@@ -1,0 +1,29 @@
+"""TPU-native actor-critic reinforcement-learning framework.
+
+A from-scratch JAX/XLA rebuild of the capabilities of
+``renly/Actor-Critic-Algs-on-Tensorflow`` (see SURVEY.md; the reference
+mount was empty at survey time, so capability parity is defined by
+BASELINE.json:5-11): A2C/A3C, PPO, DDPG, SAC, and IMPALA with V-trace,
+designed TPU-first rather than ported:
+
+- policy/value networks are Flax modules jit-compiled to XLA,
+- GAE(lambda) and V-trace are ``lax.scan`` recursions,
+- synchronous multi-actor gradient averaging is ``jax.lax.psum`` over an
+  ICI ``jax.sharding.Mesh`` (the NCCL/MirroredStrategy analog),
+- rollout/replay buffers live in TPU HBM as preallocated pytrees,
+- environments run either fully on-device (pure-JAX envs, Anakin-style)
+  or on host, bridged with double-buffered pipelining (Sebulba-style).
+"""
+
+__version__ = "0.1.0"
+
+from actor_critic_algs_on_tensorflow_tpu import (  # noqa: F401
+    algos,
+    data,
+    distributed,
+    envs,
+    models,
+    ops,
+    parallel,
+    utils,
+)

@@ -1,0 +1,52 @@
+"""Environments: pure-JAX on-device envs + wrappers (+ host bridge).
+
+``make(name, num_envs)`` builds the canonical wrapped/vectorized stack
+for a named environment.
+"""
+
+from actor_critic_algs_on_tensorflow_tpu.envs.cartpole import (  # noqa: F401
+    CartPole,
+    CartPoleParams,
+)
+from actor_critic_algs_on_tensorflow_tpu.envs.core import (  # noqa: F401
+    Box,
+    Discrete,
+    JaxEnv,
+)
+from actor_critic_algs_on_tensorflow_tpu.envs.pendulum import (  # noqa: F401
+    Pendulum,
+    PendulumParams,
+)
+from actor_critic_algs_on_tensorflow_tpu.envs.pong import (  # noqa: F401
+    PongParams,
+    PongTPU,
+)
+from actor_critic_algs_on_tensorflow_tpu.envs.wrappers import (  # noqa: F401
+    AutoReset,
+    EpisodeStats,
+    FrameStack,
+    VecEnv,
+    Wrapper,
+)
+
+_REGISTRY = {
+    "CartPole-v1": CartPole,
+    "Pendulum-v1": Pendulum,
+    "PongTPU-v0": PongTPU,
+}
+
+
+def make(name: str, num_envs: int = 1, *, frame_stack: int = 0, params=None):
+    """Build ``VecEnv(EpisodeStats(AutoReset([FrameStack(env)])))``.
+
+    Returns ``(vec_env, params)``.
+    """
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown env {name!r}; known: {sorted(_REGISTRY)}")
+    env = _REGISTRY[name]()
+    if params is None:
+        params = env.default_params()
+    if frame_stack and frame_stack > 1:
+        env = FrameStack(env, frame_stack)
+    env = VecEnv(EpisodeStats(AutoReset(env)), num_envs)
+    return env, params

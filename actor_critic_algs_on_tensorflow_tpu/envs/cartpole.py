@@ -1,0 +1,107 @@
+"""CartPole-v1 as a pure-JAX environment.
+
+Capability parity: the reference's A2C baseline runs Gym CartPole-v1
+(BASELINE.json:7). Dynamics, reward, and termination thresholds follow
+the classic Barto-Sutton-Anderson cart-pole as standardized by
+Gym/Gymnasium (Euler integration, tau=0.02, 500-step truncation), so
+reward curves are directly comparable — but the implementation is
+original JAX and the whole env runs inside ``lax.scan`` on the TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from actor_critic_algs_on_tensorflow_tpu.envs.core import Box, Discrete, JaxEnv
+
+
+@struct.dataclass
+class CartPoleParams:
+    gravity: float = 9.8
+    masscart: float = 1.0
+    masspole: float = 0.1
+    length: float = 0.5  # half the pole's length
+    force_mag: float = 10.0
+    tau: float = 0.02
+    theta_threshold: float = 12.0 * jnp.pi / 180.0
+    x_threshold: float = 2.4
+    max_steps: int = struct.field(pytree_node=False, default=500)
+
+
+@struct.dataclass
+class CartPoleState:
+    x: jax.Array
+    x_dot: jax.Array
+    theta: jax.Array
+    theta_dot: jax.Array
+    t: jax.Array  # step counter for truncation
+
+
+class CartPole(JaxEnv[CartPoleState, CartPoleParams]):
+    name = "CartPole-v1"
+
+    def default_params(self) -> CartPoleParams:
+        return CartPoleParams()
+
+    def reset(self, key, params):
+        vals = jax.random.uniform(key, (4,), jnp.float32, -0.05, 0.05)
+        state = CartPoleState(
+            x=vals[0],
+            x_dot=vals[1],
+            theta=vals[2],
+            theta_dot=vals[3],
+            t=jnp.zeros((), jnp.int32),
+        )
+        return state, self._obs(state)
+
+    def step(self, key, state, action, params):
+        del key
+        force = jnp.where(action == 1, params.force_mag, -params.force_mag)
+        costheta = jnp.cos(state.theta)
+        sintheta = jnp.sin(state.theta)
+        total_mass = params.masscart + params.masspole
+        polemass_length = params.masspole * params.length
+
+        temp = (
+            force + polemass_length * state.theta_dot**2 * sintheta
+        ) / total_mass
+        theta_acc = (params.gravity * sintheta - costheta * temp) / (
+            params.length
+            * (4.0 / 3.0 - params.masspole * costheta**2 / total_mass)
+        )
+        x_acc = temp - polemass_length * theta_acc * costheta / total_mass
+
+        x = state.x + params.tau * state.x_dot
+        x_dot = state.x_dot + params.tau * x_acc
+        theta = state.theta + params.tau * state.theta_dot
+        theta_dot = state.theta_dot + params.tau * theta_acc
+        t = state.t + 1
+
+        new_state = CartPoleState(x, x_dot, theta, theta_dot, t)
+        terminated = (
+            (jnp.abs(x) > params.x_threshold)
+            | (jnp.abs(theta) > params.theta_threshold)
+        ).astype(jnp.float32)
+        truncated = (t >= params.max_steps).astype(jnp.float32)
+        done = jnp.maximum(terminated, truncated)
+        reward = jnp.ones((), jnp.float32)
+        info: Dict[str, jax.Array] = {
+            "terminated": terminated,
+            "truncated": truncated,
+        }
+        return new_state, self._obs(new_state), reward, done, info
+
+    def _obs(self, state: CartPoleState) -> jax.Array:
+        return jnp.stack(
+            [state.x, state.x_dot, state.theta, state.theta_dot]
+        ).astype(jnp.float32)
+
+    def observation_space(self, params):
+        return Box(-jnp.inf, jnp.inf, (4,))
+
+    def action_space(self, params):
+        return Discrete(2)

@@ -1,0 +1,89 @@
+"""Pendulum-v1 as a pure-JAX environment (continuous-control smoke env).
+
+Standard frictionless inverted-pendulum swing-up (Gym/Gymnasium
+semantics: torque in [-2, 2], reward -(theta^2 + 0.1*thdot^2 +
+0.001*u^2), 200-step truncation, no termination). Serves as the cheap
+on-device continuous-control env for DDPG/SAC CI tests, standing in for
+MuJoCo workloads (BASELINE.json:9-10) which run through the host bridge.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from actor_critic_algs_on_tensorflow_tpu.envs.core import Box, JaxEnv
+
+
+@struct.dataclass
+class PendulumParams:
+    max_speed: float = 8.0
+    max_torque: float = 2.0
+    dt: float = 0.05
+    g: float = 10.0
+    m: float = 1.0
+    length: float = 1.0
+    max_steps: int = struct.field(pytree_node=False, default=200)
+
+
+@struct.dataclass
+class PendulumState:
+    theta: jax.Array
+    theta_dot: jax.Array
+    t: jax.Array
+
+
+def _angle_normalize(x):
+    return ((x + jnp.pi) % (2.0 * jnp.pi)) - jnp.pi
+
+
+class Pendulum(JaxEnv[PendulumState, PendulumParams]):
+    name = "Pendulum-v1"
+
+    def default_params(self) -> PendulumParams:
+        return PendulumParams()
+
+    def reset(self, key, params):
+        k1, k2 = jax.random.split(key)
+        theta = jax.random.uniform(k1, (), jnp.float32, -jnp.pi, jnp.pi)
+        theta_dot = jax.random.uniform(k2, (), jnp.float32, -1.0, 1.0)
+        state = PendulumState(theta, theta_dot, jnp.zeros((), jnp.int32))
+        return state, self._obs(state)
+
+    def step(self, key, state, action, params):
+        del key
+        u = jnp.clip(
+            jnp.asarray(action, jnp.float32).reshape(()),
+            -params.max_torque,
+            params.max_torque,
+        )
+        th = _angle_normalize(state.theta)
+        cost = th**2 + 0.1 * state.theta_dot**2 + 0.001 * u**2
+
+        newthdot = state.theta_dot + (
+            3.0 * params.g / (2.0 * params.length) * jnp.sin(state.theta)
+            + 3.0 / (params.m * params.length**2) * u
+        ) * params.dt
+        newthdot = jnp.clip(newthdot, -params.max_speed, params.max_speed)
+        newth = state.theta + newthdot * params.dt
+        t = state.t + 1
+
+        new_state = PendulumState(newth, newthdot, t)
+        truncated = (t >= params.max_steps).astype(jnp.float32)
+        info = {
+            "terminated": jnp.zeros((), jnp.float32),
+            "truncated": truncated,
+        }
+        return new_state, self._obs(new_state), -cost, truncated, info
+
+    def _obs(self, state):
+        return jnp.stack(
+            [jnp.cos(state.theta), jnp.sin(state.theta), state.theta_dot]
+        ).astype(jnp.float32)
+
+    def observation_space(self, params):
+        return Box(-jnp.inf, jnp.inf, (3,))
+
+    def action_space(self, params):
+        return Box(-params.max_torque, params.max_torque, (1,))

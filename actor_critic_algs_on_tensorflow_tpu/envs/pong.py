@@ -1,0 +1,235 @@
+"""PongTPU: an Atari-Pong-class environment in pure JAX.
+
+Capability parity: the reference's headline PPO workload is Atari
+``PongNoFrameskip-v4`` with a Nature-CNN encoder over 84x84 stacked
+frames (BASELINE.json:8, BASELINE.json:2). ALE ROMs are unavailable in
+this image, and — more importantly — a TPU-first design wants the env
+ON the device: PongTPU reproduces the Pong task surface (two paddles, a
+bouncing ball, first to 21, +-1 point rewards, 6 Atari-style actions,
+84x84 grayscale frames rendered on-device) as a few dozen vectorized
+XLA ops, so PPO's entire collect+learn iteration compiles to one
+program and sustains millions of env-steps/sec (the Anakin pattern).
+The dynamics step is deliberately "post-frameskip": one env step
+corresponds to one observed frame, like ``NoFrameskip`` + a skip-4
+wrapper in the classic pipeline.
+
+Scoring rules: the agent controls the RIGHT paddle; the scripted
+opponent (capped tracking speed, recenters when the ball moves away)
+controls the left. A point against the agent yields reward -1, a point
+for it +1; the episode terminates when either side reaches 21.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from actor_critic_algs_on_tensorflow_tpu.envs.core import Box, Discrete, JaxEnv
+
+
+@struct.dataclass
+class PongParams:
+    ball_speed: float = 1.5
+    max_ball_vy: float = 2.0
+    paddle_speed: float = 2.0
+    opp_speed: float = 1.0
+    spin: float = 0.25          # vy added per pixel of paddle-hit offset
+    speedup: float = 1.03       # |vx| multiplier per paddle hit
+    max_ball_vx: float = 3.0
+    win_score: int = struct.field(pytree_node=False, default=21)
+    height: int = struct.field(pytree_node=False, default=84)
+    width: int = struct.field(pytree_node=False, default=84)
+    paddle_half: int = struct.field(pytree_node=False, default=4)
+    max_steps: int = struct.field(pytree_node=False, default=10_000)
+
+
+@struct.dataclass
+class PongState:
+    ball_x: jax.Array
+    ball_y: jax.Array
+    ball_vx: jax.Array
+    ball_vy: jax.Array
+    agent_y: jax.Array
+    opp_y: jax.Array
+    agent_score: jax.Array
+    opp_score: jax.Array
+    t: jax.Array
+
+
+# Atari Pong action set: NOOP, FIRE, RIGHT(=up), LEFT(=down), RIGHTFIRE,
+# LEFTFIRE -> paddle direction {0, 0, -1, +1, -1, +1}.
+_ACTION_DIRS = jnp.asarray([0.0, 0.0, -1.0, 1.0, -1.0, 1.0], jnp.float32)
+
+
+class PongTPU(JaxEnv[PongState, PongParams]):
+    name = "PongTPU-v0"
+
+    def default_params(self) -> PongParams:
+        return PongParams()
+
+    def _serve(self, key, params, direction):
+        """Ball at center, heading `direction` (+1 toward agent)."""
+        ky = jax.random.split(key, 2)
+        vy = jax.random.uniform(ky[0], (), jnp.float32, -1.0, 1.0)
+        y = jax.random.uniform(
+            ky[1], (), jnp.float32, params.height * 0.25, params.height * 0.75
+        )
+        return (
+            jnp.asarray(params.width / 2.0, jnp.float32),
+            y,
+            direction * params.ball_speed,
+            vy,
+        )
+
+    def reset(self, key, params):
+        k1, k2 = jax.random.split(key)
+        direction = jnp.where(
+            jax.random.bernoulli(k1), jnp.float32(1.0), jnp.float32(-1.0)
+        )
+        bx, by, vx, vy = self._serve(k2, params, direction)
+        mid = jnp.asarray(params.height / 2.0, jnp.float32)
+        state = PongState(
+            ball_x=bx,
+            ball_y=by,
+            ball_vx=vx,
+            ball_vy=vy,
+            agent_y=mid,
+            opp_y=mid,
+            agent_score=jnp.zeros((), jnp.int32),
+            opp_score=jnp.zeros((), jnp.int32),
+            t=jnp.zeros((), jnp.int32),
+        )
+        return state, self._obs(state, params)
+
+    def step(self, key, state, action, params):
+        f32 = jnp.float32
+        ph = f32(params.paddle_half)
+        h, w = f32(params.height), f32(params.width)
+
+        # --- paddles ---------------------------------------------------
+        dy = _ACTION_DIRS[jnp.asarray(action, jnp.int32)] * params.paddle_speed
+        agent_y = jnp.clip(state.agent_y + dy, ph, h - 1.0 - ph)
+        # Opponent tracks the ball while it approaches, else recenters.
+        approaching = state.ball_vx < 0.0
+        opp_target = jnp.where(approaching, state.ball_y, h / 2.0)
+        opp_dy = jnp.clip(
+            opp_target - state.opp_y, -params.opp_speed, params.opp_speed
+        )
+        opp_y = jnp.clip(state.opp_y + opp_dy, ph, h - 1.0 - ph)
+
+        # --- ball flight ----------------------------------------------
+        bx = state.ball_x + state.ball_vx
+        by = state.ball_y + state.ball_vy
+        vx = state.ball_vx
+        vy = state.ball_vy
+        # bounce off top/bottom walls
+        by = jnp.where(by < 0.0, -by, by)
+        vy = jnp.where(state.ball_y + state.ball_vy < 0.0, -vy, vy)
+        over = by > (h - 1.0)
+        by = jnp.where(over, 2.0 * (h - 1.0) - by, by)
+        vy = jnp.where(over, -jnp.abs(vy), vy)
+
+        # --- paddle collisions ----------------------------------------
+        agent_col = w - 3.0
+        opp_col = 2.0
+        hit_agent = (bx >= agent_col) & (vx > 0.0) & (
+            jnp.abs(by - agent_y) <= ph + 1.0
+        )
+        hit_opp = (bx <= opp_col) & (vx < 0.0) & (
+            jnp.abs(by - opp_y) <= ph + 1.0
+        )
+        new_speed = jnp.clip(
+            jnp.abs(vx) * params.speedup, 0.0, params.max_ball_vx
+        )
+        vx = jnp.where(hit_agent, -new_speed, vx)
+        vx = jnp.where(hit_opp, new_speed, vx)
+        vy = jnp.where(
+            hit_agent,
+            jnp.clip(
+                vy + (by - agent_y) * params.spin,
+                -params.max_ball_vy,
+                params.max_ball_vy,
+            ),
+            vy,
+        )
+        vy = jnp.where(
+            hit_opp,
+            jnp.clip(
+                vy + (by - opp_y) * params.spin,
+                -params.max_ball_vy,
+                params.max_ball_vy,
+            ),
+            vy,
+        )
+        bx = jnp.where(hit_agent, agent_col - 1.0, bx)
+        bx = jnp.where(hit_opp, opp_col + 1.0, bx)
+
+        # --- scoring ---------------------------------------------------
+        agent_missed = bx > (w - 1.0)
+        opp_missed = bx < 0.0
+        reward = jnp.where(
+            agent_missed, f32(-1.0), jnp.where(opp_missed, f32(1.0), f32(0.0))
+        )
+        agent_score = state.agent_score + opp_missed.astype(jnp.int32)
+        opp_score = state.opp_score + agent_missed.astype(jnp.int32)
+
+        scored = agent_missed | opp_missed
+        serve_dir = jnp.where(agent_missed, f32(-1.0), f32(1.0))
+        sx, sy, svx, svy = self._serve(key, params, serve_dir)
+        bx = jnp.where(scored, sx, bx)
+        by = jnp.where(scored, sy, by)
+        vx = jnp.where(scored, svx, vx)
+        vy = jnp.where(scored, svy, vy)
+
+        t = state.t + 1
+        new_state = PongState(
+            ball_x=bx,
+            ball_y=by,
+            ball_vx=vx,
+            ball_vy=vy,
+            agent_y=agent_y,
+            opp_y=opp_y,
+            agent_score=agent_score,
+            opp_score=opp_score,
+            t=t,
+        )
+        terminated = (
+            (agent_score >= params.win_score) | (opp_score >= params.win_score)
+        ).astype(f32)
+        truncated = (t >= params.max_steps).astype(f32)
+        done = jnp.maximum(terminated, truncated)
+        info: Dict[str, jax.Array] = {
+            "terminated": terminated,
+            "truncated": truncated,
+        }
+        return new_state, self._obs(new_state, params), reward, done, info
+
+    def _obs(self, state: PongState, params: PongParams) -> jax.Array:
+        """Render an [H, W, 1] uint8 frame with broadcasted comparisons."""
+        rows = jnp.arange(params.height, dtype=jnp.float32)[:, None]
+        cols = jnp.arange(params.width, dtype=jnp.float32)[None, :]
+        ph = jnp.float32(params.paddle_half)
+        w = jnp.float32(params.width)
+
+        agent_mask = (
+            (cols >= w - 3.0)
+            & (cols <= w - 2.0)
+            & (jnp.abs(rows - state.agent_y) <= ph)
+        )
+        opp_mask = (
+            (cols >= 1.0) & (cols <= 2.0) & (jnp.abs(rows - state.opp_y) <= ph)
+        )
+        ball_mask = (jnp.abs(cols - state.ball_x) <= 1.0) & (
+            jnp.abs(rows - state.ball_y) <= 1.0
+        )
+        frame = (agent_mask | opp_mask | ball_mask).astype(jnp.uint8) * 255
+        return frame[..., None]
+
+    def observation_space(self, params):
+        return Box(0, 255, (params.height, params.width, 1), jnp.uint8)
+
+    def action_space(self, params):
+        return Discrete(6)

@@ -1,0 +1,12 @@
+"""Flax policy/value network zoo (MLP, Nature-CNN, DDPG/SAC heads)."""
+
+from actor_critic_algs_on_tensorflow_tpu.models.networks import (  # noqa: F401
+    DeterministicActor,
+    DiscreteActorCritic,
+    GaussianActorCritic,
+    MLPTorso,
+    NatureCNN,
+    QCritic,
+    SquashedGaussianActor,
+    TwinQCritic,
+)

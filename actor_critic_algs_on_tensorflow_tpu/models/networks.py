@@ -1,0 +1,208 @@
+"""Flax policy/value networks.
+
+Capability parity (BASELINE.json:7-10): a 2-layer MLP policy for
+CartPole, the Nature-CNN encoder for Atari-class 84x84x4 observations,
+continuous-control actor/critic pairs for DDPG, and a twin-Q critic +
+squashed-Gaussian actor for SAC. All modules are plain ``flax.linen``
+so they jit/pjit/vmap transparently; compute dtype is configurable so
+the MXU path can run bfloat16 with float32 params.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+Dtype = Any
+
+
+def _orthogonal(scale: float = jnp.sqrt(2.0)):
+    return nn.initializers.orthogonal(scale)
+
+
+def _symmetric_uniform(bound: float):
+    """U[-bound, bound] init (DDPG paper's final-layer init)."""
+
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+    return init
+
+
+class MLPTorso(nn.Module):
+    """Feed-forward torso; default 2x64 tanh (CartPole-class policies)."""
+
+    hidden_sizes: Sequence[int] = (64, 64)
+    activation: Callable = nn.tanh
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        for h in self.hidden_sizes:
+            x = nn.Dense(h, kernel_init=_orthogonal(), dtype=self.dtype)(x)
+            x = self.activation(x)
+        return x
+
+
+class NatureCNN(nn.Module):
+    """Nature-DQN convolutional encoder for 84x84 stacked frames.
+
+    Conv(32,8x8,s4) -> Conv(64,4x4,s2) -> Conv(64,3x3,s1) -> Dense(512),
+    ReLU throughout (Mnih et al. 2015). Input ``[..., 84, 84, C]`` in
+    [0, 1] or uint8 (uint8 is scaled on-device so the host->HBM transfer
+    stays 1 byte/pixel).
+    """
+
+    hidden_size: int = 512
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        if x.dtype == jnp.uint8:
+            x = x.astype(self.dtype) / 255.0
+        else:
+            x = x.astype(self.dtype)
+        batch_shape = x.shape[:-3]
+        x = x.reshape((-1,) + x.shape[-3:])
+        for features, kernel, stride in ((32, 8, 4), (64, 4, 2), (64, 3, 1)):
+            x = nn.Conv(
+                features,
+                (kernel, kernel),
+                strides=(stride, stride),
+                padding="VALID",
+                kernel_init=_orthogonal(),
+                dtype=self.dtype,
+            )(x)
+            x = nn.relu(x)
+        x = x.reshape(x.shape[0], -1)
+        x = nn.Dense(self.hidden_size, kernel_init=_orthogonal(), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        return x.reshape(batch_shape + (self.hidden_size,))
+
+
+class DiscreteActorCritic(nn.Module):
+    """Shared-torso policy + value heads for discrete action spaces.
+
+    ``torso='mlp'`` gives the CartPole 2-layer MLP (BASELINE.json:7);
+    ``torso='nature_cnn'`` the Atari encoder (BASELINE.json:8).
+    """
+
+    num_actions: int
+    torso: str = "mlp"
+    hidden_sizes: Sequence[int] = (64, 64)
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs):
+        if self.torso == "nature_cnn":
+            z = NatureCNN(dtype=self.dtype)(obs)
+        else:
+            z = MLPTorso(self.hidden_sizes, dtype=self.dtype)(obs)
+        logits = nn.Dense(
+            self.num_actions, kernel_init=_orthogonal(0.01), dtype=self.dtype
+        )(z)
+        value = nn.Dense(1, kernel_init=_orthogonal(1.0), dtype=self.dtype)(z)
+        return logits.astype(jnp.float32), value[..., 0].astype(jnp.float32)
+
+
+class GaussianActorCritic(nn.Module):
+    """Continuous-control stochastic policy + value head (PPO on MuJoCo).
+
+    State-independent log_std parameter, per standard continuous PPO.
+    """
+
+    action_dim: int
+    hidden_sizes: Sequence[int] = (64, 64)
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs):
+        z = MLPTorso(self.hidden_sizes, dtype=self.dtype)(obs)
+        mean = nn.Dense(
+            self.action_dim, kernel_init=_orthogonal(0.01), dtype=self.dtype
+        )(z)
+        log_std = self.param(
+            "log_std", nn.initializers.zeros, (self.action_dim,)
+        )
+        zv = MLPTorso(self.hidden_sizes, dtype=self.dtype)(obs)
+        value = nn.Dense(1, kernel_init=_orthogonal(1.0), dtype=self.dtype)(zv)
+        return (
+            mean.astype(jnp.float32),
+            jnp.broadcast_to(log_std, mean.shape).astype(jnp.float32),
+            value[..., 0].astype(jnp.float32),
+        )
+
+
+class DeterministicActor(nn.Module):
+    """DDPG actor: tanh-bounded deterministic policy (BASELINE.json:9)."""
+
+    action_dim: int
+    hidden_sizes: Sequence[int] = (256, 256)
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs):
+        z = MLPTorso(self.hidden_sizes, activation=nn.relu, dtype=self.dtype)(obs)
+        a = nn.Dense(
+            self.action_dim,
+            kernel_init=_symmetric_uniform(3e-3),
+            dtype=self.dtype,
+        )(z)
+        return jnp.tanh(a).astype(jnp.float32)
+
+
+class QCritic(nn.Module):
+    """State-action value function Q(s, a)."""
+
+    hidden_sizes: Sequence[int] = (256, 256)
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs, action):
+        x = jnp.concatenate(
+            [obs.astype(self.dtype), action.astype(self.dtype)], axis=-1
+        )
+        z = MLPTorso(self.hidden_sizes, activation=nn.relu, dtype=self.dtype)(x)
+        q = nn.Dense(1, kernel_init=_symmetric_uniform(3e-3), dtype=self.dtype)(z)
+        return q[..., 0].astype(jnp.float32)
+
+
+class TwinQCritic(nn.Module):
+    """Two independent Q networks evaluated in one call (SAC twin-Q,
+    BASELINE.json:10). Returns ``(q1, q2)``."""
+
+    hidden_sizes: Sequence[int] = (256, 256)
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs, action):
+        q1 = QCritic(self.hidden_sizes, dtype=self.dtype)(obs, action)
+        q2 = QCritic(self.hidden_sizes, dtype=self.dtype)(obs, action)
+        return q1, q2
+
+
+class SquashedGaussianActor(nn.Module):
+    """SAC actor: tanh-squashed Gaussian with state-dependent std
+    (BASELINE.json:10). Returns ``(mean, log_std)`` of the pre-tanh
+    Gaussian; squashing/log-prob correction lives in
+    ``ops.distributions.TanhGaussian``."""
+
+    action_dim: int
+    hidden_sizes: Sequence[int] = (256, 256)
+    log_std_min: float = -20.0
+    log_std_max: float = 2.0
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs):
+        z = MLPTorso(self.hidden_sizes, activation=nn.relu, dtype=self.dtype)(obs)
+        mean = nn.Dense(self.action_dim, dtype=self.dtype)(z)
+        log_std = nn.Dense(self.action_dim, dtype=self.dtype)(z)
+        log_std = jnp.clip(
+            log_std.astype(jnp.float32), self.log_std_min, self.log_std_max
+        )
+        return mean.astype(jnp.float32), log_std

@@ -1,0 +1,114 @@
+"""Lightweight policy distributions as JAX pytrees.
+
+Capability parity: the reference's policies are a softmax head for
+discrete control (CartPole / Atari — BASELINE.json:7-8), a deterministic
++ OU-noise actor for DDPG (BASELINE.json:9), and a squashed-Gaussian
+actor with learned entropy temperature for SAC (BASELINE.json:10).
+These classes provide sample / log_prob / entropy as pure functions on
+arrays so they can live inside jitted update steps; no external
+distribution library is used.
+
+Implemented as ``NamedTuple`` pytrees: they flatten transparently
+through ``jax.jit`` / ``lax.scan`` / ``shard_map`` boundaries.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+_LOG_SQRT_2PI = 0.5 * math.log(2.0 * math.pi)
+
+
+class Categorical(NamedTuple):
+    """Categorical distribution over logits ``[..., A]``."""
+
+    logits: jax.Array
+
+    def sample(self, key: jax.Array) -> jax.Array:
+        return jax.random.categorical(key, self.logits, axis=-1)
+
+    def mode(self) -> jax.Array:
+        return jnp.argmax(self.logits, axis=-1)
+
+    def log_prob(self, actions: jax.Array) -> jax.Array:
+        log_p = jax.nn.log_softmax(self.logits, axis=-1)
+        return jnp.take_along_axis(
+            log_p, actions[..., None].astype(jnp.int32), axis=-1
+        )[..., 0]
+
+    def entropy(self) -> jax.Array:
+        log_p = jax.nn.log_softmax(self.logits, axis=-1)
+        p = jnp.exp(log_p)
+        return -jnp.sum(p * log_p, axis=-1)
+
+    def kl(self, other: "Categorical") -> jax.Array:
+        log_p = jax.nn.log_softmax(self.logits, axis=-1)
+        log_q = jax.nn.log_softmax(other.logits, axis=-1)
+        return jnp.sum(jnp.exp(log_p) * (log_p - log_q), axis=-1)
+
+
+class DiagGaussian(NamedTuple):
+    """Diagonal Gaussian with event shape ``[..., D]``."""
+
+    mean: jax.Array
+    log_std: jax.Array
+
+    def sample(self, key: jax.Array) -> jax.Array:
+        eps = jax.random.normal(key, self.mean.shape, self.mean.dtype)
+        return self.mean + jnp.exp(self.log_std) * eps
+
+    def mode(self) -> jax.Array:
+        return self.mean
+
+    def log_prob(self, actions: jax.Array) -> jax.Array:
+        z = (actions - self.mean) * jnp.exp(-self.log_std)
+        per_dim = -0.5 * z * z - self.log_std - _LOG_SQRT_2PI
+        return jnp.sum(per_dim, axis=-1)
+
+    def entropy(self) -> jax.Array:
+        return jnp.sum(self.log_std + 0.5 + _LOG_SQRT_2PI, axis=-1)
+
+
+class TanhGaussian(NamedTuple):
+    """Tanh-squashed diagonal Gaussian (SAC actor, BASELINE.json:10).
+
+    ``sample_and_log_prob`` applies the change-of-variables correction
+
+        log pi(a) = log N(u) - sum_i log(1 - tanh(u_i)^2)
+
+    using the numerically stable identity
+    ``log(1 - tanh(u)^2) = 2 * (log 2 - u - softplus(-2u))``.
+    """
+
+    mean: jax.Array
+    log_std: jax.Array
+
+    def _base(self) -> DiagGaussian:
+        return DiagGaussian(self.mean, self.log_std)
+
+    def sample_and_log_prob(self, key: jax.Array):
+        u = self._base().sample(key)
+        a = jnp.tanh(u)
+        log_p = self._base().log_prob(u) - jnp.sum(
+            _tanh_log_det_jacobian(u), axis=-1
+        )
+        return a, log_p
+
+    def sample(self, key: jax.Array) -> jax.Array:
+        return jnp.tanh(self._base().sample(key))
+
+    def mode(self) -> jax.Array:
+        return jnp.tanh(self.mean)
+
+    def log_prob_from_pre_tanh(self, u: jax.Array) -> jax.Array:
+        return self._base().log_prob(u) - jnp.sum(
+            _tanh_log_det_jacobian(u), axis=-1
+        )
+
+
+def _tanh_log_det_jacobian(u: jax.Array) -> jax.Array:
+    return 2.0 * (math.log(2.0) - u - jax.nn.softplus(-2.0 * u))

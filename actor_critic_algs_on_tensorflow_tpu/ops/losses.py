@@ -1,0 +1,94 @@
+"""Pure-function actor-critic loss terms.
+
+Capability parity: per-algorithm losses of the reference's trainers
+(BASELINE.json:5-11) — A2C policy-gradient + value + entropy terms, the
+PPO clipped surrogate, and polyak target-network averaging used by
+DDPG/SAC. All are shape-polymorphic pure functions intended to be
+composed inside one jitted update step.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class PPOStats(NamedTuple):
+    policy_loss: jax.Array
+    clip_fraction: jax.Array
+    approx_kl: jax.Array
+
+
+def ppo_clip_loss(
+    log_probs: jax.Array,
+    old_log_probs: jax.Array,
+    advantages: jax.Array,
+    *,
+    clip_eps: float = 0.2,
+) -> PPOStats:
+    """Clipped-surrogate PPO policy loss (mean over all leading axes)."""
+    log_ratio = log_probs - old_log_probs
+    ratio = jnp.exp(log_ratio)
+    unclipped = ratio * advantages
+    clipped = jnp.clip(ratio, 1.0 - clip_eps, 1.0 + clip_eps) * advantages
+    policy_loss = -jnp.mean(jnp.minimum(unclipped, clipped))
+    clip_fraction = jnp.mean(
+        (jnp.abs(ratio - 1.0) > clip_eps).astype(jnp.float32)
+    )
+    # http://joschu.net/blog/kl-approx.html (k3 estimator)
+    approx_kl = jnp.mean(ratio - 1.0 - log_ratio)
+    return PPOStats(policy_loss, clip_fraction, approx_kl)
+
+
+def clipped_value_loss(
+    values: jax.Array,
+    old_values: jax.Array,
+    targets: jax.Array,
+    *,
+    clip_eps: float = 0.2,
+) -> jax.Array:
+    """PPO-style clipped value loss, 0.5 * max(unclipped, clipped) MSE."""
+    clipped = old_values + jnp.clip(values - old_values, -clip_eps, clip_eps)
+    return 0.5 * jnp.mean(
+        jnp.maximum((values - targets) ** 2, (clipped - targets) ** 2)
+    )
+
+
+def value_loss(values: jax.Array, targets: jax.Array) -> jax.Array:
+    return 0.5 * jnp.mean((values - targets) ** 2)
+
+
+def policy_gradient_loss(
+    log_probs: jax.Array, advantages: jax.Array
+) -> jax.Array:
+    """A2C/A3C policy-gradient loss: -E[log pi(a|s) * A] (adv detached)."""
+    return -jnp.mean(log_probs * jax.lax.stop_gradient(advantages))
+
+
+def entropy_loss(entropy: jax.Array) -> jax.Array:
+    """Entropy bonus expressed as a loss (to be added with a coefficient)."""
+    return -jnp.mean(entropy)
+
+
+def normalize_advantages(adv: jax.Array, eps: float = 1e-8) -> jax.Array:
+    return (adv - jnp.mean(adv)) / (jnp.std(adv) + eps)
+
+
+def polyak_update(target_params, online_params, tau: float):
+    """Soft target-network update: target <- (1-tau)*target + tau*online.
+
+    Used by DDPG/SAC target critics (BASELINE.json:9-10); a pytree map
+    so it fuses into the jitted update step.
+    """
+    return jax.tree_util.tree_map(
+        lambda t, o: (1.0 - tau) * t + tau * o, target_params, online_params
+    )
+
+
+def huber_loss(pred: jax.Array, target: jax.Array, delta: float = 1.0):
+    err = pred - target
+    abs_err = jnp.abs(err)
+    quad = jnp.minimum(abs_err, delta)
+    return jnp.mean(0.5 * quad**2 + delta * (abs_err - quad))

@@ -1,0 +1,46 @@
+"""Exploration noise processes as functional JAX carries.
+
+Capability parity: the reference's DDPG uses Ornstein-Uhlenbeck
+exploration noise on MuJoCo HalfCheetah (BASELINE.json:9 — "continuous
+control, OU-noise explore"). The process state is an explicit carry so
+it threads through ``lax.scan`` rollout loops and vectorizes over
+parallel envs with ``vmap``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class OUState(NamedTuple):
+    noise: jax.Array  # [..., action_dim]
+
+
+def ou_init(shape, dtype=jnp.float32) -> OUState:
+    return OUState(noise=jnp.zeros(shape, dtype))
+
+
+def ou_step(
+    state: OUState,
+    key: jax.Array,
+    *,
+    mu: float = 0.0,
+    theta: float = 0.15,
+    sigma: float = 0.2,
+    dt: float = 1e-2,
+):
+    """One Euler-Maruyama step of dX = theta*(mu - X)*dt + sigma*dW."""
+    x = state.noise
+    eps = jax.random.normal(key, x.shape, x.dtype)
+    x_next = x + theta * (mu - x) * dt + sigma * jnp.sqrt(jnp.asarray(dt, x.dtype)) * eps
+    return OUState(noise=x_next), x_next
+
+
+def ou_reset_where(state: OUState, done: jax.Array) -> OUState:
+    """Zero the noise for environments that just reset (done==1)."""
+    mask = jnp.asarray(done, state.noise.dtype)
+    mask = mask.reshape(mask.shape + (1,) * (state.noise.ndim - mask.ndim))
+    return OUState(noise=state.noise * (1.0 - mask))

@@ -1,0 +1,3 @@
+"""Core substrate: configs, PRNG discipline, metrics, checkpointing."""
+
+from actor_critic_algs_on_tensorflow_tpu.utils import config, metrics, prng  # noqa: F401

@@ -1,0 +1,82 @@
+"""Dataclass config system with named presets and CLI overrides.
+
+Capability parity: the reference exposes ``train.py`` entrypoints with
+per-algorithm/env run configurations (BASELINE.json:5-11). Here each
+algorithm has a frozen dataclass config; the five baseline workloads
+(BASELINE.json:7-11) ship as named presets in the CLI subpackage; any
+field is overridable from the command line as ``key=value``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+
+def _field_types(cls) -> dict:
+    return {f.name: f.type for f in dataclasses.fields(cls)}
+
+
+def _coerce(raw: str, current: Any) -> Any:
+    """Coerce a CLI string to the type of the current field value."""
+    if isinstance(current, bool):
+        if raw.lower() in ("1", "true", "yes", "on"):
+            return True
+        if raw.lower() in ("0", "false", "no", "off"):
+            return False
+        raise ValueError(f"cannot parse bool from {raw!r}")
+    if isinstance(current, int) and not isinstance(current, bool):
+        return int(raw)
+    if isinstance(current, float):
+        return float(raw)
+    if isinstance(current, tuple):
+        if raw.strip() == "":
+            return ()
+        elem = current[0] if current else 0
+        return tuple(type(elem)(p) for p in raw.split(","))
+    if current is None or isinstance(current, str):
+        return raw if raw.lower() != "none" else None
+    raise ValueError(f"unsupported config field type {type(current)}")
+
+
+def apply_overrides(cfg, overrides: Tuple[str, ...]):
+    """Apply ``key=value`` strings to a (possibly nested) dataclass.
+
+    Nested fields use dots: ``env.num_envs=16``.
+    """
+    for item in overrides:
+        if "=" not in item:
+            raise ValueError(f"override {item!r} is not key=value")
+        key, raw = item.split("=", 1)
+        cfg = _set_path(cfg, key.split("."), raw)
+    return cfg
+
+
+def _set_path(cfg, path, raw):
+    name = path[0]
+    if not hasattr(cfg, name):
+        raise KeyError(
+            f"{type(cfg).__name__} has no field {name!r}; "
+            f"valid: {sorted(_field_types(type(cfg)))}"
+        )
+    current = getattr(cfg, name)
+    if len(path) == 1:
+        if dataclasses.is_dataclass(current):
+            raise ValueError(
+                f"{name!r} is a nested config; set a field inside it, "
+                f"e.g. {name}.{dataclasses.fields(current)[0].name}=..."
+            )
+        return dataclasses.replace(cfg, **{name: _coerce(raw, current)})
+    return dataclasses.replace(cfg, **{name: _set_path(current, path[1:], raw)})
+
+
+def asdict_flat(cfg, prefix: str = "") -> dict:
+    out = {}
+    for f in dataclasses.fields(cfg):
+        v = getattr(cfg, f.name)
+        key = f"{prefix}{f.name}"
+        if dataclasses.is_dataclass(v):
+            out.update(asdict_flat(v, prefix=key + "."))
+        else:
+            out[key] = v
+    return out

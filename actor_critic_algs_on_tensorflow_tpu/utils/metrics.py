@@ -1,0 +1,59 @@
+"""On-device scalar metrics.
+
+Design rule (SURVEY.md §5): metrics are computed on-device inside the
+jitted step and fetched once per logging interval, so logging never
+forces an early device sync. A ``Metrics`` dict maps name -> scalar
+array; host-side consumption converts to floats in one transfer.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Mapping
+
+import jax
+import numpy as np
+
+Metrics = Dict[str, jax.Array]
+
+
+def device_get_metrics(metrics: Mapping[str, jax.Array]) -> Dict[str, float]:
+    """One host transfer for the whole metric dict."""
+    flat = jax.device_get(dict(metrics))
+    return {k: float(np.asarray(v)) for k, v in flat.items()}
+
+
+def format_metrics(step: int, metrics: Mapping[str, float]) -> str:
+    parts = [f"step={step}"]
+    for k in sorted(metrics):
+        v = metrics[k]
+        parts.append(f"{k}={v:.4g}")
+    return " ".join(parts)
+
+
+class Stopwatch:
+    """Wall-clock rate meter for env-steps/sec (the headline metric,
+    BASELINE.json:2)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._t0 = time.perf_counter()
+        self._steps0 = 0
+        self._steps = 0
+
+    def update(self, steps: int):
+        self._steps = steps
+
+    def rate(self) -> float:
+        dt = time.perf_counter() - self._t0
+        if dt <= 0:
+            return 0.0
+        return (self._steps - self._steps0) / dt
+
+    def lap(self) -> float:
+        r = self.rate()
+        self._t0 = time.perf_counter()
+        self._steps0 = self._steps
+        return r

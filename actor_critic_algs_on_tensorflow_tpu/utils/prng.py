@@ -1,0 +1,29 @@
+"""PRNG discipline helpers.
+
+JAX randomness is explicit; these helpers keep a single root key per
+run and derive per-iteration / per-device / per-env keys by folding in
+integer coordinates, which is cheap inside jit (no key threading
+through host code) and reproducible across restarts.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def root_key(seed: int) -> jax.Array:
+    return jax.random.PRNGKey(seed)
+
+
+def fold(key: jax.Array, *data: int | jax.Array) -> jax.Array:
+    """Fold one or more integers into a key."""
+    for d in data:
+        key = jax.random.fold_in(key, d)
+    return key
+
+
+def split_pytree_keys(key: jax.Array, tree):
+    """One fresh key per leaf of ``tree`` (same treedef, keys as leaves)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    keys = jax.random.split(key, len(leaves))
+    return jax.tree_util.tree_unflatten(treedef, list(keys))

@@ -1,0 +1,22 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Per SURVEY.md §4.3: distributed behavior is tested without a TPU pod by
+faking 8 host devices in one process. The environment pre-imports jax
+with a TPU platform selected (sitecustomize), so env vars are too late;
+``jax.config.update`` before first backend use does the job.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+assert len(jax.devices()) == 8, jax.devices()

@@ -1,0 +1,64 @@
+"""PPO clipped-surrogate and auxiliary losses vs. hand computation
+(SURVEY.md §4.1)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from actor_critic_algs_on_tensorflow_tpu.ops import (
+    clipped_value_loss,
+    policy_gradient_loss,
+    polyak_update,
+    ppo_clip_loss,
+    value_loss,
+)
+
+
+def test_ppo_clip_loss_hand_computed():
+    # ratios: 1.5 (clipped to 1.2 for adv>0), 0.5 (clipped to 0.8 for adv>0)
+    old_logp = jnp.zeros(2)
+    logp = jnp.log(jnp.asarray([1.5, 0.5]))
+    adv = jnp.asarray([1.0, 1.0])
+    out = ppo_clip_loss(logp, old_logp, adv, clip_eps=0.2)
+    # min(1.5, 1.2)*1 = 1.2 ; min(0.5, 0.8)*1 = 0.5 -> mean 0.85
+    np.testing.assert_allclose(float(out.policy_loss), -0.85, rtol=1e-6)
+    np.testing.assert_allclose(float(out.clip_fraction), 1.0)
+
+    adv_neg = jnp.asarray([-1.0, -1.0])
+    out2 = ppo_clip_loss(logp, old_logp, adv_neg, clip_eps=0.2)
+    # min(-1.5, -1.2) = -1.5 ; min(-0.5, -0.8) = -0.8 -> mean -1.15
+    np.testing.assert_allclose(float(out2.policy_loss), 1.15, rtol=1e-6)
+
+
+def test_ppo_identity_ratio_is_vanilla_pg():
+    logp = jnp.asarray([-0.5, -1.0])
+    adv = jnp.asarray([2.0, -1.0])
+    out = ppo_clip_loss(logp, logp, adv, clip_eps=0.2)
+    np.testing.assert_allclose(float(out.policy_loss), -float(jnp.mean(adv)), rtol=1e-6)
+    np.testing.assert_allclose(float(out.approx_kl), 0.0, atol=1e-7)
+
+
+def test_value_losses():
+    v = jnp.asarray([1.0, 2.0])
+    tgt = jnp.asarray([0.0, 0.0])
+    np.testing.assert_allclose(float(value_loss(v, tgt)), 0.5 * (1 + 4) / 2)
+    # clipped: old=0, v-old clipped to 0.2 -> max((v-t)^2, (0.2-t)^2)
+    out = clipped_value_loss(v, jnp.zeros(2), tgt, clip_eps=0.2)
+    np.testing.assert_allclose(float(out), 0.5 * (1.0 + 4.0) / 2)
+
+
+def test_policy_gradient_loss_detaches_adv():
+    import jax
+
+    def f(logp):
+        return policy_gradient_loss(logp, logp * 3.0)
+
+    g = jax.grad(f)(jnp.asarray([2.0]))
+    # d/dlogp of -(logp * sg(3*logp))/1 = -3*logp  => grad = -6
+    np.testing.assert_allclose(np.asarray(g), [-6.0], rtol=1e-6)
+
+
+def test_polyak_update():
+    t = {"w": jnp.zeros(3)}
+    o = {"w": jnp.ones(3)}
+    out = polyak_update(t, o, tau=0.1)
+    np.testing.assert_allclose(np.asarray(out["w"]), 0.1 * np.ones(3), rtol=1e-6)

@@ -1,0 +1,49 @@
+"""OU-noise statistics (SURVEY.md §4.1): stationary variance of the
+discretized Ornstein-Uhlenbeck process must match sigma^2/(2*theta)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from actor_critic_algs_on_tensorflow_tpu.ops import (
+    ou_init,
+    ou_reset_where,
+    ou_step,
+)
+
+
+def test_ou_stationary_variance():
+    theta, sigma, dt = 0.15, 0.2, 1e-2
+    n = 4096
+
+    def body(carry, key):
+        state, _ = carry
+        state, x = ou_step(state, key, theta=theta, sigma=sigma, dt=dt)
+        return (state, x), x
+
+    keys = jax.random.split(jax.random.PRNGKey(0), 20000)
+    state = ou_init((n, 1))
+    (_, _), xs = jax.lax.scan(body, (state, jnp.zeros((n, 1))), keys)
+    tail = np.asarray(xs[5000:]).ravel()
+    np.testing.assert_allclose(tail.mean(), 0.0, atol=5e-3)
+    # discretized stationary var: sigma^2*dt / (1-(1-theta*dt)^2) ~ sigma^2/(2 theta)
+    expected = sigma**2 / (2 * theta)
+    np.testing.assert_allclose(tail.var(), expected, rtol=0.05)
+
+
+def test_ou_mean_reversion_deterministic():
+    state = ou_init((1,))
+    state = state._replace(noise=jnp.asarray([1.0]))
+    new_state, _ = ou_step(
+        state, jax.random.PRNGKey(0), theta=0.5, sigma=0.0, dt=0.1
+    )
+    np.testing.assert_allclose(float(new_state.noise[0]), 0.95, rtol=1e-6)
+
+
+def test_ou_reset_where():
+    state = ou_init((3, 2))
+    state = state._replace(noise=jnp.ones((3, 2)))
+    out = ou_reset_where(state, jnp.asarray([1.0, 0.0, 1.0]))
+    np.testing.assert_allclose(
+        np.asarray(out.noise), [[0, 0], [1, 1], [0, 0]]
+    )
