@@ -1,0 +1,267 @@
+"""Shared on-policy training machinery (the Anakin pattern).
+
+Capability parity: the reference's on-policy trainers loop
+rollout -> GAE -> update with synchronous multi-actor gradient
+averaging (BASELINE.json:5, SURVEY.md §3.1). TPU-first, the WHOLE
+iteration — T env steps x B envs collected by ``lax.scan`` over
+vmapped pure-JAX envs, advantage estimation, and the optimizer
+update with ``lax.pmean`` gradient averaging — is ONE jitted
+``shard_map`` program over the ``data`` mesh axis. The host only
+dispatches iterations and reads metrics, so the TPU never waits on
+Python (the reference's host env-step loop is the bottleneck this
+design removes; SURVEY.md §3.1 "hot loops").
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Any, Callable, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax.sharding import Mesh, PartitionSpec as P
+
+from actor_critic_algs_on_tensorflow_tpu.data.rollout import Trajectory
+from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
+    DATA_AXIS,
+    device_count,
+    make_mesh,
+)
+
+# policy_fn(params, obs, key) -> (action, log_prob, value)
+PolicyFn = Callable[[Any, Any, jax.Array], Tuple[jax.Array, jax.Array, jax.Array]]
+
+
+@struct.dataclass
+class OnPolicyState:
+    """Train state for A2C/PPO-style algorithms.
+
+    ``params``/``opt_state``/``key``/``step`` are replicated across the
+    mesh; ``env_state``/``obs`` are sharded on their leading (env) axis.
+    """
+
+    params: Any
+    opt_state: Any
+    env_state: Any
+    obs: Any
+    key: jax.Array
+    step: jax.Array  # global env-step counter (int64-safe float32? int32)
+
+
+def state_specs(state: OnPolicyState) -> OnPolicyState:
+    """PartitionSpec pytree matching ``OnPolicyState``."""
+    repl = lambda t: jax.tree_util.tree_map(lambda _: P(), t)
+    shard = lambda t: jax.tree_util.tree_map(lambda _: P(DATA_AXIS), t)
+    return OnPolicyState(
+        params=repl(state.params),
+        opt_state=repl(state.opt_state),
+        env_state=shard(state.env_state),
+        obs=shard(state.obs),
+        key=P(),
+        step=P(),
+    )
+
+
+def collect_rollout(
+    env,
+    env_params,
+    policy_fn: PolicyFn,
+    params,
+    env_state,
+    obs,
+    key: jax.Array,
+    length: int,
+    *,
+    keep_final_obs: bool = False,
+):
+    """Collect a ``[T, B]`` trajectory with one ``lax.scan``.
+
+    Returns ``(env_state, obs, trajectory, ep_info)`` where ``ep_info``
+    holds per-step episode stats from the EpisodeStats wrapper plus the
+    ``terminated`` mask (and, with ``keep_final_obs``, the pre-reset
+    ``final_obs`` for time-limit bootstrapping — costs a full extra
+    ``[T, B, obs]`` buffer, so off by default for image envs).
+    """
+
+    def _step(carry, step_key):
+        env_state, obs = carry
+        k_act, k_env = jax.random.split(step_key)
+        action, log_prob, value = policy_fn(params, obs, k_act)
+        env_state, next_obs, reward, done, info = env.step(
+            k_env, env_state, action, env_params
+        )
+        traj = Trajectory(
+            obs=obs,
+            actions=action,
+            rewards=reward,
+            dones=done,
+            log_probs=log_prob,
+            values=value,
+        )
+        ep_info = {
+            "episode_return": info["episode_return"],
+            "done_episode": info["done_episode"],
+            "terminated": info["terminated"],
+        }
+        if keep_final_obs:
+            ep_info["final_obs"] = info["final_obs"]
+        return (env_state, next_obs), (traj, ep_info)
+
+    keys = jax.random.split(key, length)
+    (env_state, obs), (traj, ep_info) = jax.lax.scan(
+        _step, (env_state, obs), keys
+    )
+    return env_state, obs, traj, ep_info
+
+
+def episode_metrics(ep_info, axis_name: str | None = DATA_AXIS):
+    """Mean return/length over episodes finished in this rollout.
+
+    Cross-device reduction via psum so the result is replicated.
+    """
+    done = ep_info["done_episode"]
+    ret_sum = jnp.sum(ep_info["episode_return"] * done)
+    n = jnp.sum(done)
+    if axis_name is not None:
+        ret_sum = jax.lax.psum(ret_sum, axis_name)
+        n = jax.lax.psum(n, axis_name)
+    return {
+        "episodes": n,
+        "avg_return": ret_sum / jnp.maximum(n, 1.0),
+    }
+
+
+def evaluate(
+    env,
+    env_params,
+    act_fn: Callable[[Any, jax.Array], jax.Array],
+    key: jax.Array,
+    *,
+    num_envs: int,
+    max_steps: int = 1000,
+):
+    """Greedy/stochastic policy evaluation on a vectorized env.
+
+    Runs until each env finishes its FIRST episode (or ``max_steps``).
+    ``act_fn(obs, key) -> actions``. Returns ``(mean_return,
+    per_env_returns, fraction_finished)``; jit-compiled by the caller.
+    """
+
+    def _step(carry, k):
+        env_state, obs, done_seen, ep_ret = carry
+        actions = act_fn(obs, k)
+        env_state, obs, _, done, info = env.step(k, env_state, actions, env_params)
+        ep_ret = jnp.where(
+            done_seen > 0.5,
+            ep_ret,
+            jnp.where(done > 0.5, info["episode_return"], ep_ret),
+        )
+        done_seen = jnp.maximum(done_seen, done)
+        return (env_state, obs, done_seen, ep_ret), None
+
+    k_reset, k_run = jax.random.split(key)
+    env_state, obs = env.reset(k_reset, env_params)
+    init = (
+        env_state,
+        obs,
+        jnp.zeros(num_envs),
+        jnp.zeros(num_envs),
+    )
+    (env_state, obs, done_seen, ep_ret), _ = jax.lax.scan(
+        _step, init, jax.random.split(k_run, max_steps)
+    )
+    return jnp.mean(ep_ret), ep_ret, jnp.mean(done_seen)
+
+
+class IterationFns(NamedTuple):
+    """A compiled training program: ``init`` and one fused iteration."""
+
+    init: Callable[[jax.Array], OnPolicyState]
+    iteration: Callable[[OnPolicyState], Tuple[OnPolicyState, Dict[str, jax.Array]]]
+    mesh: Mesh
+    steps_per_iteration: int
+
+
+def build_data_parallel_iteration(
+    local_iteration: Callable,
+    example_state: OnPolicyState,
+    mesh: Mesh,
+) -> Callable:
+    """Wrap a per-device iteration in ``shard_map`` + ``jit``.
+
+    ``local_iteration(state) -> (state, metrics)`` sees local env
+    shards and full (replicated) params; it must pmean/psum anything
+    that crosses devices (grads, metrics). Donation of the input state
+    makes HBM buffers reusable across iterations.
+    """
+    specs = state_specs(example_state)
+    mapped = jax.shard_map(
+        local_iteration,
+        mesh=mesh,
+        in_specs=(specs,),
+        out_specs=(specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped, donate_argnums=(0,))
+
+
+def run_loop(
+    fns: IterationFns,
+    *,
+    total_env_steps: int,
+    seed: int = 0,
+    log_interval_iters: int = 20,
+    log_fn: Callable[[int, Dict[str, float]], None] | None = None,
+    checkpointer=None,
+    checkpoint_interval_iters: int = 0,
+    state: OnPolicyState | None = None,
+):
+    """Host-side training loop: dispatch iterations, surface metrics.
+
+    Returns ``(final_state, history)`` where ``history`` is a list of
+    (env_steps, metrics-dict) tuples fetched at log intervals.
+    """
+    from actor_critic_algs_on_tensorflow_tpu.utils.metrics import (
+        device_get_metrics,
+        format_metrics,
+    )
+
+    if state is None:
+        state = fns.init(jax.random.PRNGKey(seed))
+    # XLA's in-process CPU communicator deadlocks when collectives from
+    # multiple in-flight executions interleave (observed: rendezvous
+    # timeout with 6/8 arrivals). On the virtual CPU mesh we serialize
+    # executions; on real TPU meshes async dispatch pipelines freely.
+    serialize = (
+        jax.default_backend() == "cpu" and device_count(fns.mesh) > 1
+    )
+    num_iters = max(1, total_env_steps // fns.steps_per_iteration)
+    history = []
+    t0 = time.perf_counter()
+    steps_done0 = int(state.step)
+    last_metrics = None
+    for it in range(num_iters):
+        state, metrics = fns.iteration(state)
+        last_metrics = metrics
+        if serialize:
+            jax.block_until_ready(metrics)
+        if (it + 1) % log_interval_iters == 0 or it == num_iters - 1:
+            m = device_get_metrics(metrics)
+            env_steps = steps_done0 + (it + 1) * fns.steps_per_iteration
+            dt = time.perf_counter() - t0
+            m["steps_per_sec"] = ((it + 1) * fns.steps_per_iteration) / dt
+            history.append((env_steps, m))
+            if log_fn is not None:
+                log_fn(env_steps, m)
+            else:
+                print(format_metrics(env_steps, m), flush=True)
+        if (
+            checkpointer is not None
+            and checkpoint_interval_iters
+            and (it + 1) % checkpoint_interval_iters == 0
+        ):
+            checkpointer.save(int(state.step), state)
+    jax.block_until_ready(last_metrics)
+    return state, history

@@ -1,0 +1,46 @@
+"""On-policy trajectory containers.
+
+Capability parity: the reference stores rollouts for its on-policy
+trainers (BASELINE.json:5 — "the rollout/replay buffer lives in TPU
+HBM"). In the Anakin design the rollout buffer IS the stacked output
+of the collection ``lax.scan`` — a time-major ``Trajectory`` pytree
+that never leaves HBM; these helpers name its fields and reshape it
+for minibatched updates.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Trajectory(NamedTuple):
+    """Time-major rollout: every field is ``[T, B, ...]``."""
+
+    obs: Any
+    actions: jax.Array
+    rewards: jax.Array
+    dones: jax.Array
+    log_probs: jax.Array
+    values: jax.Array
+
+
+def flatten_time_batch(tree):
+    """[T, B, ...] -> [T*B, ...] for minibatched updates."""
+    return jax.tree_util.tree_map(
+        lambda x: x.reshape((-1,) + x.shape[2:]), tree
+    )
+
+
+def minibatch_iter_indices(key: jax.Array, n: int, num_minibatches: int):
+    """Random permutation of ``n`` split into ``num_minibatches`` index
+    blocks, as a ``[num_minibatches, n // num_minibatches]`` array."""
+    perm = jax.random.permutation(key, n)
+    size = n // num_minibatches
+    return perm[: size * num_minibatches].reshape(num_minibatches, size)
+
+
+def take_minibatch(tree, idx: jax.Array):
+    return jax.tree_util.tree_map(lambda x: jnp.take(x, idx, axis=0), tree)

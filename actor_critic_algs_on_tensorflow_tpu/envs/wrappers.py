@@ -91,6 +91,11 @@ class AutoReset(Wrapper):
             next_state,
         )
         obs_out = jnp.where(_expand(is_done, obs.ndim), reset_obs, obs)
+        # The true (pre-reset) next observation: at termination the
+        # terminal obs, at truncation the obs a value fn may bootstrap
+        # from (time-limit bootstrapping; see ops.gae).
+        info = dict(info)
+        info["final_obs"] = obs
         return state_out, obs_out, reward, done, info
 
 

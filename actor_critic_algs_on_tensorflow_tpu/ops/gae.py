@@ -26,17 +26,29 @@ def gae_advantages(
     *,
     gamma: float = 0.99,
     lam: float = 0.95,
+    terminations: jax.Array | None = None,
+    truncation_values: jax.Array | None = None,
 ):
     """Compute GAE(lambda) advantages and value targets.
 
     Args:
       rewards: ``[T, ...]`` rewards for steps ``0..T-1``.
       values: ``[T, ...]`` value estimates ``V(s_t)``.
-      dones: ``[T, ...]`` episode-termination flags for step ``t``
-        (1.0 where ``s_{t+1}`` began a new episode; bootstrap is cut).
+      dones: ``[T, ...]`` episode-boundary flags for step ``t`` (1.0
+        where ``s_{t+1}`` began a new episode; cuts the recursion).
       last_value: ``[...]`` value estimate for ``s_T`` (bootstrap).
       gamma: discount factor.
       lam: GAE lambda.
+      terminations: optional ``[T, ...]`` flags marking TRUE terminal
+        transitions (env reached an absorbing state). Where an episode
+        ended by time-limit truncation instead (``dones=1`` but
+        ``terminations=0``), the one-step target still bootstraps from
+        the truncated state's value — supplied via
+        ``truncation_values`` — removing the time-limit bias. When
+        omitted, ``dones`` is used (truncation treated as terminal,
+        the classic biased-but-simple convention).
+      truncation_values: optional ``[T, ...]`` ``V(final_obs_t)`` used
+        as the bootstrap at truncated steps (pre-auto-reset obs).
 
     Returns:
       ``(advantages, returns)`` each ``[T, ...]``; ``returns`` are the
@@ -44,9 +56,21 @@ def gae_advantages(
     """
     rewards = jnp.asarray(rewards)
     values = jnp.asarray(values)
+    last_value = jnp.asarray(last_value)
     dones = jnp.asarray(dones, dtype=rewards.dtype)
     values_tp1 = jnp.concatenate([values[1:], last_value[None]], axis=0)
-    deltas = rewards + gamma * (1.0 - dones) * values_tp1 - values
+    if terminations is None or truncation_values is None:
+        # Without V(final_obs) we cannot bootstrap a truncated step
+        # correctly, so truncation falls back to terminal treatment.
+        bootstrap_cut = dones
+    else:
+        terminations = jnp.asarray(terminations, dtype=rewards.dtype)
+        bootstrap_cut = terminations
+        truncated = dones * (1.0 - terminations)
+        values_tp1 = jnp.where(
+            truncated > 0.5, jnp.asarray(truncation_values), values_tp1
+        )
+    deltas = rewards + gamma * (1.0 - bootstrap_cut) * values_tp1 - values
 
     def _step(carry, inp):
         delta, done = inp

@@ -50,6 +50,8 @@ def vtrace(
     bootstrap across episode boundaries (1.0 where s_{t+1} is a reset).
     """
     rewards = jnp.asarray(rewards)
+    values = jnp.asarray(values)
+    bootstrap_value = jnp.asarray(bootstrap_value)
     dones = jnp.asarray(dones, dtype=rewards.dtype)
     log_rhos = target_log_probs - behaviour_log_probs
     rhos = jnp.exp(log_rhos)

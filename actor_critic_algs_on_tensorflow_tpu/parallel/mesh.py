@@ -1,0 +1,56 @@
+"""Device-mesh construction and axis conventions.
+
+Capability parity: the reference scales with synchronous data-parallel
+gradient averaging over NCCL via ``tf.distribute.MirroredStrategy``
+(BASELINE.json:5). The TPU-native analog is a 1-D ``jax.sharding.Mesh``
+over the ICI-connected chips with ``lax.pmean`` gradient averaging
+inside ``shard_map`` — XLA emits the all-reduce on ICI; no hand-written
+collectives (SURVEY.md §2.2).
+
+Axis names:
+  - ``data``: data-parallel axis (actors/envs sharded, params replicated).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def make_mesh(num_devices: int | None = None, axis_name: str = DATA_AXIS) -> Mesh:
+    """1-D data-parallel mesh over the first ``num_devices`` devices."""
+    devices = jax.devices()
+    if num_devices is None:
+        num_devices = len(devices)
+    if num_devices > len(devices):
+        raise ValueError(
+            f"requested {num_devices} devices, have {len(devices)}"
+        )
+    return Mesh(np.asarray(devices[:num_devices]), (axis_name,))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh, axis_name: str = DATA_AXIS) -> NamedSharding:
+    """Shard the leading (batch/env) axis across the mesh."""
+    return NamedSharding(mesh, P(axis_name))
+
+
+def shard_batch_specs(tree, axis_name: str = DATA_AXIS):
+    """PartitionSpec pytree: every leaf sharded on its leading axis."""
+    return jax.tree_util.tree_map(lambda _: P(axis_name), tree)
+
+
+def replicated_specs(tree):
+    return jax.tree_util.tree_map(lambda _: P(), tree)
+
+
+def device_count(mesh: Mesh | None) -> int:
+    return int(np.prod(mesh.devices.shape)) if mesh is not None else 1

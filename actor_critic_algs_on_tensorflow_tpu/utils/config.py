@@ -32,10 +32,28 @@ def _coerce(raw: str, current: Any) -> Any:
     if isinstance(current, tuple):
         if raw.strip() == "":
             return ()
-        elem = current[0] if current else 0
-        return tuple(type(elem)(p) for p in raw.split(","))
-    if current is None or isinstance(current, str):
+        parts = raw.split(",")
+        if current:
+            return tuple(type(current[0])(p) for p in parts)
+        try:
+            return tuple(int(p) for p in parts)
+        except ValueError:
+            return tuple(float(p) for p in parts)
+    if isinstance(current, str):
         return raw if raw.lower() != "none" else None
+    if current is None:
+        # No runtime type to coerce from: infer int -> float -> bool ->
+        # str from the raw text so Optional[int/float] fields work.
+        if raw.lower() == "none":
+            return None
+        for parse in (int, float):
+            try:
+                return parse(raw)
+            except ValueError:
+                pass
+        if raw.lower() in ("true", "false"):
+            return raw.lower() == "true"
+        return raw
     raise ValueError(f"unsupported config field type {type(current)}")
 
 

@@ -162,3 +162,25 @@ def test_vecenv_scan_rollout():
     state, (obs_seq, r_seq, d_seq) = run(state, jax.random.PRNGKey(7))
     assert obs_seq.shape == (32, 8, 4)
     assert float(r_seq.sum()) == 32 * 8  # reward 1 every step
+
+
+def test_autoreset_exposes_final_obs():
+    """AutoReset must surface the pre-reset observation so time-limit
+    bootstrapping can value the truncated state."""
+    env = AutoReset(CartPole())
+    params = CartPole().default_params()
+    state, obs = env.reset(jax.random.PRNGKey(0), params)
+    # push to termination quickly
+    for i in range(100):
+        state, obs, r, d, info = env.step(
+            jax.random.PRNGKey(i), state, jnp.int32(1), params
+        )
+        if float(d) == 1.0:
+            # returned obs is the NEW episode's obs; final_obs the old one
+            assert not np.allclose(np.asarray(obs), np.asarray(info["final_obs"]))
+            # terminal state: |x|>2.4 or |theta|>0.2095 in final_obs
+            fo = np.asarray(info["final_obs"])
+            assert abs(fo[0]) > 2.4 or abs(fo[2]) > 0.2095
+            break
+    else:
+        raise AssertionError("never terminated")

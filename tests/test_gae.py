@@ -93,3 +93,32 @@ def test_gae_jit_and_grad_safe():
     f = jax.jit(lambda r, v, d, lv: gae_advantages(r, v, d, lv)[0])
     out = f(jnp.ones((4, 2)), jnp.zeros((4, 2)), jnp.zeros((4, 2)), jnp.zeros(2))
     assert out.shape == (4, 2)
+
+
+def test_gae_time_limit_bootstrap():
+    """At a truncated step the target bootstraps from V(final_obs);
+    at a terminated step it does not."""
+    rewards = jnp.asarray([1.0, 1.0])
+    values = jnp.asarray([0.0, 0.0])
+    dones = jnp.asarray([1.0, 1.0])      # both steps end an episode
+    terms = jnp.asarray([0.0, 1.0])      # step0 truncated, step1 terminal
+    trunc_v = jnp.asarray([10.0, 99.0])  # 99 must be ignored (terminal)
+    adv, ret = gae_advantages(
+        rewards, values, dones, jnp.asarray(0.0),
+        gamma=0.5, lam=0.9, terminations=terms, truncation_values=trunc_v,
+    )
+    # step0: delta = 1 + 0.5*10 - 0 = 6; recursion cut by done -> adv=6
+    # step1: delta = 1 (terminal, no bootstrap)
+    np.testing.assert_allclose(np.asarray(adv), [6.0, 1.0], rtol=1e-6)
+
+    # without truncation_values, truncation treated as terminal
+    adv2, _ = gae_advantages(
+        rewards, values, dones, jnp.asarray(0.0),
+        gamma=0.5, lam=0.9, terminations=terms,
+    )
+    np.testing.assert_allclose(np.asarray(adv2), [1.0, 1.0], rtol=1e-6)
+
+
+def test_gae_accepts_python_scalars():
+    adv, ret = gae_advantages([1.0, 1.0], [0.5, 0.5], [0.0, 0.0], 0.25)
+    assert adv.shape == (2,)
