@@ -27,9 +27,7 @@ from actor_critic_algs_on_tensorflow_tpu.algos import common
 from actor_critic_algs_on_tensorflow_tpu.models import DiscreteActorCritic
 from actor_critic_algs_on_tensorflow_tpu.ops import (
     Categorical,
-    entropy_loss,
     gae_advantages,
-    normalize_advantages,
     policy_gradient_loss,
     value_loss,
 )
@@ -148,7 +146,7 @@ def make_a2c(cfg: A2CConfig) -> common.IterationFns:
             truncation_values=truncation_values,
         )
         if cfg.normalize_adv:
-            advantages = normalize_advantages(advantages)
+            advantages = common.global_normalize_advantages(advantages)
 
         def loss_fn(params):
             logits, values = model.apply(params, traj.obs)

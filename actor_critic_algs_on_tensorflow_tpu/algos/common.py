@@ -15,7 +15,6 @@ design removes; SURVEY.md §3.1 "hot loops").
 from __future__ import annotations
 
 import time
-from functools import partial
 from typing import Any, Callable, Dict, NamedTuple, Tuple
 
 import jax
@@ -27,7 +26,6 @@ from actor_critic_algs_on_tensorflow_tpu.data.rollout import Trajectory
 from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
     DATA_AXIS,
     device_count,
-    make_mesh,
 )
 
 # policy_fn(params, obs, key) -> (action, log_prob, value)
@@ -47,7 +45,7 @@ class OnPolicyState:
     env_state: Any
     obs: Any
     key: jax.Array
-    step: jax.Array  # global env-step counter (int64-safe float32? int32)
+    step: jax.Array  # iteration counter; env steps = step * steps_per_iteration
 
 
 def state_specs(state: OnPolicyState) -> OnPolicyState:
@@ -116,6 +114,24 @@ def collect_rollout(
     return env_state, obs, traj, ep_info
 
 
+def global_normalize_advantages(
+    adv: jax.Array, axis_name: str | None = DATA_AXIS, eps: float = 1e-8
+):
+    """Whiten advantages with GLOBAL (cross-device) statistics.
+
+    Inside ``shard_map`` a per-shard mean/std would make gradients
+    device-count-dependent; pmean-ing the moments keeps data-parallel
+    runs equivalent to single-device large-batch runs.
+    """
+    mean = jnp.mean(adv)
+    if axis_name is not None:
+        mean = jax.lax.pmean(mean, axis_name)
+    var = jnp.mean((adv - mean) ** 2)
+    if axis_name is not None:
+        var = jax.lax.pmean(var, axis_name)
+    return (adv - mean) * jax.lax.rsqrt(var + eps)
+
+
 def episode_metrics(ep_info, axis_name: str | None = DATA_AXIS):
     """Mean return/length over episodes finished in this rollout.
 
@@ -151,8 +167,9 @@ def evaluate(
 
     def _step(carry, k):
         env_state, obs, done_seen, ep_ret = carry
-        actions = act_fn(obs, k)
-        env_state, obs, _, done, info = env.step(k, env_state, actions, env_params)
+        k_act, k_env = jax.random.split(k)
+        actions = act_fn(obs, k_act)
+        env_state, obs, _, done, info = env.step(k_env, env_state, actions, env_params)
         ep_ret = jnp.where(
             done_seen > 0.5,
             ep_ret,
@@ -237,10 +254,13 @@ def run_loop(
     serialize = (
         jax.default_backend() == "cpu" and device_count(fns.mesh) > 1
     )
-    num_iters = max(1, total_env_steps // fns.steps_per_iteration)
+    # ``state.step`` counts ITERATIONS; total_env_steps is a global
+    # budget, so a resumed state trains only the remainder.
+    iters_done0 = int(state.step)
+    steps_done0 = iters_done0 * fns.steps_per_iteration
+    num_iters = max(1, (total_env_steps - steps_done0) // fns.steps_per_iteration)
     history = []
     t0 = time.perf_counter()
-    steps_done0 = int(state.step)
     last_metrics = None
     for it in range(num_iters):
         state, metrics = fns.iteration(state)
@@ -262,6 +282,8 @@ def run_loop(
             and checkpoint_interval_iters
             and (it + 1) % checkpoint_interval_iters == 0
         ):
-            checkpointer.save(int(state.step), state)
+            checkpointer.save(
+                steps_done0 + (it + 1) * fns.steps_per_iteration, state
+            )
     jax.block_until_ready(last_metrics)
     return state, history

@@ -1,0 +1,269 @@
+"""PPO: clipped-surrogate proximal policy optimization.
+
+Capability parity: the reference's PPO baseline — vectorized envs,
+Nature-CNN encoder on Atari, minibatched multi-epoch updates, and the
+headline env-steps/sec/chip workload (BASELINE.json:5,8,2; SURVEY.md
+§2.1 "PPO trainer", §3.1 call stack). Discrete (Categorical) and
+continuous (diagonal Gaussian) action spaces are both supported, per
+the reference's Atari + MuJoCo coverage (BASELINE.json:8-9).
+
+TPU-first design: one iteration — rollout ``lax.scan``, GAE, then the
+FULL epoch x minibatch update loop — is a single jitted ``shard_map``
+program over the ``data`` mesh axis. Minibatches are drawn from the
+device-local shard (standard data-parallel PPO) and gradients are
+``lax.pmean``-averaged over ICI every minibatch, so the schedule is
+equivalent to large-batch PPO with num_envs spread over devices.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from actor_critic_algs_on_tensorflow_tpu import envs as envs_lib
+from actor_critic_algs_on_tensorflow_tpu.algos import common
+from actor_critic_algs_on_tensorflow_tpu.data.rollout import (
+    flatten_time_batch,
+    minibatch_iter_indices,
+    take_minibatch,
+)
+from actor_critic_algs_on_tensorflow_tpu.models import (
+    DiscreteActorCritic,
+    GaussianActorCritic,
+)
+from actor_critic_algs_on_tensorflow_tpu.ops import (
+    Categorical,
+    DiagGaussian,
+    clipped_value_loss,
+    gae_advantages,
+    ppo_clip_loss,
+    value_loss,
+)
+from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
+    DATA_AXIS,
+    device_count,
+    make_mesh,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class PPOConfig:
+    env: str = "CartPole-v1"
+    num_envs: int = 8               # global, across all devices
+    rollout_length: int = 128
+    total_env_steps: int = 500_000
+    frame_stack: int = 0
+    torso: str = "mlp"              # "mlp" | "nature_cnn"
+    hidden_sizes: Tuple[int, ...] = (64, 64)
+    lr: float = 2.5e-4
+    lr_decay: bool = True
+    gamma: float = 0.99
+    gae_lambda: float = 0.95
+    clip_eps: float = 0.2
+    vf_clip: bool = True
+    vf_coef: float = 0.5
+    ent_coef: float = 0.01
+    max_grad_norm: float = 0.5
+    num_epochs: int = 4
+    num_minibatches: int = 4
+    normalize_adv: bool = True
+    time_limit_bootstrap: bool = True
+    seed: int = 0
+    num_devices: int = 0            # 0 = all visible devices
+
+
+def make_ppo(cfg: PPOConfig) -> common.IterationFns:
+    """Build jitted ``init`` and fused ``iteration`` for PPO."""
+    mesh = make_mesh(cfg.num_devices or None)
+    n_dev = device_count(mesh)
+    if cfg.num_envs % n_dev:
+        raise ValueError(
+            f"num_envs={cfg.num_envs} not divisible by {n_dev} devices"
+        )
+    local_envs = cfg.num_envs // n_dev
+    local_batch = local_envs * cfg.rollout_length
+    if local_batch % cfg.num_minibatches:
+        raise ValueError(
+            f"local batch {local_batch} not divisible by "
+            f"{cfg.num_minibatches} minibatches"
+        )
+    env, env_params = envs_lib.make(
+        cfg.env, num_envs=local_envs, frame_stack=cfg.frame_stack
+    )
+    genv, _ = envs_lib.make(
+        cfg.env, num_envs=cfg.num_envs, frame_stack=cfg.frame_stack
+    )
+    action_space = env.action_space(env_params)
+    discrete = hasattr(action_space, "n")
+    if discrete:
+        model = DiscreteActorCritic(
+            num_actions=action_space.n,
+            torso=cfg.torso,
+            hidden_sizes=cfg.hidden_sizes,
+        )
+    else:
+        model = GaussianActorCritic(
+            action_dim=action_space.shape[-1],
+            hidden_sizes=cfg.hidden_sizes,
+        )
+
+    def dist_and_value(params, obs):
+        if discrete:
+            logits, value = model.apply(params, obs)
+            return Categorical(logits), value
+        mean, log_std, value = model.apply(params, obs)
+        return DiagGaussian(mean, log_std), value
+
+    num_iters = max(1, cfg.total_env_steps // (cfg.num_envs * cfg.rollout_length))
+    if cfg.lr_decay:
+        schedule = optax.linear_schedule(
+            cfg.lr, 0.0, num_iters * cfg.num_epochs * cfg.num_minibatches
+        )
+    else:
+        schedule = cfg.lr
+    tx = optax.chain(
+        optax.clip_by_global_norm(cfg.max_grad_norm),
+        optax.adam(schedule, eps=1e-5),
+    )
+
+    def policy_fn(params, obs, key):
+        dist, value = dist_and_value(params, obs)
+        action = dist.sample(key)
+        return action, dist.log_prob(action), value
+
+    def init(key: jax.Array) -> common.OnPolicyState:
+        k_env, k_model = jax.random.split(key)
+        env_state, obs = genv.reset(k_env, env_params)
+        params = model.init(k_model, obs[:1])
+        state = common.OnPolicyState(
+            params=params,
+            opt_state=tx.init(params),
+            env_state=env_state,
+            obs=obs,
+            key=key,
+            step=jnp.zeros((), jnp.int32),
+        )
+        shardings = jax.tree_util.tree_map(
+            lambda spec: NamedSharding(mesh, spec),
+            common.state_specs(state),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        return jax.device_put(state, shardings)
+
+    def local_iteration(state: common.OnPolicyState):
+        dev = jax.lax.axis_index(DATA_AXIS)
+        it_key = jax.random.fold_in(jax.random.fold_in(state.key, state.step), dev)
+        k_roll, k_perm = jax.random.split(it_key)
+
+        env_state, obs, traj, ep_info = common.collect_rollout(
+            env, env_params, policy_fn,
+            state.params, state.env_state, state.obs, k_roll,
+            cfg.rollout_length,
+            keep_final_obs=cfg.time_limit_bootstrap,
+        )
+        _, last_value = dist_and_value(state.params, obs)
+        if cfg.time_limit_bootstrap:
+            _, truncation_values = dist_and_value(
+                state.params, ep_info["final_obs"]
+            )
+        else:
+            truncation_values = None
+        advantages, returns = gae_advantages(
+            traj.rewards, traj.values, traj.dones, last_value,
+            gamma=cfg.gamma, lam=cfg.gae_lambda,
+            terminations=ep_info["terminated"],
+            truncation_values=truncation_values,
+        )
+
+        batch = flatten_time_batch(
+            {
+                "obs": traj.obs,
+                "actions": traj.actions,
+                "old_log_probs": traj.log_probs,
+                "old_values": traj.values,
+                "advantages": advantages,
+                "returns": returns,
+            }
+        )
+
+        def minibatch_step(carry, idx):
+            params, opt_state = carry
+            mb = take_minibatch(batch, idx)
+            adv = mb["advantages"]
+            if cfg.normalize_adv:
+                adv = common.global_normalize_advantages(adv)
+
+            def loss_fn(p):
+                dist, values = dist_and_value(p, mb["obs"])
+                stats = ppo_clip_loss(
+                    dist.log_prob(mb["actions"]),
+                    mb["old_log_probs"],
+                    adv,
+                    clip_eps=cfg.clip_eps,
+                )
+                if cfg.vf_clip:
+                    vf = clipped_value_loss(
+                        values, mb["old_values"], mb["returns"],
+                        clip_eps=cfg.clip_eps,
+                    )
+                else:
+                    vf = value_loss(values, mb["returns"])
+                ent = dist.entropy().mean()
+                total = stats.policy_loss + cfg.vf_coef * vf - cfg.ent_coef * ent
+                return total, (stats, vf, ent)
+
+            (loss, (stats, vf, ent)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            grads = jax.lax.pmean(grads, DATA_AXIS)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            m = {
+                "loss": loss,
+                "policy_loss": stats.policy_loss,
+                "value_loss": vf,
+                "entropy": ent,
+                "clip_fraction": stats.clip_fraction,
+                "approx_kl": stats.approx_kl,
+            }
+            return (params, opt_state), m
+
+        def epoch_step(carry, k):
+            idx = minibatch_iter_indices(k, local_batch, cfg.num_minibatches)
+            return jax.lax.scan(minibatch_step, carry, idx)
+
+        epoch_keys = jax.random.split(k_perm, cfg.num_epochs)
+        (params, opt_state), m = jax.lax.scan(
+            epoch_step, (state.params, state.opt_state), epoch_keys
+        )
+        # Mean over [num_epochs, num_minibatches]; pmean so replicated.
+        metrics = jax.lax.pmean(
+            jax.tree_util.tree_map(jnp.mean, m), DATA_AXIS
+        )
+        metrics.update(common.episode_metrics(ep_info))
+
+        new_state = common.OnPolicyState(
+            params=params,
+            opt_state=opt_state,
+            env_state=env_state,
+            obs=obs,
+            key=state.key,
+            step=state.step + 1,
+        )
+        return new_state, metrics
+
+    example = jax.eval_shape(init, jax.random.PRNGKey(0))
+    iteration = common.build_data_parallel_iteration(
+        local_iteration, example, mesh
+    )
+    return common.IterationFns(
+        init=init,
+        iteration=iteration,
+        mesh=mesh,
+        steps_per_iteration=cfg.num_envs * cfg.rollout_length,
+    )

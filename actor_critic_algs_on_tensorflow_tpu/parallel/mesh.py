@@ -13,8 +13,6 @@ Axis names:
 
 from __future__ import annotations
 
-from typing import Sequence
-
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
