@@ -20,7 +20,6 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 from actor_critic_algs_on_tensorflow_tpu import envs as envs_lib
 from actor_critic_algs_on_tensorflow_tpu.algos import common
@@ -35,6 +34,7 @@ from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
     DATA_AXIS,
     device_count,
     make_mesh,
+    put_by_specs,
 )
 
 
@@ -115,12 +115,7 @@ def make_a2c(cfg: A2CConfig) -> common.IterationFns:
             key=key,
             step=jnp.zeros((), jnp.int32),
         )
-        shardings = jax.tree_util.tree_map(
-            lambda spec: NamedSharding(mesh, spec),
-            common.state_specs(state),
-            is_leaf=lambda x: isinstance(x, P),
-        )
-        return jax.device_put(state, shardings)
+        return put_by_specs(state, common.state_specs(state), mesh)
 
     def local_iteration(state: common.OnPolicyState):
         dev = jax.lax.axis_index(DATA_AXIS)

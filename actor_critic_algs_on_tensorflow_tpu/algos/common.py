@@ -26,6 +26,9 @@ from actor_critic_algs_on_tensorflow_tpu.data.rollout import Trajectory
 from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
     DATA_AXIS,
     device_count,
+    put_by_specs,
+    replicated_specs,
+    shard_batch_specs,
 )
 
 # policy_fn(params, obs, key) -> (action, log_prob, value)
@@ -50,16 +53,33 @@ class OnPolicyState:
 
 def state_specs(state: OnPolicyState) -> OnPolicyState:
     """PartitionSpec pytree matching ``OnPolicyState``."""
-    repl = lambda t: jax.tree_util.tree_map(lambda _: P(), t)
-    shard = lambda t: jax.tree_util.tree_map(lambda _: P(DATA_AXIS), t)
     return OnPolicyState(
-        params=repl(state.params),
-        opt_state=repl(state.opt_state),
-        env_state=shard(state.env_state),
-        obs=shard(state.obs),
+        params=replicated_specs(state.params),
+        opt_state=replicated_specs(state.opt_state),
+        env_state=shard_batch_specs(state.env_state),
+        obs=shard_batch_specs(state.obs),
         key=P(),
         step=P(),
     )
+
+
+def put_state(state, specs, mesh: Mesh):
+    """Place a host-built train state onto the mesh per its specs."""
+    return put_by_specs(state, specs, mesh)
+
+
+def build_shard_map_iteration(
+    local_iteration: Callable, specs, mesh: Mesh, *, donate: bool = True
+) -> Callable:
+    """shard_map + jit a ``state -> (state, metrics)`` iteration."""
+    mapped = jax.shard_map(
+        local_iteration,
+        mesh=mesh,
+        in_specs=(specs,),
+        out_specs=(specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
 
 def collect_rollout(
@@ -213,15 +233,9 @@ def build_data_parallel_iteration(
     that crosses devices (grads, metrics). Donation of the input state
     makes HBM buffers reusable across iterations.
     """
-    specs = state_specs(example_state)
-    mapped = jax.shard_map(
-        local_iteration,
-        mesh=mesh,
-        in_specs=(specs,),
-        out_specs=(specs, P()),
-        check_vma=False,
+    return build_shard_map_iteration(
+        local_iteration, state_specs(example_state), mesh
     )
-    return jax.jit(mapped, donate_argnums=(0,))
 
 
 def run_loop(
@@ -255,10 +269,15 @@ def run_loop(
         jax.default_backend() == "cpu" and device_count(fns.mesh) > 1
     )
     # ``state.step`` counts ITERATIONS; total_env_steps is a global
-    # budget, so a resumed state trains only the remainder.
+    # budget, so a resumed state trains only the remainder — possibly
+    # nothing. A fresh run always trains at least one iteration.
     iters_done0 = int(state.step)
     steps_done0 = iters_done0 * fns.steps_per_iteration
-    num_iters = max(1, (total_env_steps - steps_done0) // fns.steps_per_iteration)
+    num_iters = (total_env_steps - steps_done0) // fns.steps_per_iteration
+    if iters_done0 == 0:
+        num_iters = max(1, num_iters)
+    if num_iters <= 0:
+        return state, []
     history = []
     t0 = time.perf_counter()
     last_metrics = None

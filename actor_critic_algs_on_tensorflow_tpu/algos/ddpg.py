@@ -1,0 +1,273 @@
+"""DDPG: deep deterministic policy gradient for continuous control.
+
+Capability parity: the reference's DDPG baseline — deterministic
+tanh-bounded actor, Q critic, Ornstein-Uhlenbeck exploration noise,
+uniform replay, and polyak-averaged target networks on MuJoCo
+HalfCheetah-class tasks (BASELINE.json:9; SURVEY.md §2.1 "DDPG
+trainer", §3.2 call stack).
+
+TPU-first design: one iteration fuses ``steps_per_iter`` vectorized env
+steps (acting with OU noise, scattering transitions into the per-device
+HBM replay ring) and ``updates_per_iter`` sampled critic/actor updates
+with ``lax.pmean`` gradient averaging into a single jitted
+``shard_map`` program over the ``data`` mesh axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from actor_critic_algs_on_tensorflow_tpu import envs as envs_lib
+from actor_critic_algs_on_tensorflow_tpu.algos import offpolicy
+from actor_critic_algs_on_tensorflow_tpu.algos.common import episode_metrics
+from actor_critic_algs_on_tensorflow_tpu.data.replay import ReplayBuffer
+from actor_critic_algs_on_tensorflow_tpu.models import (
+    DeterministicActor,
+    QCritic,
+)
+from actor_critic_algs_on_tensorflow_tpu.ops import (
+    ou_init,
+    ou_reset_where,
+    ou_step,
+    polyak_update,
+)
+from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
+    DATA_AXIS,
+    device_count,
+    make_mesh,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class DDPGConfig:
+    env: str = "Pendulum-v1"
+    num_envs: int = 16              # global, across all devices
+    steps_per_iter: int = 8         # env steps per env per iteration
+    updates_per_iter: int = 8       # gradient updates per iteration
+    total_env_steps: int = 200_000
+    replay_capacity: int = 100_000  # per device
+    batch_size: int = 256           # per device
+    warmup_env_steps: int = 1_000   # uniform-random acting, global steps
+    hidden_sizes: Tuple[int, ...] = (256, 256)
+    actor_lr: float = 1e-3
+    critic_lr: float = 1e-3
+    gamma: float = 0.99
+    tau: float = 0.005
+    ou_theta: float = 0.15
+    ou_sigma: float = 0.2
+    ou_dt: float = 1e-2
+    max_grad_norm: float = 0.0      # 0 = no clipping (DDPG default)
+    seed: int = 0
+    num_devices: int = 0
+
+
+@struct.dataclass
+class DDPGParams:
+    actor: any
+    critic: any
+    target_actor: any
+    target_critic: any
+
+
+def make_ddpg(cfg: DDPGConfig) -> offpolicy.OffPolicyFns:
+    """Build jitted ``init`` and fused ``iteration`` for DDPG."""
+    mesh = make_mesh(cfg.num_devices or None)
+    n_dev = device_count(mesh)
+    if cfg.num_envs % n_dev:
+        raise ValueError(
+            f"num_envs={cfg.num_envs} not divisible by {n_dev} devices"
+        )
+    local_envs = cfg.num_envs // n_dev
+    env, env_params = envs_lib.make(cfg.env, num_envs=local_envs)
+    genv, _ = envs_lib.make(cfg.env, num_envs=cfg.num_envs)
+    aspace = env.action_space(env_params)
+    action_dim = aspace.shape[-1] if aspace.shape else 1
+    action_scale = float(aspace.high)
+
+    actor = DeterministicActor(action_dim, cfg.hidden_sizes)
+    critic = QCritic(cfg.hidden_sizes)
+
+    def _tx(lr):
+        if cfg.max_grad_norm:
+            return optax.chain(
+                optax.clip_by_global_norm(cfg.max_grad_norm), optax.adam(lr)
+            )
+        return optax.adam(lr)
+
+    actor_tx, critic_tx = _tx(cfg.actor_lr), _tx(cfg.critic_lr)
+    buf = ReplayBuffer(cfg.replay_capacity)
+
+    steps_per_iteration = cfg.num_envs * cfg.steps_per_iter
+    warmup_iters = cfg.warmup_env_steps // max(steps_per_iteration, 1)
+
+    def act_fn(params, obs, noise, key, step):
+        """Tanh actor + OU noise; uniform-random during warmup."""
+        k_ou, k_rand = jax.random.split(key)
+        a = actor.apply(params.actor, obs)
+        noise, eps = ou_step(
+            noise, k_ou, theta=cfg.ou_theta, sigma=cfg.ou_sigma, dt=cfg.ou_dt
+        )
+        a = jnp.clip(a + eps, -1.0, 1.0)
+        rand = jax.random.uniform(k_rand, a.shape, a.dtype, -1.0, 1.0)
+        a = jnp.where(step < warmup_iters, rand, a)
+        return a * action_scale, noise
+
+    def init(key: jax.Array) -> offpolicy.OffPolicyState:
+        k_env, k_actor, k_critic, k_state = jax.random.split(key, 4)
+        env_state, obs = genv.reset(k_env, env_params)
+        a0 = jnp.zeros((1, action_dim))
+        actor_params = actor.init(k_actor, obs[:1])
+        critic_params = critic.init(k_critic, obs[:1], a0)
+        # Targets are COPIES: with donated state, aliasing online and
+        # target leaves would donate the same buffer twice.
+        copy = lambda t: jax.tree_util.tree_map(jnp.copy, t)
+        params = DDPGParams(
+            actor=actor_params,
+            critic=critic_params,
+            target_actor=copy(actor_params),
+            target_critic=copy(critic_params),
+        )
+        # Per-device replay shards: [n_dev, capacity, ...] leaves so the
+        # data axis shards row 0 and each device sees its own ring.
+        example = offpolicy.Transition(
+            obs=obs[0],
+            action=jnp.zeros((action_dim,)) ,
+            reward=jnp.zeros(()),
+            next_obs=obs[0],
+            terminated=jnp.zeros(()),
+        )
+        replay = jax.vmap(lambda _: buf.init(example))(jnp.arange(n_dev))
+        state = offpolicy.OffPolicyState(
+            params=params,
+            opt_state={
+                "actor": actor_tx.init(actor_params),
+                "critic": critic_tx.init(critic_params),
+            },
+            env_state=env_state,
+            obs=obs,
+            noise=ou_init((cfg.num_envs, action_dim)),
+            replay=replay,
+            key=k_state,
+            step=jnp.zeros((), jnp.int32),
+        )
+        return offpolicy.put_sharded(state, mesh)
+
+    def local_iteration(state: offpolicy.OffPolicyState):
+        dev = jax.lax.axis_index(DATA_AXIS)
+        it_key = jax.random.fold_in(jax.random.fold_in(state.key, state.step), dev)
+        k_roll, k_upd = jax.random.split(it_key)
+        # Inside shard_map the replay shard still has its [1] device row.
+        replay = jax.tree_util.tree_map(lambda x: x[0], state.replay)
+
+        env_state, obs, noise, replay, ep_info = offpolicy.act_then_store(
+            env, env_params, buf, act_fn,
+            state.params,
+            (state.env_state, state.obs, state.noise, replay),
+            k_roll, cfg.steps_per_iter, state.step,
+            noise_reset_fn=ou_reset_where,
+        )
+
+        def one_update(carry, key):
+            params, opt_state = carry
+            batch = buf.sample(replay, key, cfg.batch_size)
+
+            def critic_loss_fn(cp):
+                a_next = actor.apply(params.target_actor, batch.next_obs)
+                q_next = critic.apply(
+                    params.target_critic, batch.next_obs, a_next * action_scale
+                )
+                y = batch.reward + cfg.gamma * (1.0 - batch.terminated) * q_next
+                q = critic.apply(cp, batch.obs, batch.action)
+                return jnp.mean((q - jax.lax.stop_gradient(y)) ** 2), q
+
+            (q_loss, q), q_grads = jax.value_and_grad(
+                critic_loss_fn, has_aux=True
+            )(params.critic)
+
+            def actor_loss_fn(ap):
+                a = actor.apply(ap, batch.obs)
+                return -jnp.mean(
+                    critic.apply(params.critic, batch.obs, a * action_scale)
+                )
+
+            a_loss, a_grads = jax.value_and_grad(actor_loss_fn)(params.actor)
+
+            q_grads = jax.lax.pmean(q_grads, DATA_AXIS)
+            a_grads = jax.lax.pmean(a_grads, DATA_AXIS)
+            q_up, c_opt = critic_tx.update(
+                q_grads, opt_state["critic"], params.critic
+            )
+            a_up, a_opt = actor_tx.update(
+                a_grads, opt_state["actor"], params.actor
+            )
+            new_params = DDPGParams(
+                actor=optax.apply_updates(params.actor, a_up),
+                critic=optax.apply_updates(params.critic, q_up),
+                target_actor=polyak_update(
+                    params.target_actor, params.actor, cfg.tau
+                ),
+                target_critic=polyak_update(
+                    params.target_critic, params.critic, cfg.tau
+                ),
+            )
+            m = {"q_loss": q_loss, "actor_loss": a_loss, "q_mean": jnp.mean(q)}
+            return (new_params, {"actor": a_opt, "critic": c_opt}), m
+
+        def run_updates(carry):
+            return jax.lax.scan(
+                one_update, carry, jax.random.split(k_upd, cfg.updates_per_iter)
+            )
+
+        def skip_updates(carry):
+            zeros = {
+                "q_loss": jnp.zeros((cfg.updates_per_iter,)),
+                "actor_loss": jnp.zeros((cfg.updates_per_iter,)),
+                "q_mean": jnp.zeros((cfg.updates_per_iter,)),
+            }
+            return carry, zeros
+
+        # No updates until past warmup AND the buffer can fill a batch.
+        ready = jnp.logical_and(
+            state.step >= warmup_iters, replay.size >= cfg.batch_size
+        )
+        (params, opt_state), m = jax.lax.cond(
+            ready, run_updates, skip_updates,
+            (state.params, state.opt_state),
+        )
+
+        metrics = jax.lax.pmean(
+            jax.tree_util.tree_map(jnp.mean, m), DATA_AXIS
+        )
+        metrics.update(episode_metrics(ep_info))
+        metrics["replay_size"] = jax.lax.pmean(
+            replay.size.astype(jnp.float32), DATA_AXIS
+        )
+
+        new_state = offpolicy.OffPolicyState(
+            params=params,
+            opt_state=opt_state,
+            env_state=env_state,
+            obs=obs,
+            noise=noise,
+            replay=jax.tree_util.tree_map(lambda x: x[None], replay),
+            key=state.key,
+            step=state.step + 1,
+        )
+        return new_state, metrics
+
+    example = jax.eval_shape(init, jax.random.PRNGKey(0))
+    iteration = offpolicy.build_off_policy_iteration(
+        local_iteration, example, mesh
+    )
+    return offpolicy.OffPolicyFns(
+        init=init,
+        iteration=iteration,
+        mesh=mesh,
+        steps_per_iteration=steps_per_iteration,
+    )

@@ -1,0 +1,440 @@
+"""IMPALA / distributed A3C: async actors + V-trace learner.
+
+Capability parity: the reference's distributed mode — asynchronous
+actors generating trajectories with stale ("behaviour") policies, a
+central learner applying V-trace off-policy correction, and weight
+publication back to the actors (BASELINE.json:11; SURVEY.md §2.1
+"IMPALA / distributed A3C", §3.3 call stack). Its scaling study is
+8 -> 256 actors (BASELINE.json:2).
+
+TPU-first design:
+  - Each ACTOR is a host thread owning a jitted rollout program over
+    vectorized pure-JAX envs (or a host-env bridge) and a snapshot of
+    the newest published params; it pushes device-resident trajectory
+    pytrees (with behaviour log-probs) into a bounded
+    ``TrajectoryQueue``. Threads suffice on one host because rollout
+    compute runs on-device; on a pod, the same actor object runs on
+    actor hosts and the queue rides DCN (SURVEY.md §3.3 boundary).
+  - The LEARNER is one jitted ``shard_map`` program over the ``data``
+    mesh axis: stacked trajectory batches are sharded on the batch
+    axis, V-trace targets computed as a ``lax.scan``, and gradients
+    ``lax.pmean``-averaged over ICI.
+  - Weight publication is a lock-free reference swap: params are
+    immutable device arrays, so actors snapshot the latest reference
+    at rollout start — no copies, no torn reads (the analog of the
+    reference's parameter-server weight pull).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue as queue_lib
+import threading
+import time
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from actor_critic_algs_on_tensorflow_tpu import envs as envs_lib
+from actor_critic_algs_on_tensorflow_tpu.algos import common
+from actor_critic_algs_on_tensorflow_tpu.distributed.queue import (
+    TrajectoryQueue,
+)
+from actor_critic_algs_on_tensorflow_tpu.models import DiscreteActorCritic
+from actor_critic_algs_on_tensorflow_tpu.ops import (
+    Categorical,
+    entropy_loss,
+    value_loss,
+    vtrace,
+)
+from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
+    DATA_AXIS,
+    device_count,
+    make_mesh,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ImpalaConfig:
+    env: str = "CartPole-v1"
+    num_actors: int = 4
+    envs_per_actor: int = 8
+    rollout_length: int = 32
+    # trajectories per learner batch (global, across devices)
+    batch_trajectories: int = 8
+    total_env_steps: int = 500_000
+    frame_stack: int = 0
+    torso: str = "mlp"
+    hidden_sizes: Tuple[int, ...] = (64, 64)
+    lr: float = 6e-4
+    lr_decay: bool = True
+    gamma: float = 0.99
+    vtrace_lam: float = 1.0
+    rho_bar: float = 1.0
+    c_bar: float = 1.0
+    vf_coef: float = 0.5
+    ent_coef: float = 0.01
+    max_grad_norm: float = 40.0
+    queue_size: int = 16
+    publish_interval: int = 1       # learner steps between publications
+    seed: int = 0
+    num_devices: int = 0
+
+
+class ActorTrajectory(struct.PyTreeNode):
+    """What an actor ships to the learner: time-major ``[T, B_env]``
+    fields plus the bootstrap observation after the last step."""
+
+    obs: Any
+    actions: jax.Array
+    rewards: jax.Array
+    dones: jax.Array
+    behaviour_log_probs: jax.Array
+    last_obs: Any
+
+
+@struct.dataclass
+class LearnerState:
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+
+class ParamStore:
+    """Latest published params; reference swap is atomic under the GIL,
+    and params pytrees are immutable device arrays."""
+
+    def __init__(self, params):
+        self._params = params
+        self.version = 0
+
+    def publish(self, params) -> None:
+        self._params = params
+        self.version += 1
+
+    def snapshot(self):
+        return self._params
+
+
+class ImpalaActor(threading.Thread):
+    """One async actor: rollout with the newest snapshot, enqueue."""
+
+    def __init__(
+        self,
+        actor_id: int,
+        rollout_fn,
+        env_reset_fn,
+        store: ParamStore,
+        out_queue: TrajectoryQueue,
+        halt: threading.Event,
+        seed: int,
+    ):
+        super().__init__(name=f"impala-actor-{actor_id}", daemon=True)
+        self.actor_id = actor_id
+        self._rollout = rollout_fn
+        self._reset = env_reset_fn
+        self._store = store
+        self._queue = out_queue
+        # NB: name must not shadow threading.Thread._stop
+        self._halt = halt
+        self._key = jax.random.PRNGKey(seed)
+        self.rollouts = 0
+        self.error: BaseException | None = None
+
+    def run(self) -> None:
+        try:
+            self._key, k = jax.random.split(self._key)
+            env_state, obs = self._reset(k)
+            while not self._halt.is_set():
+                params = self._store.snapshot()
+                self._key, k = jax.random.split(self._key)
+                env_state, obs, traj, ep = self._rollout(
+                    params, env_state, obs, k
+                )
+                while not self._halt.is_set():
+                    try:
+                        self._queue.put((traj, ep), timeout=0.5)
+                        self.rollouts += 1
+                        break
+                    except queue_lib.Full:  # retry until stop
+                        continue
+        except BaseException as e:  # surfaced by run_impala
+            self.error = e
+
+
+def make_impala(cfg: ImpalaConfig):
+    """Build (learner_init, learner_step, make_actor_programs, mesh).
+
+    ``learner_step(state, batch) -> (state, metrics)`` is the jitted
+    shard_map program; ``make_actor_programs(actor_id)`` returns that
+    actor's jitted ``(rollout, reset)`` pair.
+    """
+    mesh = make_mesh(cfg.num_devices or None)
+    n_dev = device_count(mesh)
+    # The learner shards the stacked env axis B = trajectories * envs.
+    if (cfg.batch_trajectories * cfg.envs_per_actor) % n_dev:
+        raise ValueError(
+            f"batch_trajectories*envs_per_actor="
+            f"{cfg.batch_trajectories * cfg.envs_per_actor} not divisible "
+            f"by {n_dev} devices"
+        )
+    env, env_params = envs_lib.make(
+        cfg.env, num_envs=cfg.envs_per_actor, frame_stack=cfg.frame_stack
+    )
+    action_space = env.action_space(env_params)
+    model = DiscreteActorCritic(
+        num_actions=action_space.n,
+        torso=cfg.torso,
+        hidden_sizes=cfg.hidden_sizes,
+    )
+
+    steps_per_batch = (
+        cfg.batch_trajectories * cfg.envs_per_actor * cfg.rollout_length
+    )
+    num_learner_steps = max(1, cfg.total_env_steps // steps_per_batch)
+    if cfg.lr_decay:
+        schedule = optax.linear_schedule(cfg.lr, 0.0, num_learner_steps)
+    else:
+        schedule = cfg.lr
+    tx = optax.chain(
+        optax.clip_by_global_norm(cfg.max_grad_norm),
+        optax.adam(schedule, eps=1e-5),
+    )
+
+    # ---- actor program ------------------------------------------------
+
+    def policy_fn(params, obs, key):
+        logits, value = model.apply(params, obs)
+        dist = Categorical(logits)
+        action = dist.sample(key)
+        return action, dist.log_prob(action), value
+
+    def make_actor_programs(actor_id: int):
+        """Jitted (rollout, reset) for ONE actor.
+
+        Pure-JAX envs are stateless objects, so all actors share one;
+        host (``gym:``) envs hold a live simulator, so each actor gets
+        a private ``fresh`` pool — interleaved io_callbacks from many
+        threads on one pool would mix episodes across actors.
+        """
+        if cfg.env.startswith("gym:"):
+            aenv, aparams = envs_lib.make(
+                cfg.env, num_envs=cfg.envs_per_actor, fresh=True
+            )
+        else:
+            aenv, aparams = env, env_params
+
+        def actor_rollout(params, env_state, obs, key):
+            env_state, obs, traj, ep_info = common.collect_rollout(
+                aenv, aparams, policy_fn,
+                params, env_state, obs, key, cfg.rollout_length,
+            )
+            out = ActorTrajectory(
+                obs=traj.obs,
+                actions=traj.actions,
+                rewards=traj.rewards,
+                dones=traj.dones,
+                behaviour_log_probs=traj.log_probs,
+                last_obs=obs,
+            )
+            ep = {
+                "episode_return": ep_info["episode_return"],
+                "done_episode": ep_info["done_episode"],
+            }
+            return env_state, obs, out, ep
+
+        def env_reset(key):
+            return aenv.reset(key, aparams)
+
+        return jax.jit(actor_rollout), env_reset
+
+    # ---- learner program ----------------------------------------------
+
+    def init(key: jax.Array) -> LearnerState:
+        _, obs = env.reset(key, env_params)
+        params = model.init(key, obs[:1])
+        state = LearnerState(
+            params=params,
+            opt_state=tx.init(params),
+            step=jnp.zeros((), jnp.int32),
+        )
+        return jax.device_put(state, NamedSharding(mesh, P()))
+
+    def local_learner_step(state: LearnerState, batch: ActorTrajectory):
+        """Batch fields are ``[T, B_local, ...]`` (B sharded on data)."""
+
+        def loss_fn(params):
+            logits, values = model.apply(params, batch.obs)
+            _, last_value = model.apply(params, batch.last_obs)
+            dist = Categorical(logits)
+            target_log_probs = dist.log_prob(batch.actions)
+            vt = vtrace(
+                batch.behaviour_log_probs,
+                jax.lax.stop_gradient(target_log_probs),
+                batch.rewards,
+                jax.lax.stop_gradient(values),
+                batch.dones,
+                jax.lax.stop_gradient(last_value),
+                gamma=cfg.gamma,
+                lam=cfg.vtrace_lam,
+                rho_bar=cfg.rho_bar,
+                c_bar=cfg.c_bar,
+            )
+            pg = -jnp.mean(
+                target_log_probs * jax.lax.stop_gradient(vt.pg_advantages)
+            )
+            vf = value_loss(values, jax.lax.stop_gradient(vt.vs))
+            ent = dist.entropy().mean()
+            total = pg + cfg.vf_coef * vf + cfg.ent_coef * entropy_loss(ent)
+            aux = (pg, vf, ent, jnp.mean(vt.rhos))
+            return total, aux
+
+        (loss, (pg, vf, ent, rho)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        grads = jax.lax.pmean(grads, DATA_AXIS)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics = jax.lax.pmean(
+            {
+                "loss": loss,
+                "policy_loss": pg,
+                "value_loss": vf,
+                "entropy": ent,
+                "mean_rho": rho,
+            },
+            DATA_AXIS,
+        )
+        return (
+            LearnerState(params=params, opt_state=opt_state, step=state.step + 1),
+            metrics,
+        )
+
+    example = jax.eval_shape(init, jax.random.PRNGKey(0))
+    state_spec = jax.tree_util.tree_map(lambda _: P(), example)
+    # Trajectory batches shard on axis 1 (the trajectory/env axis; axis 0
+    # is time) except last_obs, which is [B, ...] and shards on axis 0.
+    batch_spec = ActorTrajectory(
+        obs=P(None, DATA_AXIS),
+        actions=P(None, DATA_AXIS),
+        rewards=P(None, DATA_AXIS),
+        dones=P(None, DATA_AXIS),
+        behaviour_log_probs=P(None, DATA_AXIS),
+        last_obs=P(DATA_AXIS),
+    )
+    # NO donation here: ParamStore and in-flight actor snapshots alias
+    # state.params, and donating would delete the buffers actors are
+    # reading (harmless on CPU, fatal on TPU).
+    learner_step = jax.jit(
+        jax.shard_map(
+            local_learner_step,
+            mesh=mesh,
+            in_specs=(state_spec, batch_spec),
+            out_specs=(state_spec, P()),
+            check_vma=False,
+        ),
+    )
+    return init, learner_step, make_actor_programs, mesh
+
+
+def stack_trajectories(trajs: List[ActorTrajectory]) -> ActorTrajectory:
+    """Concatenate actor rollouts on the env axis -> ``[T, B, ...]``
+    (``last_obs`` is ``[B, ...]`` and concatenates on axis 0)."""
+    cat = lambda axis: (
+        lambda *xs: jnp.concatenate(xs, axis=axis)
+    )
+    return ActorTrajectory(
+        obs=jax.tree_util.tree_map(cat(1), *[t.obs for t in trajs]),
+        actions=cat(1)(*[t.actions for t in trajs]),
+        rewards=cat(1)(*[t.rewards for t in trajs]),
+        dones=cat(1)(*[t.dones for t in trajs]),
+        behaviour_log_probs=cat(1)(
+            *[t.behaviour_log_probs for t in trajs]
+        ),
+        last_obs=jax.tree_util.tree_map(cat(0), *[t.last_obs for t in trajs]),
+    )
+
+
+def run_impala(
+    cfg: ImpalaConfig,
+    *,
+    log_interval: int = 20,
+    log_fn=None,
+) -> Tuple[LearnerState, List[Tuple[int, Dict[str, float]]]]:
+    """Drive actors + learner until the env-step budget is consumed."""
+    from actor_critic_algs_on_tensorflow_tpu.utils.metrics import (
+        device_get_metrics,
+        format_metrics,
+    )
+
+    init, learner_step, make_actor_programs, mesh = make_impala(cfg)
+    state = init(jax.random.PRNGKey(cfg.seed))
+    store = ParamStore(state.params)
+    q = TrajectoryQueue(cfg.queue_size)
+    stop = threading.Event()
+    traj_per_batch = cfg.batch_trajectories
+    actors = [
+        ImpalaActor(
+            i, *make_actor_programs(i), store, q, stop,
+            seed=cfg.seed * 10_000 + i
+        )
+        for i in range(cfg.num_actors)
+    ]
+    for a in actors:
+        a.start()
+
+    steps_per_batch = (
+        cfg.batch_trajectories * cfg.envs_per_actor * cfg.rollout_length
+    )
+    num_learner_steps = max(1, cfg.total_env_steps // steps_per_batch)
+    history: List[Tuple[int, Dict[str, float]]] = []
+    t0 = time.perf_counter()
+    try:
+        for it in range(num_learner_steps):
+            trajs, eps = [], []
+            while len(trajs) < traj_per_batch:
+                for a in actors:
+                    if a.error is not None:
+                        raise RuntimeError(
+                            f"actor {a.actor_id} died"
+                        ) from a.error
+                try:
+                    traj, ep = q.get(timeout=1.0)
+                except queue_lib.Empty:  # re-check actor health
+                    continue
+                trajs.append(traj)
+                eps.append(ep)
+            batch = stack_trajectories(trajs)
+            state, metrics = learner_step(state, batch)
+            if (it + 1) % cfg.publish_interval == 0:
+                store.publish(state.params)
+            if (it + 1) % log_interval == 0 or it == num_learner_steps - 1:
+                m = device_get_metrics(metrics)
+                done = jnp.concatenate(
+                    [e["done_episode"].reshape(-1) for e in eps]
+                )
+                rets = jnp.concatenate(
+                    [e["episode_return"].reshape(-1) for e in eps]
+                )
+                n_ep = float(jnp.sum(done))
+                if n_ep > 0:
+                    m["avg_return"] = float(jnp.sum(rets * done) / n_ep)
+                env_steps = (it + 1) * steps_per_batch
+                m["steps_per_sec"] = env_steps / (time.perf_counter() - t0)
+                m.update(q.metrics())
+                m["param_version"] = store.version
+                history.append((env_steps, m))
+                if log_fn is not None:
+                    log_fn(env_steps, m)
+                else:
+                    print(format_metrics(env_steps, m), flush=True)
+    finally:
+        stop.set()
+        q.close()
+        for a in actors:
+            a.join(timeout=5.0)
+    return state, history

@@ -1,0 +1,168 @@
+"""Shared off-policy training machinery (DDPG/SAC substrate).
+
+Capability parity: the reference's off-policy trainers loop
+``env step -> replay.add -> every k steps: sample + update`` with
+target networks (BASELINE.json:9,10; SURVEY.md §3.2). TPU-first, one
+iteration fuses ``steps_per_iter`` vectorized env steps (a ``lax.scan``
+that both acts and scatters transitions into the HBM replay ring) with
+``updates_per_iter`` sampled gradient updates into ONE jitted
+``shard_map`` program over the ``data`` mesh axis. Each device owns a
+local replay shard fed by its local envs; gradients are
+``lax.pmean``-averaged (the MirroredStrategy/NCCL analog).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax.sharding import Mesh, PartitionSpec as P
+
+from actor_critic_algs_on_tensorflow_tpu.data.replay import (
+    ReplayBuffer,
+    ReplayState,
+)
+from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import DATA_AXIS
+
+
+class Transition(NamedTuple):
+    """One off-policy transition; replay stores a [capacity, ...] stack."""
+
+    obs: Any
+    action: jax.Array
+    reward: jax.Array
+    next_obs: Any
+    # 1.0 only at TRUE terminations — time-limit truncations bootstrap,
+    # so they mask nothing (gymnasium semantics; see envs.core).
+    terminated: jax.Array
+
+
+@struct.dataclass
+class OffPolicyState:
+    """Train state for DDPG/SAC-style algorithms.
+
+    ``params``/``opt_state``/``key``/``step`` replicated; ``env_state``/
+    ``obs``/``noise``/``replay`` sharded per-device on the env axis
+    (replay rows are device-local, so its leaves shard on axis 0 only
+    via the vmapped [n_dev, ...] layout built by ``init``).
+    """
+
+    params: Any          # algorithm-specific pytree (actor/critic/targets/...)
+    opt_state: Any
+    env_state: Any
+    obs: Any
+    noise: Any           # exploration carry (OU state or None-like)
+    replay: ReplayState
+    key: jax.Array
+    step: jax.Array      # iteration counter
+
+
+def state_specs(state: OffPolicyState) -> OffPolicyState:
+    from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
+        replicated_specs,
+        shard_batch_specs,
+    )
+
+    return OffPolicyState(
+        params=replicated_specs(state.params),
+        opt_state=replicated_specs(state.opt_state),
+        env_state=shard_batch_specs(state.env_state),
+        obs=shard_batch_specs(state.obs),
+        noise=shard_batch_specs(state.noise),
+        replay=shard_batch_specs(state.replay),
+        key=P(),
+        step=P(),
+    )
+
+
+class OffPolicyFns(NamedTuple):
+    """A compiled off-policy training program."""
+
+    init: Callable[[jax.Array], OffPolicyState]
+    iteration: Callable[
+        [OffPolicyState], Tuple[OffPolicyState, Dict[str, jax.Array]]
+    ]
+    mesh: Mesh
+    steps_per_iteration: int  # global env steps per iteration
+
+
+def build_off_policy_iteration(
+    local_iteration: Callable,
+    example_state: OffPolicyState,
+    mesh: Mesh,
+) -> Callable:
+    """shard_map + jit with state donation (HBM replay updates in place)."""
+    from actor_critic_algs_on_tensorflow_tpu.algos.common import (
+        build_shard_map_iteration,
+    )
+
+    return build_shard_map_iteration(
+        local_iteration, state_specs(example_state), mesh
+    )
+
+
+def put_sharded(state: OffPolicyState, mesh: Mesh) -> OffPolicyState:
+    """Place a host-built state onto the mesh per ``state_specs``."""
+    from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import put_by_specs
+
+    return put_by_specs(state, state_specs(state), mesh)
+
+
+def act_then_store(
+    env,
+    env_params,
+    buf: ReplayBuffer,
+    act_fn: Callable,  # (params, obs, noise, key, step) -> (action, noise)
+    params,
+    carry,  # (env_state, obs, noise, replay)
+    key: jax.Array,
+    num_steps: int,
+    global_step,
+    *,
+    noise_reset_fn: Callable | None = None,  # (noise, done) -> noise
+):
+    """``lax.scan`` of env steps that scatters transitions into replay.
+
+    ``noise_reset_fn`` runs INSIDE the scan on each step's ``done`` so
+    per-episode noise processes (OU) reset at every boundary, not just
+    those landing on the final scan step.
+
+    Returns ``(env_state, obs, noise, replay, ep_info)``.
+    """
+
+    def _step(c, step_key):
+        env_state, obs, noise, replay = c
+        k_act, k_env = jax.random.split(step_key)
+        action, noise = act_fn(params, obs, noise, k_act, global_step)
+        env_state, next_obs, reward, done, info = env.step(
+            k_env, env_state, action, env_params
+        )
+        if noise_reset_fn is not None:
+            noise = noise_reset_fn(noise, done)
+        # AutoReset returns the POST-reset obs at boundaries; the true
+        # successor is info["final_obs"], which the wrapper preserves.
+        successor = info["final_obs"]
+        replay = buf.add_batch(
+            replay,
+            Transition(
+                obs=obs,
+                action=action,
+                reward=reward,
+                next_obs=successor,
+                terminated=info["terminated"],
+            ),
+        )
+        ep_info = {
+            "episode_return": info["episode_return"],
+            "done_episode": info["done_episode"],
+            "done": done,
+        }
+        return (env_state, next_obs, noise, replay), ep_info
+
+    keys = jax.random.split(key, num_steps)
+    (env_state, obs, noise, replay), ep_info = jax.lax.scan(
+        _step, carry, keys
+    )
+    return env_state, obs, noise, replay, ep_info

@@ -1,0 +1,290 @@
+"""SAC: soft actor-critic with learned entropy temperature.
+
+Capability parity: the reference's SAC baseline — twin-Q critics (min
+of two target Qs), tanh-squashed Gaussian actor, and a learned entropy
+temperature alpha tuned against a target entropy, on MuJoCo
+Humanoid-class tasks (BASELINE.json:10; SURVEY.md §2.1 "SAC trainer",
+§3.2 call stack, §7.3 numerics warning).
+
+TPU-first design mirrors ``algos.ddpg``: one jitted ``shard_map``
+program fuses env stepping into the per-device HBM replay ring with the
+sampled twin-Q / actor / alpha updates; gradients ``lax.pmean``-averaged
+over the ``data`` axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from actor_critic_algs_on_tensorflow_tpu import envs as envs_lib
+from actor_critic_algs_on_tensorflow_tpu.algos import offpolicy
+from actor_critic_algs_on_tensorflow_tpu.algos.common import episode_metrics
+from actor_critic_algs_on_tensorflow_tpu.data.replay import ReplayBuffer
+from actor_critic_algs_on_tensorflow_tpu.models import (
+    SquashedGaussianActor,
+    TwinQCritic,
+)
+from actor_critic_algs_on_tensorflow_tpu.ops import TanhGaussian, polyak_update
+from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
+    DATA_AXIS,
+    device_count,
+    make_mesh,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class SACConfig:
+    env: str = "Pendulum-v1"
+    num_envs: int = 16              # global, across all devices
+    steps_per_iter: int = 8         # env steps per env per iteration
+    updates_per_iter: int = 8
+    total_env_steps: int = 200_000
+    replay_capacity: int = 100_000  # per device
+    batch_size: int = 256           # per device
+    warmup_env_steps: int = 1_000
+    hidden_sizes: Tuple[int, ...] = (256, 256)
+    actor_lr: float = 3e-4
+    critic_lr: float = 3e-4
+    alpha_lr: float = 3e-4
+    init_alpha: float = 1.0
+    # target entropy = -action_dim * target_entropy_scale (SAC default 1)
+    target_entropy_scale: float = 1.0
+    gamma: float = 0.99
+    tau: float = 0.005
+    seed: int = 0
+    num_devices: int = 0
+
+
+@struct.dataclass
+class SACParams:
+    actor: any
+    critic: any
+    target_critic: any
+    log_alpha: jax.Array
+
+
+def make_sac(cfg: SACConfig) -> offpolicy.OffPolicyFns:
+    """Build jitted ``init`` and fused ``iteration`` for SAC."""
+    mesh = make_mesh(cfg.num_devices or None)
+    n_dev = device_count(mesh)
+    if cfg.num_envs % n_dev:
+        raise ValueError(
+            f"num_envs={cfg.num_envs} not divisible by {n_dev} devices"
+        )
+    local_envs = cfg.num_envs // n_dev
+    env, env_params = envs_lib.make(cfg.env, num_envs=local_envs)
+    genv, _ = envs_lib.make(cfg.env, num_envs=cfg.num_envs)
+    aspace = env.action_space(env_params)
+    action_dim = aspace.shape[-1] if aspace.shape else 1
+    action_scale = float(aspace.high)
+    target_entropy = -float(action_dim) * cfg.target_entropy_scale
+
+    actor = SquashedGaussianActor(action_dim, cfg.hidden_sizes)
+    critic = TwinQCritic(cfg.hidden_sizes)
+    actor_tx = optax.adam(cfg.actor_lr)
+    critic_tx = optax.adam(cfg.critic_lr)
+    alpha_tx = optax.adam(cfg.alpha_lr)
+    buf = ReplayBuffer(cfg.replay_capacity)
+
+    steps_per_iteration = cfg.num_envs * cfg.steps_per_iter
+    warmup_iters = cfg.warmup_env_steps // max(steps_per_iteration, 1)
+
+    def act_fn(params, obs, noise, key, step):
+        """Stochastic squashed-Gaussian acting; uniform during warmup."""
+        k_sample, k_rand = jax.random.split(key)
+        mean, log_std = actor.apply(params.actor, obs)
+        a = TanhGaussian(mean, log_std).sample(k_sample)
+        rand = jax.random.uniform(k_rand, a.shape, a.dtype, -1.0, 1.0)
+        a = jnp.where(step < warmup_iters, rand, a)
+        return a * action_scale, noise
+
+    def init(key: jax.Array) -> offpolicy.OffPolicyState:
+        k_env, k_actor, k_critic, k_state = jax.random.split(key, 4)
+        env_state, obs = genv.reset(k_env, env_params)
+        a0 = jnp.zeros((1, action_dim))
+        actor_params = actor.init(k_actor, obs[:1])
+        critic_params = critic.init(k_critic, obs[:1], a0)
+        log_alpha = jnp.log(jnp.asarray(cfg.init_alpha, jnp.float32))
+        params = SACParams(
+            actor=actor_params,
+            critic=critic_params,
+            # Copy: donated state must not alias online/target buffers.
+            target_critic=jax.tree_util.tree_map(jnp.copy, critic_params),
+            log_alpha=log_alpha,
+        )
+        example = offpolicy.Transition(
+            obs=obs[0],
+            action=jnp.zeros((action_dim,)),
+            reward=jnp.zeros(()),
+            next_obs=obs[0],
+            terminated=jnp.zeros(()),
+        )
+        replay = jax.vmap(lambda _: buf.init(example))(jnp.arange(n_dev))
+        state = offpolicy.OffPolicyState(
+            params=params,
+            opt_state={
+                "actor": actor_tx.init(actor_params),
+                "critic": critic_tx.init(critic_params),
+                "alpha": alpha_tx.init(log_alpha),
+            },
+            env_state=env_state,
+            obs=obs,
+            noise=jnp.zeros((cfg.num_envs,)),  # SAC needs no noise carry
+            replay=replay,
+            key=k_state,
+            step=jnp.zeros((), jnp.int32),
+        )
+        return offpolicy.put_sharded(state, mesh)
+
+    def local_iteration(state: offpolicy.OffPolicyState):
+        dev = jax.lax.axis_index(DATA_AXIS)
+        it_key = jax.random.fold_in(jax.random.fold_in(state.key, state.step), dev)
+        k_roll, k_upd = jax.random.split(it_key)
+        replay = jax.tree_util.tree_map(lambda x: x[0], state.replay)
+
+        env_state, obs, noise, replay, ep_info = offpolicy.act_then_store(
+            env, env_params, buf, act_fn,
+            state.params,
+            (state.env_state, state.obs, state.noise, replay),
+            k_roll, cfg.steps_per_iter, state.step,
+        )
+
+        def one_update(carry, key):
+            params, opt_state = carry
+            k_batch, k_next, k_pi = jax.random.split(key, 3)
+            batch = buf.sample(replay, k_batch, cfg.batch_size)
+            alpha = jnp.exp(params.log_alpha)
+
+            def critic_loss_fn(cp):
+                mean, log_std = actor.apply(params.actor, batch.next_obs)
+                a_next, logp_next = TanhGaussian(
+                    mean, log_std
+                ).sample_and_log_prob(k_next)
+                q1t, q2t = critic.apply(
+                    params.target_critic, batch.next_obs, a_next * action_scale
+                )
+                v_next = jnp.minimum(q1t, q2t) - alpha * logp_next
+                y = batch.reward + cfg.gamma * (1.0 - batch.terminated) * v_next
+                y = jax.lax.stop_gradient(y)
+                q1, q2 = critic.apply(cp, batch.obs, batch.action)
+                return (
+                    jnp.mean((q1 - y) ** 2) + jnp.mean((q2 - y) ** 2),
+                    0.5 * (jnp.mean(q1) + jnp.mean(q2)),
+                )
+
+            (q_loss, q_mean), q_grads = jax.value_and_grad(
+                critic_loss_fn, has_aux=True
+            )(params.critic)
+
+            def actor_loss_fn(ap):
+                mean, log_std = actor.apply(ap, batch.obs)
+                a, logp = TanhGaussian(mean, log_std).sample_and_log_prob(k_pi)
+                q1, q2 = critic.apply(
+                    params.critic, batch.obs, a * action_scale
+                )
+                q = jnp.minimum(q1, q2)
+                return jnp.mean(alpha * logp - q), jnp.mean(logp)
+
+            (a_loss, logp_mean), a_grads = jax.value_and_grad(
+                actor_loss_fn, has_aux=True
+            )(params.actor)
+
+            def alpha_loss_fn(la):
+                # Gradient flows through la only; entropy gap detached.
+                gap = jax.lax.stop_gradient(logp_mean + target_entropy)
+                return -jnp.exp(la) * gap
+
+            al_loss, al_grad = jax.value_and_grad(alpha_loss_fn)(
+                params.log_alpha
+            )
+
+            q_grads = jax.lax.pmean(q_grads, DATA_AXIS)
+            a_grads = jax.lax.pmean(a_grads, DATA_AXIS)
+            al_grad = jax.lax.pmean(al_grad, DATA_AXIS)
+            q_up, c_opt = critic_tx.update(
+                q_grads, opt_state["critic"], params.critic
+            )
+            a_up, a_opt = actor_tx.update(
+                a_grads, opt_state["actor"], params.actor
+            )
+            al_up, al_opt = alpha_tx.update(
+                al_grad, opt_state["alpha"], params.log_alpha
+            )
+            new_params = SACParams(
+                actor=optax.apply_updates(params.actor, a_up),
+                critic=optax.apply_updates(params.critic, q_up),
+                target_critic=polyak_update(
+                    params.target_critic, params.critic, cfg.tau
+                ),
+                log_alpha=optax.apply_updates(params.log_alpha, al_up),
+            )
+            m = {
+                "q_loss": q_loss,
+                "actor_loss": a_loss,
+                "alpha_loss": al_loss,
+                "alpha": alpha,
+                "entropy": -logp_mean,
+                "q_mean": q_mean,
+            }
+            new_opt = {"actor": a_opt, "critic": c_opt, "alpha": al_opt}
+            return (new_params, new_opt), m
+
+        def run_updates(carry):
+            return jax.lax.scan(
+                one_update, carry, jax.random.split(k_upd, cfg.updates_per_iter)
+            )
+
+        def skip_updates(carry):
+            zeros = jax.tree_util.tree_map(
+                lambda _: jnp.zeros((cfg.updates_per_iter,)),
+                {
+                    "q_loss": 0, "actor_loss": 0, "alpha_loss": 0,
+                    "alpha": 0, "entropy": 0, "q_mean": 0,
+                },
+            )
+            return carry, zeros
+
+        ready = jnp.logical_and(
+            state.step >= warmup_iters, replay.size >= cfg.batch_size
+        )
+        (params, opt_state), m = jax.lax.cond(
+            ready, run_updates, skip_updates,
+            (state.params, state.opt_state),
+        )
+
+        metrics = jax.lax.pmean(
+            jax.tree_util.tree_map(jnp.mean, m), DATA_AXIS
+        )
+        metrics.update(episode_metrics(ep_info))
+        metrics["replay_size"] = jax.lax.pmean(
+            replay.size.astype(jnp.float32), DATA_AXIS
+        )
+
+        new_state = offpolicy.OffPolicyState(
+            params=params,
+            opt_state=opt_state,
+            env_state=env_state,
+            obs=obs,
+            noise=noise,
+            replay=jax.tree_util.tree_map(lambda x: x[None], replay),
+            key=state.key,
+            step=state.step + 1,
+        )
+        return new_state, metrics
+
+    example = jax.eval_shape(init, jax.random.PRNGKey(0))
+    iteration = offpolicy.build_off_policy_iteration(
+        local_iteration, example, mesh
+    )
+    return offpolicy.OffPolicyFns(
+        init=init,
+        iteration=iteration,
+        mesh=mesh,
+        steps_per_iteration=steps_per_iteration,
+    )

@@ -1,0 +1,240 @@
+"""train.py-style CLI entrypoints.
+
+Capability parity: the reference's public surface is command-line
+``train.py`` invocations selecting algorithm + env + hyperparameters
+(BASELINE.json:5 — "the existing train.py entrypoints"; SURVEY.md L6).
+The five baseline workloads (BASELINE.json:7-11) are checked in as
+named presets:
+
+    python train.py --preset a2c-cartpole
+    python train.py --preset ppo-pong
+    python train.py --preset ddpg-halfcheetah
+    python train.py --preset sac-humanoid
+    python train.py --preset impala-cartpole
+
+or explicitly:
+
+    python train.py --algo ppo --env PongTPU-v0 --total-steps 1000000 \
+        --set torso=nature_cnn --set frame_stack=4
+
+``--set key=value`` overrides any config dataclass field with type
+coercion from the field's declared type.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+from typing import Any, Tuple
+
+
+def _coerce(value: str, target_type) -> Any:
+    """Parse a CLI string into a config field's type."""
+    import typing
+
+    origin = typing.get_origin(target_type)
+    if origin in (tuple, Tuple):
+        inner = typing.get_args(target_type)
+        elt = inner[0] if inner else str
+        if value.strip() == "":
+            return ()
+        return tuple(_coerce(v.strip(), elt) for v in value.split(","))
+    if target_type is bool or str(target_type) == "bool":
+        return value.lower() in ("1", "true", "yes", "on")
+    if target_type is int:
+        return int(value)
+    if target_type is float:
+        return float(value)
+    return value
+
+
+def apply_overrides(cfg, overrides: list[str]):
+    """Apply ``key=value`` strings to a frozen config dataclass."""
+    import typing
+
+    hints = typing.get_type_hints(type(cfg))
+    updates = {}
+    for item in overrides:
+        if "=" not in item:
+            raise SystemExit(f"--set expects key=value, got {item!r}")
+        key, value = item.split("=", 1)
+        if key not in hints:
+            known = ", ".join(sorted(hints))
+            raise SystemExit(f"unknown config field {key!r}; known: {known}")
+        updates[key] = _coerce(value, hints[key])
+    return dataclasses.replace(cfg, **updates)
+
+
+PRESETS = {
+    # 1. A2C on CartPole-v1: 2-layer MLP, sync actors (BASELINE.json:7)
+    "a2c-cartpole": ("a2c", {"env": "CartPole-v1", "total_env_steps": 500_000}),
+    # 2. PPO on Atari-class Pong: Nature-CNN, 8 vec envs (BASELINE.json:8)
+    "ppo-pong": (
+        "ppo",
+        {
+            "env": "PongTPU-v0",
+            "num_envs": 8,
+            "rollout_length": 128,
+            "torso": "nature_cnn",
+            "frame_stack": 4,
+            "total_env_steps": 10_000_000,
+        },
+    ),
+    # 3. DDPG on MuJoCo HalfCheetah: OU-noise explore (BASELINE.json:9)
+    "ddpg-halfcheetah": (
+        "ddpg",
+        {
+            "env": "gym:HalfCheetah-v4",
+            "num_envs": 8,
+            "num_devices": 1,
+            "total_env_steps": 1_000_000,
+        },
+    ),
+    # 4. SAC on Humanoid: twin-Q + learned alpha (BASELINE.json:10)
+    "sac-humanoid": (
+        "sac",
+        {
+            "env": "gym:Humanoid-v4",
+            "num_envs": 8,
+            "num_devices": 1,
+            "total_env_steps": 3_000_000,
+        },
+    ),
+    # 5. IMPALA / distributed A3C with V-trace (BASELINE.json:11)
+    "impala-cartpole": (
+        "impala",
+        {"env": "CartPole-v1", "num_actors": 8, "total_env_steps": 1_000_000},
+    ),
+}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="train.py",
+        description="TPU-native actor-critic training entrypoints",
+    )
+    p.add_argument("--preset", choices=sorted(PRESETS), help="named baseline config")
+    p.add_argument("--algo", choices=["a2c", "ppo", "ddpg", "sac", "impala"])
+    p.add_argument("--env", help="env id (pure-JAX name or gym:<id>)")
+    p.add_argument("--total-steps", type=int, help="total env steps")
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument(
+        "--set",
+        action="append",
+        default=[],
+        metavar="KEY=VALUE",
+        help="override any config field (repeatable)",
+    )
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-interval", type=int, default=200,
+                   help="iterations between checkpoints")
+    p.add_argument("--resume", action="store_true",
+                   help="restore latest checkpoint from --checkpoint-dir")
+    p.add_argument("--log-interval", type=int, default=20)
+    return p
+
+
+def make_config(args) -> Tuple[str, Any]:
+    from actor_critic_algs_on_tensorflow_tpu.algos.a2c import A2CConfig
+    from actor_critic_algs_on_tensorflow_tpu.algos.ddpg import DDPGConfig
+    from actor_critic_algs_on_tensorflow_tpu.algos.impala import ImpalaConfig
+    from actor_critic_algs_on_tensorflow_tpu.algos.ppo import PPOConfig
+    from actor_critic_algs_on_tensorflow_tpu.algos.sac import SACConfig
+
+    classes = {
+        "a2c": A2CConfig,
+        "ppo": PPOConfig,
+        "ddpg": DDPGConfig,
+        "sac": SACConfig,
+        "impala": ImpalaConfig,
+    }
+    if args.preset:
+        algo, base = PRESETS[args.preset]
+        cfg = classes[algo](**base)
+    elif args.algo:
+        algo = args.algo
+        cfg = classes[algo]()
+    else:
+        raise SystemExit("pass --preset or --algo (see --help)")
+    if args.env:
+        cfg = dataclasses.replace(cfg, env=args.env)
+    if args.total_steps:
+        cfg = dataclasses.replace(cfg, total_env_steps=args.total_steps)
+    if args.seed is not None:
+        cfg = dataclasses.replace(cfg, seed=args.seed)
+    cfg = apply_overrides(cfg, args.set)
+    return algo, cfg
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    algo, cfg = make_config(args)
+    print(f"[train] algo={algo} config={cfg}", flush=True)
+
+    if algo == "impala":
+        from actor_critic_algs_on_tensorflow_tpu.algos.impala import run_impala
+
+        state, _ = run_impala(cfg, log_interval=args.log_interval)
+        print(f"[train] done: learner steps={int(state.step)}")
+        return 0
+
+    from actor_critic_algs_on_tensorflow_tpu.algos import common
+
+    if algo == "a2c":
+        from actor_critic_algs_on_tensorflow_tpu.algos.a2c import make_a2c
+
+        fns = make_a2c(cfg)
+    elif algo == "ppo":
+        from actor_critic_algs_on_tensorflow_tpu.algos.ppo import make_ppo
+
+        fns = make_ppo(cfg)
+    elif algo == "ddpg":
+        from actor_critic_algs_on_tensorflow_tpu.algos.ddpg import make_ddpg
+
+        fns = make_ddpg(cfg)
+    else:
+        from actor_critic_algs_on_tensorflow_tpu.algos.sac import make_sac
+
+        fns = make_sac(cfg)
+
+    checkpointer = None
+    state = None
+    if args.checkpoint_dir:
+        from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
+            Checkpointer,
+        )
+
+        checkpointer = Checkpointer(args.checkpoint_dir)
+        if args.resume and checkpointer.latest_step() is not None:
+            import jax
+
+            template = fns.init(jax.random.PRNGKey(cfg.seed))
+            state = checkpointer.restore(template)
+            print(f"[train] resumed from step {checkpointer.latest_step()}")
+
+    state, history = common.run_loop(
+        fns,
+        total_env_steps=cfg.total_env_steps,
+        seed=cfg.seed,
+        log_interval_iters=args.log_interval,
+        checkpointer=checkpointer,
+        checkpoint_interval_iters=args.checkpoint_interval,
+        state=state,
+    )
+    if checkpointer is not None:
+        checkpointer.save(int(state.step), state)
+        checkpointer.wait()
+        checkpointer.close()
+    if history:
+        final = history[-1][1]
+        print(
+            f"[train] done: env_steps={history[-1][0]} "
+            f"steps_per_sec={final.get('steps_per_sec', 0):.0f} "
+            f"avg_return={final.get('avg_return', float('nan')):.2f}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

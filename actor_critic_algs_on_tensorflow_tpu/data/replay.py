@@ -1,0 +1,102 @@
+"""Uniform replay buffer resident in TPU HBM.
+
+Capability parity: the reference's off-policy trainers (DDPG, SAC —
+BASELINE.json:9,10) sample uniform minibatches from a host-side replay
+buffer (SURVEY.md §2.1 "Replay buffer"). TPU-first, the buffer is a
+pre-allocated ``[capacity, ...]`` pytree that LIVES in device memory
+(BASELINE.json:5 — "the rollout/replay buffer lives in TPU HBM"):
+inserts are XLA scatters, sampling is an on-device gather, and with
+buffer donation the jitted train step updates it in place — no
+host<->device traffic ever touches a transition after it is produced.
+
+Functional API (all methods pure, jit/vmap/shard_map-safe):
+
+    buf = ReplayBuffer(capacity)
+    state = buf.init(example_transition)          # zeros, [capacity, ...]
+    state = buf.add_batch(state, batch)           # [B, ...] scatter + wrap
+    batch = buf.sample(state, key, batch_size)    # uniform over valid rows
+
+Under data-parallel ``shard_map`` each device holds an independent
+local shard of the buffer (capacity is per-device), the exact analog of
+per-worker replay in the reference's MirroredStrategy setup.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class ReplayState:
+    """Ring-buffer contents + cursor. A pytree; donate it across steps."""
+
+    storage: Any            # pytree of [capacity, ...] arrays
+    insert_pos: jax.Array   # int32 scalar: next row to write (mod capacity)
+    size: jax.Array         # int32 scalar: number of valid rows
+
+
+class ReplayBuffer:
+    """Fixed-capacity uniform ring buffer over an arbitrary pytree."""
+
+    def __init__(self, capacity: int):
+        if capacity <= 0:
+            raise ValueError(f"capacity must be positive, got {capacity}")
+        self.capacity = capacity
+
+    def init(self, example_item) -> ReplayState:
+        """Allocate zeroed ``[capacity, ...]`` storage shaped like one
+        (unbatched) transition pytree."""
+        storage = jax.tree_util.tree_map(
+            lambda x: jnp.zeros(
+                (self.capacity,) + jnp.shape(x), jnp.asarray(x).dtype
+            ),
+            example_item,
+        )
+        return ReplayState(
+            storage=storage,
+            insert_pos=jnp.zeros((), jnp.int32),
+            size=jnp.zeros((), jnp.int32),
+        )
+
+    def add_batch(self, state: ReplayState, batch) -> ReplayState:
+        """Insert a ``[B, ...]`` batch at the cursor, wrapping around.
+
+        B may exceed capacity; later rows overwrite earlier ones within
+        the same call (ring semantics), matching sequential insertion.
+        """
+        sizes = {jnp.shape(x)[0] for x in jax.tree_util.tree_leaves(batch)}
+        if len(sizes) != 1:
+            raise ValueError(f"inconsistent batch sizes: {sizes}")
+        (n,) = sizes
+        rows = (state.insert_pos + jnp.arange(n, dtype=jnp.int32)) % self.capacity
+        if n > self.capacity:
+            # Only the LAST ``capacity`` rows survive; XLA scatters with
+            # duplicate indices are order-nondeterministic, so drop the
+            # overwritten prefix explicitly.
+            keep = n - self.capacity
+            rows = rows[keep:]
+            batch = jax.tree_util.tree_map(lambda x: x[keep:], batch)
+        storage = jax.tree_util.tree_map(
+            lambda buf, x: buf.at[rows].set(x), state.storage, batch
+        )
+        return ReplayState(
+            storage=storage,
+            insert_pos=(state.insert_pos + n) % self.capacity,
+            size=jnp.minimum(state.size + n, self.capacity),
+        )
+
+    def sample(self, state: ReplayState, key: jax.Array, batch_size: int):
+        """Uniform sample (with replacement) of ``batch_size`` valid rows."""
+        idx = jax.random.randint(
+            key, (batch_size,), 0, jnp.maximum(state.size, 1)
+        )
+        return jax.tree_util.tree_map(
+            lambda buf: jnp.take(buf, idx, axis=0), state.storage
+        )
+
+    def can_sample(self, state: ReplayState, min_size: int) -> jax.Array:
+        return state.size >= min_size

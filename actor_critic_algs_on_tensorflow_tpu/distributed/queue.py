@@ -1,0 +1,108 @@
+"""Actor->learner trajectory queue with observability and a watchdog.
+
+Capability parity: the reference's IMPALA / distributed-A3C mode ships
+actor trajectories to a central learner (BASELINE.json:11; SURVEY.md
+§3.3 — "the distributed-systems surface of the repo"). In the rebuild
+the queue carries device-resident trajectory pytrees between actor
+threads (or, multi-host, DCN streams) and the learner; SURVEY.md §5
+requires queue-depth metrics and a deadlock/starvation watchdog in
+place of race-detection tooling.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class QueueStats:
+    puts: int = 0
+    gets: int = 0
+    put_blocked_s: float = 0.0   # producer backpressure time
+    get_blocked_s: float = 0.0   # consumer starvation time
+    last_put_ts: float = field(default_factory=time.monotonic)
+    last_get_ts: float = field(default_factory=time.monotonic)
+
+
+class TrajectoryQueue:
+    """Bounded FIFO for trajectory pytrees with starvation detection.
+
+    ``maxsize`` bounds the off-policy lag: with size q and batch b the
+    learner consumes trajectories at most ``q + b`` publications stale,
+    which V-trace's rho/c clipping then corrects.
+    """
+
+    def __init__(self, maxsize: int = 16, *, watchdog_timeout_s: float = 60.0):
+        self._q: "queue.Queue[Any]" = queue.Queue(maxsize)
+        self.stats = QueueStats()
+        self._lock = threading.Lock()
+        self._timeout = watchdog_timeout_s
+        self._watchdog_alerts: list[str] = []
+        self._closed = threading.Event()
+        self._watchdog = threading.Thread(
+            target=self._watch, name="queue-watchdog", daemon=True
+        )
+        self._watchdog.start()
+
+    def put(self, item: Any, timeout: float | None = None) -> None:
+        t0 = time.monotonic()
+        self._q.put(item, timeout=timeout)
+        with self._lock:
+            self.stats.puts += 1
+            self.stats.put_blocked_s += time.monotonic() - t0
+            self.stats.last_put_ts = time.monotonic()
+
+    def get(self, timeout: float | None = None) -> Any:
+        t0 = time.monotonic()
+        item = self._q.get(timeout=timeout)
+        with self._lock:
+            self.stats.gets += 1
+            self.stats.get_blocked_s += time.monotonic() - t0
+            self.stats.last_get_ts = time.monotonic()
+        return item
+
+    def depth(self) -> int:
+        return self._q.qsize()
+
+    def metrics(self) -> dict:
+        with self._lock:
+            return {
+                "queue_depth": self.depth(),
+                "queue_puts": self.stats.puts,
+                "queue_gets": self.stats.gets,
+                "producer_blocked_s": round(self.stats.put_blocked_s, 3),
+                "consumer_blocked_s": round(self.stats.get_blocked_s, 3),
+            }
+
+    @property
+    def watchdog_alerts(self) -> list[str]:
+        return list(self._watchdog_alerts)
+
+    def close(self) -> None:
+        self._closed.set()
+
+    def _watch(self) -> None:
+        """Flag starvation: a full queue nobody drains, or an empty queue
+        nobody feeds, for longer than the timeout."""
+        while not self._closed.wait(self._timeout / 4):
+            now = time.monotonic()
+            with self._lock:
+                idle_get = now - self.stats.last_get_ts
+                idle_put = now - self.stats.last_put_ts
+            full, empty = self._q.full(), self._q.empty()
+            if full and idle_get > self._timeout:
+                self._alert(
+                    f"learner stalled: queue full, no get for {idle_get:.0f}s"
+                )
+            elif empty and idle_put > self._timeout:
+                self._alert(
+                    f"actors stalled: queue empty, no put for {idle_put:.0f}s"
+                )
+
+    def _alert(self, msg: str) -> None:
+        self._watchdog_alerts.append(msg)
+        print(f"[TrajectoryQueue watchdog] {msg}", flush=True)

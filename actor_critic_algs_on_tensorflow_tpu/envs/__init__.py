@@ -35,12 +35,52 @@ _REGISTRY = {
     "PongTPU-v0": PongTPU,
 }
 
+# Host envs are stateful (the simulator lives host-side), so repeated
+# make() calls for the same (id, width) must share ONE instance — the
+# trainers build a local-width and a global-width env and expect them
+# to be the same pool on a 1-device mesh.
+_HOST_CACHE = {}
 
-def make(name: str, num_envs: int = 1, *, frame_stack: int = 0, params=None):
-    """Build ``VecEnv(EpisodeStats(AutoReset([FrameStack(env)])))``.
+
+def make(
+    name: str,
+    num_envs: int = 1,
+    *,
+    frame_stack: int = 0,
+    params=None,
+    fresh: bool = False,
+):
+    """Build ``VecEnv(EpisodeStats(AutoReset([FrameStack(env)])))`` for a
+    registered pure-JAX env, or a cached :class:`HostGymEnv` for a
+    ``gym:``-prefixed gymnasium id (e.g. ``gym:HalfCheetah-v4``).
+
+    ``fresh=True`` bypasses the host-env cache, returning a private
+    simulator pool — required when several independent consumers (e.g.
+    IMPALA actor threads, or eval alongside training at the same width)
+    would otherwise interleave steps on one shared pool.
 
     Returns ``(vec_env, params)``.
     """
+    if name.startswith("gym:"):
+        from actor_critic_algs_on_tensorflow_tpu.envs.host import HostGymEnv
+
+        if frame_stack and frame_stack > 1:
+            raise ValueError(
+                "frame_stack is not supported on the gym: host path; "
+                "wrap the underlying gymnasium env instead"
+            )
+        env_id = name[len("gym:"):]
+        backend = "sync"
+        if env_id.startswith("async:"):
+            env_id, backend = env_id[len("async:"):], "async"
+        if fresh:
+            return HostGymEnv(env_id, num_envs, backend=backend), None
+        cache_key = (env_id, num_envs, backend)
+        if cache_key not in _HOST_CACHE:
+            _HOST_CACHE[cache_key] = HostGymEnv(
+                env_id, num_envs, backend=backend
+            )
+        return _HOST_CACHE[cache_key], None
     if name not in _REGISTRY:
         raise KeyError(f"unknown env {name!r}; known: {sorted(_REGISTRY)}")
     env = _REGISTRY[name]()

@@ -42,12 +42,28 @@ def batch_sharded(mesh: Mesh, axis_name: str = DATA_AXIS) -> NamedSharding:
 
 
 def shard_batch_specs(tree, axis_name: str = DATA_AXIS):
-    """PartitionSpec pytree: every leaf sharded on its leading axis."""
-    return jax.tree_util.tree_map(lambda _: P(axis_name), tree)
+    """PartitionSpec pytree: every leaf sharded on its leading axis.
+
+    Scalar leaves (e.g. a host-env ordering token) cannot shard on a
+    leading axis — they are replicated instead.
+    """
+    return jax.tree_util.tree_map(
+        lambda x: P(axis_name) if len(getattr(x, "shape", ())) else P(), tree
+    )
 
 
 def replicated_specs(tree):
     return jax.tree_util.tree_map(lambda _: P(), tree)
+
+
+def put_by_specs(tree, specs, mesh: Mesh):
+    """``device_put`` a pytree onto the mesh per a PartitionSpec pytree."""
+    shardings = jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return jax.device_put(tree, shardings)
 
 
 def device_count(mesh: Mesh | None) -> int:

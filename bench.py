@@ -1,0 +1,75 @@
+"""Headline benchmark: PPO env-steps/sec/chip on the Atari-class workload.
+
+Reproduces the reference's headline metric (BASELINE.json:2 —
+"env-steps/sec/chip (PPO Atari)") on this host's accelerator: PPO with
+the Nature-CNN encoder over 84x84x4 stacked frames on the on-device
+PongTPU env, full collect+learn iterations (rollout scan + GAE +
+epoch/minibatch updates) as one jitted program.
+
+Baseline: the driver target is >= 1M env-steps/sec on a TPU v4-32
+(BASELINE.json:5), i.e. 31,250 env-steps/sec/chip; ``vs_baseline`` is
+measured steps/sec/chip over that per-chip target.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+
+from actor_critic_algs_on_tensorflow_tpu.algos.ppo import PPOConfig, make_ppo
+
+PER_CHIP_TARGET = 1_000_000 / 32  # BASELINE.json:5 on v4-32
+
+
+def main():
+    n_dev = len(jax.devices())
+    num_envs = int(os.environ.get("BENCH_NUM_ENVS", 64 * n_dev))
+    rollout = int(os.environ.get("BENCH_ROLLOUT", 128))
+    timed_iters = int(os.environ.get("BENCH_ITERS", 5))
+
+    cfg = PPOConfig(
+        env="PongTPU-v0",
+        num_envs=num_envs,
+        rollout_length=rollout,
+        total_env_steps=10**9,
+        frame_stack=4,
+        torso="nature_cnn",
+        num_epochs=4,
+        num_minibatches=4,
+        num_devices=n_dev,
+    )
+    fns = make_ppo(cfg)
+    state = fns.init(jax.random.PRNGKey(0))
+
+    # Warmup: compile + one full iteration.
+    state, metrics = fns.iteration(state)
+    jax.block_until_ready(metrics)
+
+    t0 = time.perf_counter()
+    for _ in range(timed_iters):
+        state, metrics = fns.iteration(state)
+    jax.block_until_ready(metrics)
+    dt = time.perf_counter() - t0
+
+    steps = timed_iters * fns.steps_per_iteration
+    per_chip = steps / dt / n_dev
+    print(
+        json.dumps(
+            {
+                "metric": "ppo_atari_env_steps_per_sec_per_chip",
+                "value": round(per_chip, 1),
+                "unit": "env-steps/sec/chip",
+                "vs_baseline": round(per_chip / PER_CHIP_TARGET, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

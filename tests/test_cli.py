@@ -1,0 +1,58 @@
+"""CLI entrypoint surface: presets, overrides, and a short real run."""
+
+import dataclasses
+
+import pytest
+
+from actor_critic_algs_on_tensorflow_tpu.cli import train as cli
+
+
+def test_presets_cover_the_five_baselines():
+    algos = {algo for algo, _ in cli.PRESETS.values()}
+    assert algos == {"a2c", "ppo", "ddpg", "sac", "impala"}
+
+
+def test_make_config_preset_and_overrides():
+    args = cli.build_parser().parse_args(
+        ["--preset", "ppo-pong", "--set", "lr=1e-3", "--set",
+         "hidden_sizes=32,32", "--set", "vf_clip=false", "--total-steps", "999"]
+    )
+    algo, cfg = cli.make_config(args)
+    assert algo == "ppo"
+    assert cfg.torso == "nature_cnn" and cfg.frame_stack == 4
+    assert cfg.lr == 1e-3
+    assert cfg.hidden_sizes == (32, 32)
+    assert cfg.vf_clip is False
+    assert cfg.total_env_steps == 999
+
+
+def test_unknown_override_rejected():
+    args = cli.build_parser().parse_args(
+        ["--algo", "a2c", "--set", "nope=1"]
+    )
+    with pytest.raises(SystemExit, match="unknown config field"):
+        cli.make_config(args)
+
+
+def test_cli_end_to_end_a2c(capsys):
+    rc = cli.main(
+        ["--algo", "a2c", "--env", "CartPole-v1", "--total-steps", "2048",
+         "--set", "num_envs=16", "--set", "rollout_length=8",
+         "--log-interval", "8"]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "steps_per_sec" in out and "done" in out
+
+
+def test_cli_checkpoint_resume_roundtrip(tmp_path, capsys):
+    common = [
+        "--algo", "a2c", "--env", "CartPole-v1",
+        "--set", "num_envs=16", "--set", "rollout_length=8",
+        "--checkpoint-dir", str(tmp_path / "ck"),
+        "--checkpoint-interval", "4", "--log-interval", "100",
+    ]
+    assert cli.main(common + ["--total-steps", "1024"]) == 0
+    assert cli.main(common + ["--total-steps", "2048", "--resume"]) == 0
+    out = capsys.readouterr().out
+    assert "resumed from step" in out

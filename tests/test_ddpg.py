@@ -1,0 +1,107 @@
+"""DDPG end-to-end: smoke, determinism, warmup gating, Pendulum learning."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from actor_critic_algs_on_tensorflow_tpu import envs as envs_lib
+from actor_critic_algs_on_tensorflow_tpu.algos import common, ddpg
+from actor_critic_algs_on_tensorflow_tpu.models import DeterministicActor
+
+
+def _params_l2(tree):
+    return float(sum(jnp.sum(x**2) for x in jax.tree_util.tree_leaves(tree)))
+
+
+def _cfg(**kw):
+    base = dict(
+        env="Pendulum-v1",
+        num_envs=8,
+        steps_per_iter=4,
+        updates_per_iter=2,
+        replay_capacity=1_000,
+        batch_size=4,
+        warmup_env_steps=32,
+    )
+    base.update(kw)
+    return ddpg.DDPGConfig(**base)
+
+
+def test_ddpg_iteration_smoke():
+    fns = ddpg.make_ddpg(_cfg())
+    state = fns.init(jax.random.PRNGKey(0))
+    before = _params_l2(state.params.actor)
+    # Iter 0: warmup (random actions, no updates). Iter 1+: updates.
+    for _ in range(3):
+        state, metrics = fns.iteration(state)
+    after = _params_l2(state.params.actor)
+    m = {k: float(v) for k, v in metrics.items()}
+    assert np.isfinite(list(m.values())).all(), m
+    assert after != before
+    assert int(state.step) == 3
+    assert m["replay_size"] == 3 * 4 * (8 // len(jax.devices()))
+
+
+def test_ddpg_warmup_blocks_updates():
+    fns = ddpg.make_ddpg(_cfg(warmup_env_steps=10**9))
+    state = fns.init(jax.random.PRNGKey(0))
+    before = _params_l2(state.params.actor)
+    state, metrics = fns.iteration(state)
+    assert _params_l2(state.params.actor) == before
+    assert float(metrics["q_loss"]) == 0.0
+
+
+def test_ddpg_determinism():
+    fns = ddpg.make_ddpg(_cfg())
+
+    def run(seed):
+        state = fns.init(jax.random.PRNGKey(seed))
+        out = []
+        for _ in range(3):
+            state, metrics = fns.iteration(state)
+            jax.block_until_ready(metrics)
+            out.append(float(metrics["q_loss"]))
+        return out
+
+    assert run(0) == run(0)
+    assert run(0) != run(1)
+
+
+def test_ddpg_target_networks_lag():
+    fns = ddpg.make_ddpg(_cfg(warmup_env_steps=0))
+    state = fns.init(jax.random.PRNGKey(0))
+    state, _ = fns.iteration(state)
+    state, _ = fns.iteration(state)
+    # Targets moved (polyak) but stay distinct from online nets.
+    assert _params_l2(state.params.target_actor) != _params_l2(state.params.actor)
+
+
+@pytest.mark.slow
+def test_ddpg_learns_pendulum():
+    """Pendulum greedy-eval return improves well past random (~-1200)."""
+    cfg = _cfg(
+        num_envs=8,
+        steps_per_iter=8,
+        updates_per_iter=8,
+        total_env_steps=60_000,
+        warmup_env_steps=1_000,
+        replay_capacity=60_000,
+    )
+    fns = ddpg.make_ddpg(cfg)
+    state, _ = common.run_loop(
+        fns, total_env_steps=cfg.total_env_steps, seed=0,
+        log_interval_iters=10**9,
+    )
+
+    env, params = envs_lib.make("Pendulum-v1", num_envs=16)
+    actor = DeterministicActor(1)
+
+    def act(obs, key):
+        return actor.apply(state.params.actor, obs) * 2.0
+
+    mean_ret, _, frac_done = jax.jit(
+        lambda key: common.evaluate(env, params, act, key, num_envs=16, max_steps=200)
+    )(jax.random.PRNGKey(1))
+    assert float(frac_done) == 1.0
+    assert float(mean_ret) > -400.0, float(mean_ret)

@@ -1,0 +1,108 @@
+"""IMPALA: queue machinery, learner step, and the in-process
+actor/learner topology (SURVEY.md §4.3)."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from actor_critic_algs_on_tensorflow_tpu.algos import impala
+from actor_critic_algs_on_tensorflow_tpu.distributed.queue import (
+    TrajectoryQueue,
+)
+
+
+def _cfg(**kw):
+    base = dict(
+        env="CartPole-v1",
+        num_actors=2,
+        envs_per_actor=4,
+        rollout_length=8,
+        batch_trajectories=2,
+        queue_size=4,
+        total_env_steps=2 * 4 * 8 * 5,  # 5 learner steps
+    )
+    base.update(kw)
+    return impala.ImpalaConfig(**base)
+
+
+def test_queue_stats_and_backpressure():
+    q = TrajectoryQueue(maxsize=2, watchdog_timeout_s=60)
+    q.put(1)
+    q.put(2)
+    assert q.depth() == 2
+    got = [q.get(), q.get()]
+    assert got == [1, 2]
+    m = q.metrics()
+    assert m["queue_puts"] == 2 and m["queue_gets"] == 2
+    q.close()
+
+
+def test_queue_watchdog_flags_starvation():
+    q = TrajectoryQueue(maxsize=2, watchdog_timeout_s=0.4)
+    time.sleep(1.0)  # nobody produces -> "actors stalled"
+    assert any("actors stalled" in a for a in q.watchdog_alerts)
+    q.close()
+
+
+def test_learner_step_shapes_and_finiteness():
+    cfg = _cfg()
+    init, learner_step, make_actor, mesh = impala.make_impala(cfg)
+    actor_rollout, env_reset = make_actor(0)
+    state = init(jax.random.PRNGKey(0))
+    env_state, obs = env_reset(jax.random.PRNGKey(1))
+    trajs = []
+    for i in range(cfg.batch_trajectories):
+        env_state, obs, traj, ep = actor_rollout(
+            state.params, env_state, obs, jax.random.PRNGKey(i)
+        )
+        trajs.append(traj)
+    batch = impala.stack_trajectories(trajs)
+    assert batch.rewards.shape == (
+        cfg.rollout_length,
+        cfg.batch_trajectories * cfg.envs_per_actor,
+    )
+    state2, metrics = learner_step(state, batch)
+    m = {k: float(v) for k, v in metrics.items()}
+    assert np.isfinite(list(m.values())).all(), m
+    assert int(state2.step) == 1
+    # On-policy data => importance ratios == 1.
+    np.testing.assert_allclose(m["mean_rho"], 1.0, rtol=1e-5)
+
+
+def test_run_impala_end_to_end():
+    """Async actors + learner drain the step budget; params get published."""
+    cfg = _cfg()
+    logs = []
+    state, history = impala.run_impala(
+        cfg, log_interval=1, log_fn=lambda s, m: logs.append((s, m))
+    )
+    assert int(state.step) == 5
+    assert len(history) == 5
+    final = history[-1][1]
+    assert final["param_version"] >= 1
+    assert final["queue_gets"] >= 5 * cfg.batch_trajectories
+    assert np.isfinite(final["loss"])
+    # All actor/learner threads shut down cleanly.
+    assert not any(
+        t.name.startswith("impala-actor") and t.is_alive()
+        for t in threading.enumerate()
+    )
+
+
+@pytest.mark.slow
+def test_impala_learns_cartpole():
+    cfg = _cfg(
+        num_actors=4,
+        envs_per_actor=8,
+        rollout_length=16,
+        batch_trajectories=4,
+        total_env_steps=400_000,
+        ent_coef=0.005,
+    )
+    state, history = impala.run_impala(cfg, log_interval=50)
+    returns = [m.get("avg_return", 0.0) for _, m in history[-3:]]
+    assert max(returns) > 150.0, history[-3:]

@@ -1,0 +1,104 @@
+"""Replay ring-buffer semantics (SURVEY.md §4.1): wraparound, sampling."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from actor_critic_algs_on_tensorflow_tpu.data.replay import ReplayBuffer
+
+
+def _items(lo, hi):
+    return {
+        "x": jnp.arange(lo, hi, dtype=jnp.float32),
+        "y": jnp.stack([jnp.full((2,), i, jnp.int32) for i in range(lo, hi)]),
+    }
+
+
+def test_add_and_size():
+    buf = ReplayBuffer(8)
+    state = buf.init({"x": jnp.zeros(()), "y": jnp.zeros((2,), jnp.int32)})
+    state = buf.add_batch(state, _items(0, 3))
+    assert int(state.size) == 3 and int(state.insert_pos) == 3
+    np.testing.assert_array_equal(state.storage["x"][:3], [0.0, 1.0, 2.0])
+    state = buf.add_batch(state, _items(3, 8))
+    assert int(state.size) == 8 and int(state.insert_pos) == 0
+
+
+def test_wraparound_overwrites_oldest():
+    buf = ReplayBuffer(4)
+    state = buf.init({"x": jnp.zeros(())})
+    state = buf.add_batch(state, {"x": jnp.arange(3.0)})
+    state = buf.add_batch(state, {"x": jnp.arange(3.0, 6.0)})
+    # rows: [4, 5, 2, 3] (0 and 1 overwritten)
+    np.testing.assert_array_equal(state.storage["x"], [4.0, 5.0, 2.0, 3.0])
+    assert int(state.size) == 4 and int(state.insert_pos) == 2
+
+
+def test_batch_larger_than_capacity_keeps_last():
+    buf = ReplayBuffer(4)
+    state = buf.init({"x": jnp.zeros(())})
+    state = buf.add_batch(state, {"x": jnp.arange(10.0)})
+    assert int(state.size) == 4
+    # Last 4 items (6..9) survive at ring positions (0+6..9) % 4.
+    assert sorted(np.asarray(state.storage["x"]).tolist()) == [6.0, 7.0, 8.0, 9.0]
+    assert int(state.insert_pos) == 10 % 4
+
+
+def test_sample_uniform_over_valid_rows():
+    buf = ReplayBuffer(100)
+    state = buf.init({"x": jnp.zeros(())})
+    state = buf.add_batch(state, {"x": jnp.arange(10.0)})
+    batch = buf.sample(state, jax.random.PRNGKey(0), 5000)
+    vals = np.asarray(batch["x"])
+    # Never samples unwritten rows.
+    assert vals.min() >= 0.0 and vals.max() <= 9.0
+    # Roughly uniform over the 10 valid rows.
+    counts = np.bincount(vals.astype(int), minlength=10)
+    assert counts.min() > 300, counts
+
+
+def test_jit_and_donation():
+    buf = ReplayBuffer(16)
+    state = buf.init({"x": jnp.zeros((3,))})
+
+    @jax.jit
+    def step(state, batch):
+        state = buf.add_batch(state, batch)
+        return state, buf.sample(state, jax.random.PRNGKey(1), 4)
+
+    for i in range(5):
+        state, sample = step(state, {"x": jnp.ones((6, 3)) * i})
+    assert int(state.size) == 16
+    assert sample["x"].shape == (4, 3)
+
+
+def test_sharded_per_device_replay():
+    """Each device owns an independent buffer shard under shard_map."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    buf = ReplayBuffer(8)
+    n = len(jax.devices())
+    mesh = Mesh(np.asarray(jax.devices()), ("data",))
+    state = jax.vmap(lambda _: buf.init({"x": jnp.zeros(())}))(jnp.arange(n))
+
+    def local(state, batch):
+        state = jax.tree_util.tree_map(lambda x: x[0], state)
+        batch = jax.tree_util.tree_map(lambda x: x[0], batch)
+        state = buf.add_batch(state, batch)
+        return jax.tree_util.tree_map(lambda x: x[None], state)
+
+    step = jax.jit(
+        jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P("data"), P("data")),
+            out_specs=P("data"),
+            check_vma=False,
+        )
+    )
+    batch = {"x": jnp.arange(float(n * 4)).reshape(n, 4)}
+    state = step(state, batch)
+    assert state.storage["x"].shape == (n, 8)
+    np.testing.assert_array_equal(
+        np.asarray(state.storage["x"][:, :4]), np.asarray(batch["x"])
+    )

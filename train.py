@@ -1,0 +1,11 @@
+#!/usr/bin/env python
+"""Top-level train.py — the reference's user-visible entrypoint surface
+(BASELINE.json:5). Thin shim over the package CLI; see
+``actor_critic_algs_on_tensorflow_tpu/cli/train.py`` for flags and presets."""
+
+import sys
+
+from actor_critic_algs_on_tensorflow_tpu.cli.train import main
+
+if __name__ == "__main__":
+    sys.exit(main())
