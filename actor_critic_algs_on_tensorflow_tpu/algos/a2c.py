@@ -59,6 +59,7 @@ class A2CConfig:
     # instead of treating them as terminal (see ops.gae). Costs an
     # extra [T, B, obs] buffer + value forward; disable for image envs.
     time_limit_bootstrap: bool = True
+    compute_dtype: str = "float32"  # "bfloat16" runs torsos on the MXU in bf16
     seed: int = 0
     num_devices: int = 0            # 0 = all visible devices
 
@@ -85,6 +86,7 @@ def make_a2c(cfg: A2CConfig) -> common.IterationFns:
         num_actions=action_space.n,
         torso=cfg.torso,
         hidden_sizes=cfg.hidden_sizes,
+        dtype=jnp.dtype(cfg.compute_dtype),
     )
 
     num_iters = max(1, cfg.total_env_steps // (cfg.num_envs * cfg.rollout_length))

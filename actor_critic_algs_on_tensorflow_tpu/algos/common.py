@@ -248,11 +248,14 @@ def run_loop(
     checkpointer=None,
     checkpoint_interval_iters: int = 0,
     state: OnPolicyState | None = None,
+    summary_writer=None,
 ):
     """Host-side training loop: dispatch iterations, surface metrics.
 
     Returns ``(final_state, history)`` where ``history`` is a list of
     (env_steps, metrics-dict) tuples fetched at log intervals.
+    ``summary_writer`` (utils.tensorboard.SummaryWriter) additionally
+    receives every logged metric dict.
     """
     from actor_critic_algs_on_tensorflow_tpu.utils.metrics import (
         device_get_metrics,
@@ -292,6 +295,8 @@ def run_loop(
             dt = time.perf_counter() - t0
             m["steps_per_sec"] = ((it + 1) * fns.steps_per_iteration) / dt
             history.append((env_steps, m))
+            if summary_writer is not None:
+                summary_writer.add_scalars(m, env_steps)
             if log_fn is not None:
                 log_fn(env_steps, m)
             else:

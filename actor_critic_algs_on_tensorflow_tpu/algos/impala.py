@@ -73,6 +73,9 @@ class ImpalaConfig:
     lr: float = 6e-4
     lr_decay: bool = True
     gamma: float = 0.99
+    # "vtrace" = IMPALA off-policy correction; "none" = plain A3C
+    # targets (importance ratios forced to 1, i.e. async A2C/A3C mode).
+    correction: str = "vtrace"
     vtrace_lam: float = 1.0
     rho_bar: float = 1.0
     c_bar: float = 1.0
@@ -81,6 +84,10 @@ class ImpalaConfig:
     max_grad_norm: float = 40.0
     queue_size: int = 16
     publish_interval: int = 1       # learner steps between publications
+    # Dead actors are restarted (stateless recovery) up to this many
+    # times before the failure is surfaced (SURVEY.md §5).
+    max_actor_restarts: int = 2
+    compute_dtype: str = "float32"  # "bfloat16" runs the torso on the MXU in bf16
     seed: int = 0
     num_devices: int = 0
 
@@ -144,12 +151,22 @@ class ImpalaActor(threading.Thread):
         self._key = jax.random.PRNGKey(seed)
         self.rollouts = 0
         self.error: BaseException | None = None
+        self._inject_fault = threading.Event()
+
+    def inject_fault(self) -> None:
+        """Make the next rollout raise (fault-injection testing,
+        SURVEY.md §5 failure-detection row)."""
+        self._inject_fault.set()
 
     def run(self) -> None:
         try:
             self._key, k = jax.random.split(self._key)
             env_state, obs = self._reset(k)
             while not self._halt.is_set():
+                if self._inject_fault.is_set():
+                    raise RuntimeError(
+                        f"injected fault in actor {self.actor_id}"
+                    )
                 params = self._store.snapshot()
                 self._key, k = jax.random.split(self._key)
                 env_state, obs, traj, ep = self._rollout(
@@ -173,6 +190,10 @@ def make_impala(cfg: ImpalaConfig):
     shard_map program; ``make_actor_programs(actor_id)`` returns that
     actor's jitted ``(rollout, reset)`` pair.
     """
+    if cfg.correction not in ("vtrace", "none"):
+        raise ValueError(
+            f"correction must be 'vtrace' or 'none', got {cfg.correction!r}"
+        )
     mesh = make_mesh(cfg.num_devices or None)
     n_dev = device_count(mesh)
     # The learner shards the stacked env axis B = trajectories * envs.
@@ -190,6 +211,7 @@ def make_impala(cfg: ImpalaConfig):
         num_actions=action_space.n,
         torso=cfg.torso,
         hidden_sizes=cfg.hidden_sizes,
+        dtype=jnp.dtype(cfg.compute_dtype),
     )
 
     steps_per_batch = (
@@ -272,8 +294,15 @@ def make_impala(cfg: ImpalaConfig):
             _, last_value = model.apply(params, batch.last_obs)
             dist = Categorical(logits)
             target_log_probs = dist.log_prob(batch.actions)
+            if cfg.correction == "none":
+                # A3C: no importance weighting — with rho = c = 1 the
+                # V-trace recursion reduces exactly to n-step TD(lam)
+                # returns, the classic async-A2C/A3C target.
+                behaviour = jax.lax.stop_gradient(target_log_probs)
+            else:
+                behaviour = batch.behaviour_log_probs
             vt = vtrace(
-                batch.behaviour_log_probs,
+                behaviour,
                 jax.lax.stop_gradient(target_log_probs),
                 batch.rewards,
                 jax.lax.stop_gradient(values),
@@ -364,8 +393,18 @@ def run_impala(
     *,
     log_interval: int = 20,
     log_fn=None,
+    inject_failure_at: int | None = None,
+    summary_writer=None,
 ) -> Tuple[LearnerState, List[Tuple[int, Dict[str, float]]]]:
-    """Drive actors + learner until the env-step budget is consumed."""
+    """Drive actors + learner until the env-step budget is consumed.
+
+    Dead actors are detected by the learner's health check and restarted
+    statelessly (fresh env, fresh PRNG stream, newest weights) up to
+    ``cfg.max_actor_restarts`` times — the reference-era analog is
+    restarting a crashed A3C worker process (SURVEY.md §5 "failure
+    detection / elastic recovery"). ``inject_failure_at`` kills one
+    actor at that learner step to exercise the path in tests.
+    """
     from actor_critic_algs_on_tensorflow_tpu.utils.metrics import (
         device_get_metrics,
         format_metrics,
@@ -377,15 +416,36 @@ def run_impala(
     q = TrajectoryQueue(cfg.queue_size)
     stop = threading.Event()
     traj_per_batch = cfg.batch_trajectories
-    actors = [
-        ImpalaActor(
+    restarts = 0
+
+    def spawn(i: int, generation: int) -> ImpalaActor:
+        a = ImpalaActor(
             i, *make_actor_programs(i), store, q, stop,
-            seed=cfg.seed * 10_000 + i
+            seed=cfg.seed * 10_000 + generation * 1_000 + i,
         )
-        for i in range(cfg.num_actors)
-    ]
-    for a in actors:
         a.start()
+        return a
+
+    actors = [spawn(i, 0) for i in range(cfg.num_actors)]
+
+    def check_health():
+        nonlocal restarts
+        for idx, a in enumerate(actors):
+            if a.error is None:
+                continue
+            if restarts >= cfg.max_actor_restarts:
+                raise RuntimeError(
+                    f"actor {a.actor_id} died and restart budget "
+                    f"({cfg.max_actor_restarts}) is exhausted"
+                ) from a.error
+            restarts += 1
+            print(
+                f"[impala] actor {a.actor_id} died "
+                f"({type(a.error).__name__}: {a.error}); "
+                f"restart {restarts}/{cfg.max_actor_restarts}",
+                flush=True,
+            )
+            actors[idx] = spawn(a.actor_id, restarts)
 
     steps_per_batch = (
         cfg.batch_trajectories * cfg.envs_per_actor * cfg.rollout_length
@@ -395,13 +455,11 @@ def run_impala(
     t0 = time.perf_counter()
     try:
         for it in range(num_learner_steps):
+            if inject_failure_at is not None and it == inject_failure_at:
+                actors[0].inject_fault()
             trajs, eps = [], []
             while len(trajs) < traj_per_batch:
-                for a in actors:
-                    if a.error is not None:
-                        raise RuntimeError(
-                            f"actor {a.actor_id} died"
-                        ) from a.error
+                check_health()
                 try:
                     traj, ep = q.get(timeout=1.0)
                 except queue_lib.Empty:  # re-check actor health
@@ -427,7 +485,10 @@ def run_impala(
                 m["steps_per_sec"] = env_steps / (time.perf_counter() - t0)
                 m.update(q.metrics())
                 m["param_version"] = store.version
+                m["actor_restarts"] = restarts
                 history.append((env_steps, m))
+                if summary_writer is not None:
+                    summary_writer.add_scalars(m, env_steps)
                 if log_fn is not None:
                     log_fn(env_steps, m)
                 else:

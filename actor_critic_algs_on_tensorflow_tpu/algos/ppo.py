@@ -73,6 +73,7 @@ class PPOConfig:
     num_minibatches: int = 4
     normalize_adv: bool = True
     time_limit_bootstrap: bool = True
+    compute_dtype: str = "float32"  # "bfloat16" runs torsos on the MXU in bf16
     seed: int = 0
     num_devices: int = 0            # 0 = all visible devices
 
@@ -105,11 +106,13 @@ def make_ppo(cfg: PPOConfig) -> common.IterationFns:
             num_actions=action_space.n,
             torso=cfg.torso,
             hidden_sizes=cfg.hidden_sizes,
+            dtype=jnp.dtype(cfg.compute_dtype),
         )
     else:
         model = GaussianActorCritic(
             action_dim=action_space.shape[-1],
             hidden_sizes=cfg.hidden_sizes,
+            dtype=jnp.dtype(cfg.compute_dtype),
         )
 
     def dist_and_value(params, obs):

@@ -132,6 +132,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--resume", action="store_true",
                    help="restore latest checkpoint from --checkpoint-dir")
     p.add_argument("--log-interval", type=int, default=20)
+    p.add_argument("--tensorboard-dir", default=None,
+                   help="write TensorBoard scalar event files here")
     return p
 
 
@@ -172,10 +174,27 @@ def main(argv=None) -> int:
     algo, cfg = make_config(args)
     print(f"[train] algo={algo} config={cfg}", flush=True)
 
+    writer = None
+    if args.tensorboard_dir:
+        from actor_critic_algs_on_tensorflow_tpu.utils.tensorboard import (
+            SummaryWriter,
+        )
+
+        writer = SummaryWriter(args.tensorboard_dir)
+    try:
+        return _run(args, algo, cfg, writer)
+    finally:
+        if writer is not None:
+            writer.close()
+
+
+def _run(args, algo, cfg, writer) -> int:
     if algo == "impala":
         from actor_critic_algs_on_tensorflow_tpu.algos.impala import run_impala
 
-        state, _ = run_impala(cfg, log_interval=args.log_interval)
+        state, _ = run_impala(
+            cfg, log_interval=args.log_interval, summary_writer=writer
+        )
         print(f"[train] done: learner steps={int(state.step)}")
         return 0
 
@@ -221,6 +240,7 @@ def main(argv=None) -> int:
         checkpointer=checkpointer,
         checkpoint_interval_iters=args.checkpoint_interval,
         state=state,
+        summary_writer=writer,
     )
     if checkpointer is not None:
         checkpointer.save(int(state.step), state)

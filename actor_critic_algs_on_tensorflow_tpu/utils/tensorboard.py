@@ -1,0 +1,221 @@
+"""Minimal TensorBoard scalar-event writer with zero TF dependency.
+
+Capability parity: the reference era logs training scalars to
+TensorBoard summaries (SURVEY.md §5 "Metrics / logging"). TensorFlow
+itself is not a dependency of this framework, so the event-file wire
+format is implemented directly — it is small and stable:
+
+  * a file of TFRecords: ``[len:u64le][masked_crc32c(len):u32le]
+    [payload][masked_crc32c(payload):u32le]``
+  * each payload is a serialized ``tensorflow.Event`` protobuf; for
+    scalars only three fields matter: ``wall_time`` (double, field 1),
+    ``step`` (int64, field 2), ``summary`` (field 5) holding
+    ``Summary.Value{tag (field 1), simple_value (field 2)}``.
+
+Anything TensorBoard-compatible (including XProf's TB frontend) can
+read the output. Scalars are written at log intervals (a few dozen
+bytes each), so pure-Python CRC32C is nowhere near any hot path.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import time
+from typing import Dict
+
+# ---- CRC32C (Castagnoli), table-driven ---------------------------------
+
+_CRC_TABLE = []
+for _i in range(256):
+    _c = _i
+    for _ in range(8):
+        _c = (_c >> 1) ^ 0x82F63B78 if _c & 1 else _c >> 1
+    _CRC_TABLE.append(_c)
+
+
+def _crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ---- tiny protobuf encoder ---------------------------------------------
+
+
+def _varint(n: int) -> bytes:
+    if n < 0:
+        # Negative varints need the 10-byte two's-complement form; no
+        # caller here (lengths, field keys, step counts) should produce
+        # one, so fail loudly instead of looping forever.
+        raise ValueError(f"negative varint not supported: {n}")
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _field_varint(num: int, value: int) -> bytes:
+    return _varint(num << 3) + _varint(value)
+
+
+def _field_double(num: int, value: float) -> bytes:
+    return _varint((num << 3) | 1) + struct.pack("<d", value)
+
+
+def _field_float(num: int, value: float) -> bytes:
+    return _varint((num << 3) | 5) + struct.pack("<f", value)
+
+
+def _field_bytes(num: int, payload: bytes) -> bytes:
+    return _varint((num << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _scalar_event(wall_time: float, step: int, tag: str, value: float) -> bytes:
+    summary_value = _field_bytes(1, tag.encode()) + _field_float(2, value)
+    summary = _field_bytes(1, summary_value)
+    return (
+        _field_double(1, wall_time)
+        + _field_varint(2, step)
+        + _field_bytes(5, summary)
+    )
+
+
+def _version_event(wall_time: float) -> bytes:
+    return _field_double(1, wall_time) + _field_bytes(3, b"brain.Event:2")
+
+
+def _record(payload: bytes) -> bytes:
+    header = struct.pack("<Q", len(payload))
+    return (
+        header
+        + struct.pack("<I", _masked_crc(header))
+        + payload
+        + struct.pack("<I", _masked_crc(payload))
+    )
+
+
+class SummaryWriter:
+    """Append-only scalar event writer: ``add_scalar`` / ``add_scalars``."""
+
+    def __init__(self, log_dir: str | os.PathLike):
+        os.makedirs(log_dir, exist_ok=True)
+        fname = (
+            f"events.out.tfevents.{int(time.time())}."
+            f"{socket.gethostname()}.{os.getpid()}.{id(self)}"
+        )
+        self._path = os.path.join(os.fspath(log_dir), fname)
+        self._f = open(self._path, "ab")
+        self._f.write(_record(_version_event(time.time())))
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def add_scalar(self, tag: str, value: float, step: int) -> None:
+        self._f.write(
+            _record(_scalar_event(time.time(), int(step), tag, float(value)))
+        )
+
+    def add_scalars(self, metrics: Dict[str, float], step: int) -> None:
+        for tag, value in metrics.items():
+            self.add_scalar(tag, value, step)
+        # Called at log intervals only — flush so live TensorBoard (and
+        # crashed runs) see every logged interval.
+        self.flush()
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.flush()
+        self._f.close()
+
+
+def read_scalars(path: str) -> Dict[str, list]:
+    """Parse scalar events back out of an event file (for tests/tools)."""
+    out: Dict[str, list] = {}
+    with open(path, "rb") as f:
+        data = f.read()
+    pos = 0
+    while pos < len(data):
+        (length,) = struct.unpack_from("<Q", data, pos)
+        (len_crc,) = struct.unpack_from("<I", data, pos + 8)
+        header = data[pos : pos + 8]
+        if _masked_crc(header) != len_crc:
+            raise ValueError(f"corrupt length CRC at byte {pos}")
+        payload = data[pos + 12 : pos + 12 + length]
+        (payload_crc,) = struct.unpack_from("<I", data, pos + 12 + length)
+        if _masked_crc(payload) != payload_crc:
+            raise ValueError(f"corrupt payload CRC at byte {pos}")
+        _parse_event(payload, out)
+        pos += 12 + length + 4
+    return out
+
+
+def _read_varint(data: bytes, pos: int):
+    n = shift = 0
+    while True:
+        b = data[pos]
+        n |= (b & 0x7F) << shift
+        pos += 1
+        if not b & 0x80:
+            return n, pos
+        shift += 7
+
+
+def _parse_event(payload: bytes, out: Dict[str, list]) -> None:
+    pos, step, summary = 0, 0, None
+    while pos < len(payload):
+        key, pos = _read_varint(payload, pos)
+        num, wire = key >> 3, key & 7
+        if wire == 0:
+            val, pos = _read_varint(payload, pos)
+            if num == 2:
+                step = val
+        elif wire == 1:
+            pos += 8
+        elif wire == 5:
+            pos += 4
+        elif wire == 2:
+            ln, pos = _read_varint(payload, pos)
+            if num == 5:
+                summary = payload[pos : pos + ln]
+            pos += ln
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+    if summary is None:
+        return
+    pos = 0
+    while pos < len(summary):
+        key, pos = _read_varint(summary, pos)
+        if key >> 3 == 1 and key & 7 == 2:
+            ln, pos = _read_varint(summary, pos)
+            value = summary[pos : pos + ln]
+            pos += ln
+            vpos, tag, scalar = 0, None, None
+            while vpos < len(value):
+                vkey, vpos = _read_varint(value, vpos)
+                if vkey >> 3 == 1 and vkey & 7 == 2:
+                    ln2, vpos = _read_varint(value, vpos)
+                    tag = value[vpos : vpos + ln2].decode()
+                    vpos += ln2
+                elif vkey >> 3 == 2 and vkey & 7 == 5:
+                    (scalar,) = struct.unpack_from("<f", value, vpos)
+                    vpos += 4
+                else:
+                    break
+            if tag is not None and scalar is not None:
+                out.setdefault(tag, []).append((step, scalar))
+        else:
+            break

@@ -56,3 +56,19 @@ def test_cli_checkpoint_resume_roundtrip(tmp_path, capsys):
     assert cli.main(common + ["--total-steps", "2048", "--resume"]) == 0
     out = capsys.readouterr().out
     assert "resumed from step" in out
+
+
+def test_cli_tensorboard_output(tmp_path):
+    from actor_critic_algs_on_tensorflow_tpu.utils import tensorboard as tb
+    import os
+
+    rc = cli.main(
+        ["--algo", "a2c", "--env", "CartPole-v1", "--total-steps", "1024",
+         "--set", "num_envs=16", "--set", "rollout_length=8",
+         "--log-interval", "4", "--tensorboard-dir", str(tmp_path / "tb")]
+    )
+    assert rc == 0
+    files = os.listdir(tmp_path / "tb")
+    assert len(files) == 1
+    scalars = tb.read_scalars(str(tmp_path / "tb" / files[0]))
+    assert "loss" in scalars and "steps_per_sec" in scalars

@@ -93,6 +93,49 @@ def test_run_impala_end_to_end():
     )
 
 
+def test_a3c_mode_matches_vtrace_on_policy():
+    """With correction="none" the learner runs plain A3C targets; on
+    on-policy data (rho == 1) the two modes produce identical losses."""
+    cfg_v = _cfg()
+    cfg_a = _cfg(correction="none")
+    init, step_v, make_actor, _ = impala.make_impala(cfg_v)
+    _, step_a, _, _ = impala.make_impala(cfg_a)
+    actor_rollout, env_reset = make_actor(0)
+    state = init(jax.random.PRNGKey(0))
+    env_state, obs = env_reset(jax.random.PRNGKey(1))
+    trajs = []
+    for i in range(cfg_v.batch_trajectories):
+        env_state, obs, traj, _ = actor_rollout(
+            state.params, env_state, obs, jax.random.PRNGKey(i)
+        )
+        trajs.append(traj)
+    batch = impala.stack_trajectories(trajs)
+    _, m_v = step_v(state, batch)
+    _, m_a = step_a(state, batch)
+    np.testing.assert_allclose(
+        float(m_v["loss"]), float(m_a["loss"]), rtol=1e-5
+    )
+
+
+def test_actor_failure_recovery():
+    """An injected actor fault is detected and the actor restarted;
+    training still completes the full step budget."""
+    cfg = _cfg(max_actor_restarts=2)
+    state, history = impala.run_impala(
+        cfg, log_interval=1, log_fn=lambda s, m: None, inject_failure_at=1
+    )
+    assert int(state.step) == 5
+
+
+def test_actor_failure_exhausts_restart_budget():
+    cfg = _cfg(max_actor_restarts=0)
+    with pytest.raises(RuntimeError, match="restart budget"):
+        impala.run_impala(
+            cfg, log_interval=10**9, log_fn=lambda s, m: None,
+            inject_failure_at=0,
+        )
+
+
 @pytest.mark.slow
 def test_impala_learns_cartpole():
     cfg = _cfg(

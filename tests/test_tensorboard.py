@@ -1,0 +1,55 @@
+"""TensorBoard event-writer wire format: CRC framing + scalar round-trip."""
+
+import zlib
+
+import numpy as np
+
+from actor_critic_algs_on_tensorflow_tpu.utils import tensorboard as tb
+
+
+def test_crc32c_known_vectors():
+    # RFC 3720 test vectors for CRC32C (Castagnoli).
+    assert tb._crc32c(b"") == 0x0
+    assert tb._crc32c(b"123456789") == 0xE3069283
+    assert tb._crc32c(bytes(32)) == 0x8A9136AA
+
+
+def test_varint_roundtrip():
+    for n in (0, 1, 127, 128, 300, 2**31, 2**60):
+        data = tb._varint(n)
+        got, pos = tb._read_varint(data, 0)
+        assert got == n and pos == len(data)
+
+
+def test_scalar_write_and_read_back(tmp_path):
+    w = tb.SummaryWriter(tmp_path)
+    for step in range(5):
+        w.add_scalars({"loss": 1.0 / (step + 1), "reward": float(step)}, step)
+    w.close()
+
+    scalars = tb.read_scalars(w.path)
+    assert set(scalars) == {"loss", "reward"}
+    steps = [s for s, _ in scalars["reward"]]
+    vals = [v for _, v in scalars["reward"]]
+    assert steps == list(range(5))
+    np.testing.assert_allclose(vals, [0.0, 1.0, 2.0, 3.0, 4.0])
+    np.testing.assert_allclose(
+        [v for _, v in scalars["loss"]],
+        [1.0, 0.5, 1 / 3, 0.25, 0.2],
+        rtol=1e-6,
+    )
+
+
+def test_corruption_detected(tmp_path):
+    w = tb.SummaryWriter(tmp_path)
+    w.add_scalar("x", 1.0, 0)
+    w.close()
+    raw = bytearray(open(w.path, "rb").read())
+    raw[-6] ^= 0xFF  # flip a payload byte
+    bad = tmp_path / "bad"
+    bad.write_bytes(bytes(raw))
+    try:
+        tb.read_scalars(str(bad))
+        raise AssertionError("expected ValueError")
+    except ValueError as e:
+        assert "CRC" in str(e)
