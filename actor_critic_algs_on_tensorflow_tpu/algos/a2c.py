@@ -60,6 +60,7 @@ class A2CConfig:
     # extra [T, B, obs] buffer + value forward; disable for image envs.
     time_limit_bootstrap: bool = True
     compute_dtype: str = "float32"  # "bfloat16" runs torsos on the MXU in bf16
+    use_pallas_scan: bool = False   # fused Pallas VMEM kernel for GAE
     seed: int = 0
     num_devices: int = 0            # 0 = all visible devices
 
@@ -141,6 +142,7 @@ def make_a2c(cfg: A2CConfig) -> common.IterationFns:
             gamma=cfg.gamma, lam=cfg.gae_lambda,
             terminations=ep_info["terminated"],
             truncation_values=truncation_values,
+            use_pallas=cfg.use_pallas_scan,
         )
         if cfg.normalize_adv:
             advantages = common.global_normalize_advantages(advantages)

@@ -88,6 +88,7 @@ class ImpalaConfig:
     # times before the failure is surfaced (SURVEY.md §5).
     max_actor_restarts: int = 2
     compute_dtype: str = "float32"  # "bfloat16" runs the torso on the MXU in bf16
+    use_pallas_scan: bool = False   # fused Pallas VMEM kernel for V-trace
     seed: int = 0
     num_devices: int = 0
 
@@ -312,6 +313,7 @@ def make_impala(cfg: ImpalaConfig):
                 lam=cfg.vtrace_lam,
                 rho_bar=cfg.rho_bar,
                 c_bar=cfg.c_bar,
+                use_pallas=cfg.use_pallas_scan,
             )
             pg = -jnp.mean(
                 target_log_probs * jax.lax.stop_gradient(vt.pg_advantages)

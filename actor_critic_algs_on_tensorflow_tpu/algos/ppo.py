@@ -74,6 +74,7 @@ class PPOConfig:
     normalize_adv: bool = True
     time_limit_bootstrap: bool = True
     compute_dtype: str = "float32"  # "bfloat16" runs torsos on the MXU in bf16
+    use_pallas_scan: bool = False   # fused Pallas VMEM kernel for GAE
     seed: int = 0
     num_devices: int = 0            # 0 = all visible devices
 
@@ -176,6 +177,7 @@ def make_ppo(cfg: PPOConfig) -> common.IterationFns:
             gamma=cfg.gamma, lam=cfg.gae_lambda,
             terminations=ep_info["terminated"],
             truncation_values=truncation_values,
+            use_pallas=cfg.use_pallas_scan,
         )
 
         batch = flatten_time_batch(

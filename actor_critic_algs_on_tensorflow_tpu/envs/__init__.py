@@ -61,26 +61,36 @@ def make(
 
     Returns ``(vec_env, params)``.
     """
-    if name.startswith("gym:"):
-        from actor_critic_algs_on_tensorflow_tpu.envs.host import HostGymEnv
-
+    if name.startswith(("native:", "gym:")):
         if frame_stack and frame_stack > 1:
             raise ValueError(
-                "frame_stack is not supported on the gym: host path; "
-                "wrap the underlying gymnasium env instead"
+                f"frame_stack is not supported on host-resident envs "
+                f"({name!r}); wrap the underlying env instead"
             )
-        env_id = name[len("gym:"):]
-        backend = "sync"
-        if env_id.startswith("async:"):
-            env_id, backend = env_id[len("async:"):], "async"
+        if name.startswith("native:"):
+            from actor_critic_algs_on_tensorflow_tpu.envs.native import (
+                NativeEnvPool,
+            )
+
+            env_id = name[len("native:"):]
+            key = ("native", env_id, num_envs)
+            ctor = lambda: NativeEnvPool(env_id, num_envs)
+        else:
+            from actor_critic_algs_on_tensorflow_tpu.envs.host import (
+                HostGymEnv,
+            )
+
+            env_id = name[len("gym:"):]
+            backend = "sync"
+            if env_id.startswith("async:"):
+                env_id, backend = env_id[len("async:"):], "async"
+            key = ("gym", env_id, num_envs, backend)
+            ctor = lambda: HostGymEnv(env_id, num_envs, backend=backend)
         if fresh:
-            return HostGymEnv(env_id, num_envs, backend=backend), None
-        cache_key = (env_id, num_envs, backend)
-        if cache_key not in _HOST_CACHE:
-            _HOST_CACHE[cache_key] = HostGymEnv(
-                env_id, num_envs, backend=backend
-            )
-        return _HOST_CACHE[cache_key], None
+            return ctor(), None
+        if key not in _HOST_CACHE:
+            _HOST_CACHE[key] = ctor()
+        return _HOST_CACHE[key], None
     if name not in _REGISTRY:
         raise KeyError(f"unknown env {name!r}; known: {sorted(_REGISTRY)}")
     env = _REGISTRY[name]()

@@ -28,6 +28,7 @@ def gae_advantages(
     lam: float = 0.95,
     terminations: jax.Array | None = None,
     truncation_values: jax.Array | None = None,
+    use_pallas: bool = False,
 ):
     """Compute GAE(lambda) advantages and value targets.
 
@@ -49,6 +50,8 @@ def gae_advantages(
         the classic biased-but-simple convention).
       truncation_values: optional ``[T, ...]`` ``V(final_obs_t)`` used
         as the bootstrap at truncated steps (pre-auto-reset obs).
+      use_pallas: compute the backward recurrence with the fused Pallas
+        VMEM kernel (ops.pallas_scan) instead of ``lax.scan``.
 
     Returns:
       ``(advantages, returns)`` each ``[T, ...]``; ``returns`` are the
@@ -72,17 +75,24 @@ def gae_advantages(
         )
     deltas = rewards + gamma * (1.0 - bootstrap_cut) * values_tp1 - values
 
-    def _step(carry, inp):
-        delta, done = inp
-        carry = delta + gamma * lam * (1.0 - done) * carry
-        return carry, carry
+    if use_pallas:
+        from actor_critic_algs_on_tensorflow_tpu.ops.pallas_scan import (
+            linear_backward_scan,
+        )
 
-    _, adv_rev = jax.lax.scan(
-        _step,
-        jnp.zeros_like(last_value),
-        (deltas[::-1], dones[::-1]),
-    )
-    advantages = adv_rev[::-1]
+        advantages = linear_backward_scan(deltas, gamma * lam * (1.0 - dones))
+    else:
+        def _step(carry, inp):
+            delta, done = inp
+            carry = delta + gamma * lam * (1.0 - done) * carry
+            return carry, carry
+
+        _, adv_rev = jax.lax.scan(
+            _step,
+            jnp.zeros_like(last_value),
+            (deltas[::-1], dones[::-1]),
+        )
+        advantages = adv_rev[::-1]
     returns = advantages + values
     return advantages, returns
 

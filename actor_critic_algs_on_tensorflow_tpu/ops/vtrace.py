@@ -42,6 +42,7 @@ def vtrace(
     rho_bar: float = 1.0,
     c_bar: float = 1.0,
     pg_rho_bar: float | None = None,
+    use_pallas: bool = False,
 ) -> VTraceOutput:
     """Compute V-trace targets and policy-gradient advantages.
 
@@ -62,17 +63,24 @@ def vtrace(
     discounts = gamma * (1.0 - dones)
     deltas = clipped_rhos * (rewards + discounts * values_tp1 - values)
 
-    def _step(acc, inp):
-        delta, discount, c = inp
-        acc = delta + discount * c * acc
-        return acc, acc
+    if use_pallas:
+        from actor_critic_algs_on_tensorflow_tpu.ops.pallas_scan import (
+            linear_backward_scan,
+        )
 
-    _, acc_rev = jax.lax.scan(
-        _step,
-        jnp.zeros_like(bootstrap_value),
-        (deltas[::-1], discounts[::-1], cs[::-1]),
-    )
-    vs_minus_v = acc_rev[::-1]
+        vs_minus_v = linear_backward_scan(deltas, discounts * cs)
+    else:
+        def _step(acc, inp):
+            delta, discount, c = inp
+            acc = delta + discount * c * acc
+            return acc, acc
+
+        _, acc_rev = jax.lax.scan(
+            _step,
+            jnp.zeros_like(bootstrap_value),
+            (deltas[::-1], discounts[::-1], cs[::-1]),
+        )
+        vs_minus_v = acc_rev[::-1]
     vs = values + vs_minus_v
 
     vs_tp1 = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)

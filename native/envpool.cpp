@@ -1,0 +1,349 @@
+// Native vectorized environment pool.
+//
+// Capability parity: the reference's env stepping bottoms out in native
+// code inside its dependencies (ALE / MuJoCo / TF's C++ runtime —
+// SURVEY.md §2.3). This is the rebuild's own native runtime piece: a
+// C++ thread-pool env stepper (envpool-style) for host-resident
+// environments, exposed through a C ABI consumed via ctypes
+// (envs/native.py) and bridged into jitted programs with the same
+// ordered-io_callback contract as the gymnasium bridge (envs/host.py).
+//
+// Semantics mirror the framework's env contract exactly (SAME_STEP
+// autoreset): at a done step the returned obs is the NEW episode's
+// first observation and final_obs carries the pre-reset successor;
+// terminated/truncated are reported separately; per-episode
+// return/length accumulate across the boundary.
+//
+// Envs implemented natively: CartPole-v1 and Pendulum-v1 with
+// gymnasium-equivalent physics, so learning curves are comparable
+// across the pure-JAX, gymnasium, and native backends.
+//
+// Build: g++ -O3 -shared -fPIC -std=c++17 -pthread envpool.cpp -o libenvpool.so
+
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr double kPi = 3.14159265358979323846;
+
+struct StepOut {
+  float reward = 0.f;
+  bool terminated = false;
+  bool truncated = false;
+};
+
+// ---- environment dynamics ------------------------------------------------
+
+class Env {
+ public:
+  virtual ~Env() = default;
+  virtual int obs_dim() const = 0;
+  virtual int action_dim() const = 0;   // 0 => discrete
+  virtual int num_actions() const = 0;  // discrete only
+  virtual float action_high() const { return 0.f; }  // continuous bound
+  virtual void reset(std::mt19937_64& rng, float* obs) = 0;
+  virtual StepOut step(const float* action, std::mt19937_64& rng,
+                       float* obs) = 0;
+};
+
+// CartPole-v1: gymnasium classic-control physics (Euler, dt=0.02),
+// termination at |x|>2.4 or |theta|>12deg, truncation at 500 steps.
+class CartPole final : public Env {
+ public:
+  int obs_dim() const override { return 4; }
+  int action_dim() const override { return 0; }
+  int num_actions() const override { return 2; }
+
+  void reset(std::mt19937_64& rng, float* obs) override {
+    std::uniform_real_distribution<double> u(-0.05, 0.05);
+    for (int i = 0; i < 4; ++i) state_[i] = u(rng);
+    t_ = 0;
+    write_obs(obs);
+  }
+
+  StepOut step(const float* action, std::mt19937_64& rng,
+               float* obs) override {
+    const double force = (action[0] > 0.5) ? 10.0 : -10.0;
+    const double x = state_[0], x_dot = state_[1];
+    const double theta = state_[2], theta_dot = state_[3];
+    const double costh = std::cos(theta), sinth = std::sin(theta);
+    const double temp =
+        (force + kPoleMassLength * theta_dot * theta_dot * sinth) / kTotalMass;
+    const double theta_acc =
+        (kGravity * sinth - costh * temp) /
+        (kLength * (4.0 / 3.0 - kMassPole * costh * costh / kTotalMass));
+    const double x_acc = temp - kPoleMassLength * theta_acc * costh / kTotalMass;
+    state_[0] = x + kDt * x_dot;
+    state_[1] = x_dot + kDt * x_acc;
+    state_[2] = theta + kDt * theta_dot;
+    state_[3] = theta_dot + kDt * theta_acc;
+    ++t_;
+    StepOut out;
+    out.reward = 1.0f;
+    out.terminated = std::abs(state_[0]) > 2.4 ||
+                     std::abs(state_[2]) > 12.0 * 2.0 * kPi / 360.0;
+    out.truncated = !out.terminated && t_ >= 500;
+    write_obs(obs);
+    return out;
+  }
+
+ private:
+  void write_obs(float* obs) const {
+    for (int i = 0; i < 4; ++i) obs[i] = static_cast<float>(state_[i]);
+  }
+  static constexpr double kGravity = 9.8, kMassCart = 1.0, kMassPole = 0.1;
+  static constexpr double kTotalMass = kMassCart + kMassPole;
+  static constexpr double kLength = 0.5;  // half pole length
+  static constexpr double kPoleMassLength = kMassPole * kLength;
+  static constexpr double kDt = 0.02;
+  double state_[4] = {0, 0, 0, 0};
+  int t_ = 0;
+};
+
+// Pendulum-v1: gymnasium physics (g=10, m=1, l=1, dt=0.05), torque in
+// [-2, 2], obs = (cos th, sin th, th_dot), truncation at 200 steps.
+class Pendulum final : public Env {
+ public:
+  int obs_dim() const override { return 3; }
+  int action_dim() const override { return 1; }
+  int num_actions() const override { return 0; }
+  float action_high() const override { return 2.f; }
+
+  void reset(std::mt19937_64& rng, float* obs) override {
+    std::uniform_real_distribution<double> uth(-kPi, kPi);
+    std::uniform_real_distribution<double> uv(-1.0, 1.0);
+    th_ = uth(rng);
+    th_dot_ = uv(rng);
+    t_ = 0;
+    write_obs(obs);
+  }
+
+  StepOut step(const float* action, std::mt19937_64& rng,
+               float* obs) override {
+    double u = std::fmin(std::fmax(static_cast<double>(action[0]), -2.0), 2.0);
+    const double th_norm = angle_normalize(th_);
+    const double cost =
+        th_norm * th_norm + 0.1 * th_dot_ * th_dot_ + 0.001 * u * u;
+    th_dot_ += (3.0 * kG / (2.0 * kL) * std::sin(th_) +
+                3.0 / (kM * kL * kL) * u) *
+               kDt;
+    th_dot_ = std::fmin(std::fmax(th_dot_, -8.0), 8.0);
+    th_ += th_dot_ * kDt;
+    ++t_;
+    StepOut out;
+    out.reward = static_cast<float>(-cost);
+    out.terminated = false;
+    out.truncated = t_ >= 200;
+    write_obs(obs);
+    return out;
+  }
+
+ private:
+  static double angle_normalize(double x) {
+    return std::fmod(x + kPi, 2.0 * kPi) < 0
+               ? std::fmod(x + kPi, 2.0 * kPi) + 2.0 * kPi - kPi
+               : std::fmod(x + kPi, 2.0 * kPi) - kPi;
+  }
+  void write_obs(float* obs) const {
+    obs[0] = static_cast<float>(std::cos(th_));
+    obs[1] = static_cast<float>(std::sin(th_));
+    obs[2] = static_cast<float>(th_dot_);
+  }
+  static constexpr double kG = 10.0, kM = 1.0, kL = 1.0, kDt = 0.05;
+  double th_ = 0, th_dot_ = 0;
+  int t_ = 0;
+};
+
+Env* make_env(const char* id) {
+  if (std::strcmp(id, "CartPole-v1") == 0) return new CartPole();
+  if (std::strcmp(id, "Pendulum-v1") == 0) return new Pendulum();
+  return nullptr;
+}
+
+// ---- thread pool ---------------------------------------------------------
+
+// Persistent worker pool: each step() call partitions the env batch
+// across workers, wakes them, and waits on a completion barrier. For
+// heavier simulators this is where the wall-clock goes; the pool keeps
+// workers warm instead of spawning threads per step.
+class Pool {
+ public:
+  Pool(int num_workers) : stop_(false), pending_(0), generation_(0) {
+    for (int w = 0; w < num_workers; ++w) {
+      workers_.emplace_back([this, w] { worker_loop(w); });
+    }
+  }
+
+  ~Pool() {
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_work_.notify_all();
+    for (auto& t : workers_) t.join();
+  }
+
+  // Run fn(worker_index) on every worker and wait for all to finish.
+  void run(std::function<void(int)> fn) {
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      fn_ = std::move(fn);
+      pending_ = static_cast<int>(workers_.size());
+      ++generation_;
+    }
+    cv_work_.notify_all();
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_done_.wait(lk, [this] { return pending_ == 0; });
+  }
+
+  int size() const { return static_cast<int>(workers_.size()); }
+
+ private:
+  void worker_loop(int w) {
+    uint64_t seen = 0;
+    for (;;) {
+      std::function<void(int)> fn;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_work_.wait(lk, [&] { return stop_ || generation_ != seen; });
+        if (stop_) return;
+        seen = generation_;
+        fn = fn_;
+      }
+      fn(w);
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        if (--pending_ == 0) cv_done_.notify_all();
+      }
+    }
+  }
+
+  std::vector<std::thread> workers_;
+  std::mutex mu_;
+  std::condition_variable cv_work_, cv_done_;
+  std::function<void(int)> fn_;
+  bool stop_;
+  int pending_;
+  uint64_t generation_;
+};
+
+// ---- pool of envs --------------------------------------------------------
+
+struct EnvPool {
+  std::vector<std::unique_ptr<Env>> envs;
+  std::vector<std::mt19937_64> rngs;
+  std::vector<float> ep_return, ep_length;
+  std::unique_ptr<Pool> pool;
+  int num_envs = 0;
+  int obs_dim = 0;
+  int act_width = 0;  // floats per action (1 for discrete)
+
+  void for_each(const std::function<void(int)>& body) {
+    const int n = num_envs, w = pool->size();
+    pool->run([&](int worker) {
+      const int lo = static_cast<int>(static_cast<int64_t>(worker) * n / w);
+      const int hi = static_cast<int>(static_cast<int64_t>(worker + 1) * n / w);
+      for (int i = lo; i < hi; ++i) body(i);
+    });
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* envpool_create(const char* env_id, int num_envs, int num_threads,
+                     uint64_t seed) {
+  if (num_envs <= 0) return nullptr;
+  auto* p = new EnvPool();
+  p->num_envs = num_envs;
+  for (int i = 0; i < num_envs; ++i) {
+    Env* e = make_env(env_id);
+    if (e == nullptr) {
+      delete p;
+      return nullptr;
+    }
+    p->envs.emplace_back(e);
+    p->rngs.emplace_back(seed * 1000003ull + static_cast<uint64_t>(i));
+  }
+  p->obs_dim = p->envs[0]->obs_dim();
+  p->act_width = p->envs[0]->action_dim() == 0 ? 1 : p->envs[0]->action_dim();
+  p->ep_return.assign(num_envs, 0.f);
+  p->ep_length.assign(num_envs, 0.f);
+  if (num_threads <= 0) num_threads = 1;
+  p->pool = std::make_unique<Pool>(num_threads);
+  return p;
+}
+
+int envpool_obs_dim(void* handle) {
+  return static_cast<EnvPool*>(handle)->obs_dim;
+}
+
+int envpool_action_dim(void* handle) {
+  return static_cast<EnvPool*>(handle)->envs[0]->action_dim();
+}
+
+int envpool_num_actions(void* handle) {
+  return static_cast<EnvPool*>(handle)->envs[0]->num_actions();
+}
+
+// Symmetric action bound for continuous envs (0 for discrete). Lives
+// next to the dynamics so Python never hardcodes per-env scales.
+float envpool_action_high(void* handle) {
+  return static_cast<EnvPool*>(handle)->envs[0]->action_high();
+}
+
+void envpool_reset(void* handle, uint64_t seed, float* obs) {
+  auto* p = static_cast<EnvPool*>(handle);
+  for (int i = 0; i < p->num_envs; ++i) {
+    p->rngs[i].seed(seed * 1000003ull + static_cast<uint64_t>(i));
+  }
+  p->for_each([&](int i) {
+    p->envs[i]->reset(p->rngs[i], obs + static_cast<int64_t>(i) * p->obs_dim);
+    p->ep_return[i] = 0.f;
+    p->ep_length[i] = 0.f;
+  });
+}
+
+// SAME_STEP autoreset step over the whole batch. All output buffers are
+// caller-allocated: obs/final_obs are [n, obs_dim]; the rest are [n].
+void envpool_step(void* handle, const float* actions, float* obs,
+                  float* reward, float* done, float* terminated,
+                  float* truncated, float* final_obs, float* ep_return,
+                  float* ep_length) {
+  auto* p = static_cast<EnvPool*>(handle);
+  const int64_t od = p->obs_dim;
+  p->for_each([&](int i) {
+    float* o = obs + i * od;
+    StepOut s = p->envs[i]->step(actions + i * p->act_width, p->rngs[i], o);
+    p->ep_return[i] += s.reward;
+    p->ep_length[i] += 1.f;
+    reward[i] = s.reward;
+    terminated[i] = s.terminated ? 1.f : 0.f;
+    truncated[i] = s.truncated ? 1.f : 0.f;
+    const bool d = s.terminated || s.truncated;
+    done[i] = d ? 1.f : 0.f;
+    ep_return[i] = p->ep_return[i];
+    ep_length[i] = p->ep_length[i];
+    std::memcpy(final_obs + i * od, o, sizeof(float) * od);
+    if (d) {
+      p->envs[i]->reset(p->rngs[i], o);  // obs becomes new episode's first
+      p->ep_return[i] = 0.f;
+      p->ep_length[i] = 0.f;
+    }
+  });
+}
+
+void envpool_destroy(void* handle) { delete static_cast<EnvPool*>(handle); }
+
+}  // extern "C"
