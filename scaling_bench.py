@@ -1,0 +1,80 @@
+"""A2C actor-scaling study: throughput and efficiency vs actor count.
+
+Reproduces the reference's scaling metric (BASELINE.json:2 — "A2C
+scaling efficiency from 8 -> 256 actors"). Actors here are vectorized
+env instances feeding the fused A2C iteration; on a pod the same sweep
+spreads them over the mesh (env axis sharded), so single-chip efficiency
+is the per-chip term of the pod-scale study.
+
+Prints one JSON line per actor count plus a summary line:
+  {"actors": N, "steps_per_sec": S, "efficiency_vs_8": E}
+Efficiency is throughput per actor normalized to the 8-actor point
+(1.0 = perfect linear scaling).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+
+
+def measure(num_envs: int, rollout: int, iters: int) -> float:
+    from actor_critic_algs_on_tensorflow_tpu.algos.a2c import (
+        A2CConfig,
+        make_a2c,
+    )
+
+    n_dev = len(jax.devices())
+    # Keep envs divisible by the mesh; below n_dev envs fall back to 1 dev.
+    devs = n_dev if num_envs % n_dev == 0 else 1
+    cfg = A2CConfig(
+        env="CartPole-v1",
+        num_envs=num_envs,
+        rollout_length=rollout,
+        total_env_steps=10**9,
+        num_devices=devs,
+    )
+    fns = make_a2c(cfg)
+    state = fns.init(jax.random.PRNGKey(0))
+    state, metrics = fns.iteration(state)
+    jax.block_until_ready(metrics)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = fns.iteration(state)
+    jax.block_until_ready(metrics)
+    dt = time.perf_counter() - t0
+    return iters * fns.steps_per_iteration / dt
+
+
+def main():
+    rollout = int(os.environ.get("SCALE_ROLLOUT", 32))
+    iters = int(os.environ.get("SCALE_ITERS", 20))
+    counts = [int(c) for c in os.environ.get(
+        "SCALE_ACTORS", "8,16,32,64,128,256"
+    ).split(",")]
+    results = []
+    base = None
+    for n in counts:
+        sps = measure(n, rollout, iters)
+        per_actor = sps / n
+        if base is None:
+            base = per_actor
+        eff = per_actor / base
+        results.append({"actors": n, "steps_per_sec": round(sps, 1),
+                        "efficiency_vs_8": round(eff, 3)})
+        print(json.dumps(results[-1]), flush=True)
+    print(json.dumps({
+        "metric": "a2c_scaling_efficiency_8_to_256",
+        "value": results[-1]["efficiency_vs_8"],
+        "unit": "fraction-of-linear",
+        "points": results,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
