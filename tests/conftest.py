@@ -20,3 +20,4 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 
 assert len(jax.devices()) == 8, jax.devices()
+

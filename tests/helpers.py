@@ -1,0 +1,30 @@
+"""Shared test helpers (imported as a plain module, not via conftest)."""
+
+import jax
+import jax.numpy as jnp
+
+from actor_critic_algs_on_tensorflow_tpu import envs as envs_lib
+from actor_critic_algs_on_tensorflow_tpu.algos import common
+from actor_critic_algs_on_tensorflow_tpu.models import DiscreteActorCritic
+
+
+def greedy_cartpole_return(params, model=None):
+    """Shared greedy-eval harness for the CartPole learning tests:
+    argmax policy over 32 envs, full 500-step horizon. ``model`` must
+    match the architecture ``params`` was trained with (defaults to the
+    stock ``DiscreteActorCritic`` the learning tests all use). Returns
+    (mean_return, fraction_of_envs_finished) as floats."""
+    env, env_params = envs_lib.make("CartPole-v1", num_envs=32)
+    if model is None:
+        model = DiscreteActorCritic(num_actions=2)
+
+    def act(obs, key):
+        logits, _ = model.apply(params, obs)
+        return jnp.argmax(logits, axis=-1)
+
+    mean_ret, _, frac_done = jax.jit(
+        lambda key: common.evaluate(
+            env, env_params, act, key, num_envs=32, max_steps=501
+        )
+    )(jax.random.PRNGKey(123))
+    return float(mean_ret), float(frac_done)

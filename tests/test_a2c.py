@@ -6,9 +6,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from actor_critic_algs_on_tensorflow_tpu import envs as envs_lib
 from actor_critic_algs_on_tensorflow_tpu.algos import a2c, common
-from actor_critic_algs_on_tensorflow_tpu.models import DiscreteActorCritic
 
 
 def _params_l2(tree):
@@ -58,6 +56,8 @@ def test_a2c_num_envs_must_divide_devices():
 def test_a2c_solves_cartpole():
     """The one cheap end-to-end learning test (SURVEY.md §4.2):
     CartPole greedy-eval return >= 195 after a bounded step budget."""
+    from helpers import greedy_cartpole_return
+
     cfg = a2c.A2CConfig(
         total_env_steps=500_000, gae_lambda=1.0, lr=1e-3, seed=0
     )
@@ -68,18 +68,6 @@ def test_a2c_solves_cartpole():
         seed=0,
         log_interval_iters=10**9,
     )
-
-    env, params = envs_lib.make("CartPole-v1", num_envs=32)
-    model = DiscreteActorCritic(num_actions=2)
-
-    def act(obs, key):
-        logits, _ = model.apply(state.params, obs)
-        return jnp.argmax(logits, axis=-1)
-
-    mean_ret, _, frac_done = jax.jit(
-        lambda key: common.evaluate(
-            env, params, act, key, num_envs=32, max_steps=501
-        )
-    )(jax.random.PRNGKey(123))
-    assert float(frac_done) == 1.0
-    assert float(mean_ret) >= 195.0, float(mean_ret)
+    mean_ret, frac_done = greedy_cartpole_return(state.params)
+    assert frac_done == 1.0
+    assert mean_ret >= 195.0, mean_ret

@@ -5,7 +5,6 @@ import threading
 import time
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -138,14 +137,23 @@ def test_actor_failure_exhausts_restart_budget():
 
 @pytest.mark.slow
 def test_impala_learns_cartpole():
+    """Greedy-eval return after training, like the A2C learning test —
+    the per-batch ``avg_return`` metric is too sparse to assert on (a
+    well-trained policy may finish zero episodes in one 256-step
+    learner batch)."""
+    from helpers import greedy_cartpole_return
+
     cfg = _cfg(
         num_actors=4,
-        envs_per_actor=8,
+        envs_per_actor=4,
         rollout_length=16,
         batch_trajectories=4,
-        total_env_steps=400_000,
-        ent_coef=0.005,
+        total_env_steps=600_000,
+        lr=1e-3,
+        ent_coef=0.01,
+        seed=0,
     )
-    state, history = impala.run_impala(cfg, log_interval=50)
-    returns = [m.get("avg_return", 0.0) for _, m in history[-3:]]
-    assert max(returns) > 150.0, history[-3:]
+    state, _ = impala.run_impala(cfg, log_interval=50)
+    mean_ret, frac_done = greedy_cartpole_return(state.params)
+    assert frac_done == 1.0
+    assert mean_ret >= 150.0, mean_ret

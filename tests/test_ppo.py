@@ -6,9 +6,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from actor_critic_algs_on_tensorflow_tpu import envs as envs_lib
 from actor_critic_algs_on_tensorflow_tpu.algos import common, ppo
-from actor_critic_algs_on_tensorflow_tpu.models import DiscreteActorCritic
 
 
 def _params_l2(tree):
@@ -98,20 +96,11 @@ def test_ppo_solves_cartpole():
         log_interval_iters=10**9,
     )
 
-    env, params = envs_lib.make("CartPole-v1", num_envs=32)
-    model = DiscreteActorCritic(num_actions=2)
+    from helpers import greedy_cartpole_return
 
-    def act(obs, key):
-        logits, _ = model.apply(state.params, obs)
-        return jnp.argmax(logits, axis=-1)
-
-    mean_ret, _, frac_done = jax.jit(
-        lambda key: common.evaluate(
-            env, params, act, key, num_envs=32, max_steps=501
-        )
-    )(jax.random.PRNGKey(123))
-    assert float(frac_done) == 1.0
-    assert float(mean_ret) >= 195.0, float(mean_ret)
+    mean_ret, frac_done = greedy_cartpole_return(state.params)
+    assert frac_done == 1.0
+    assert mean_ret >= 195.0, mean_ret
 
 
 def test_ppo_continuous_pendulum_smoke():
