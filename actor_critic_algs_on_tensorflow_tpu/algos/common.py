@@ -26,6 +26,7 @@ from actor_critic_algs_on_tensorflow_tpu.data.rollout import Trajectory
 from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
     DATA_AXIS,
     device_count,
+    donation_supported,
     put_by_specs,
     replicated_specs,
     shard_batch_specs,
@@ -79,6 +80,7 @@ def build_shard_map_iteration(
         out_specs=(specs, P()),
         check_vma=False,
     )
+    donate = donate and donation_supported()
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
 

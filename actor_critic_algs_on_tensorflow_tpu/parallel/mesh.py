@@ -13,11 +13,41 @@ Axis names:
 
 from __future__ import annotations
 
+import functools
+import os
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
+
+
+@functools.cache
+def donation_supported() -> bool:
+    """Whether jit buffer donation is safe on the active backend.
+
+    The experimental single-chip "axon" TPU plugin miscompiles donated
+    train-state pytrees for the fused on-policy iterations (runtime
+    ``INVALID_ARGUMENT: TPU backend error`` that then wedges the whole
+    TPU client), while the identical program runs correctly with
+    donation disabled. Real TPU and CPU backends are unaffected, so
+    donation stays on there (it is what lets HBM buffers — replay
+    rings, rollout storage — be reused in place across iterations).
+
+    Override with ``ACT_TPU_DONATE=0`` / ``ACT_TPU_DONATE=1``.
+    """
+    forced = os.environ.get("ACT_TPU_DONATE")
+    if forced is not None:
+        return forced.strip().lower() not in ("0", "false", "no", "off", "")
+    try:
+        from jax.extend import backend as jex_backend
+
+        version = jex_backend.get_backend().platform_version
+    except Exception:
+        # Unknown backend: donation off costs memory, not correctness.
+        return False
+    return "axon" not in version
 
 
 def make_mesh(num_devices: int | None = None, axis_name: str = DATA_AXIS) -> Mesh:

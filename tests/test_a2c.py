@@ -7,6 +7,7 @@ import numpy as np
 import pytest
 
 from actor_critic_algs_on_tensorflow_tpu.algos import a2c, common
+from helpers import greedy_cartpole_return
 
 
 def _params_l2(tree):
@@ -56,7 +57,6 @@ def test_a2c_num_envs_must_divide_devices():
 def test_a2c_solves_cartpole():
     """The one cheap end-to-end learning test (SURVEY.md §4.2):
     CartPole greedy-eval return >= 195 after a bounded step budget."""
-    from helpers import greedy_cartpole_return
 
     cfg = a2c.A2CConfig(
         total_env_steps=500_000, gae_lambda=1.0, lr=1e-3, seed=0

@@ -12,6 +12,7 @@ from actor_critic_algs_on_tensorflow_tpu.algos import impala
 from actor_critic_algs_on_tensorflow_tpu.distributed.queue import (
     TrajectoryQueue,
 )
+from helpers import greedy_cartpole_return
 
 
 def _cfg(**kw):
@@ -141,7 +142,6 @@ def test_impala_learns_cartpole():
     the per-batch ``avg_return`` metric is too sparse to assert on (a
     well-trained policy may finish zero episodes in one 256-step
     learner batch)."""
-    from helpers import greedy_cartpole_return
 
     cfg = _cfg(
         num_actors=4,

@@ -7,6 +7,7 @@ import numpy as np
 import pytest
 
 from actor_critic_algs_on_tensorflow_tpu.algos import common, ppo
+from helpers import greedy_cartpole_return
 
 
 def _params_l2(tree):
@@ -95,8 +96,6 @@ def test_ppo_solves_cartpole():
         seed=0,
         log_interval_iters=10**9,
     )
-
-    from helpers import greedy_cartpole_return
 
     mean_ret, frac_done = greedy_cartpole_return(state.params)
     assert frac_done == 1.0
