@@ -25,6 +25,14 @@ from actor_critic_algs_on_tensorflow_tpu.ops.noise import (  # noqa: F401
     ou_reset_where,
     ou_step,
 )
+from actor_critic_algs_on_tensorflow_tpu.ops.sequence_parallel import (  # noqa: F401
+    SPVTraceOutput,
+    shift_from_next,
+    sp_discounted_returns,
+    sp_gae_advantages,
+    sp_linear_backward_scan,
+    sp_vtrace,
+)
 from actor_critic_algs_on_tensorflow_tpu.ops.vtrace import (  # noqa: F401
     VTraceOutput,
     vtrace,

@@ -1,0 +1,231 @@
+"""Sequence-parallel temporal credit assignment over a mesh ``time`` axis.
+
+The rollout length T is the framework's only sequence dimension
+(SURVEY.md §5: the reference has no transformer; its "long context" is
+the trajectory the GAE(lambda)/V-trace scans walk). Single-device, that
+axis lives in one chip's HBM and one ``lax.scan``. This module makes it
+a *shardable mesh axis*: rollouts longer than one chip's memory — or
+trajectories streamed shard-wise from IMPALA actors — are partitioned
+``[T] -> D x [T/D]`` over a ``Mesh`` axis and the backward linear
+recurrence
+
+    acc_t = delta_t + decay_t * acc_{t+1},    acc_T = init
+
+is computed exactly with one local scan per device plus O(1)
+inter-device collectives on ICI (an ``all_gather`` of per-block affine
+summaries and one ``ppermute`` boundary shift) — the all-to-all
+sequence-parallel decomposition of a linear recurrence.
+
+Why this is exact: a block of the recurrence is an affine function of
+the carry entering from the future. With ``z`` the block's zero-carry
+scan and ``p`` the suffix product of decays,
+
+    acc_t = z_t + p_t * carry_in,   carry_in = acc at the block's end,
+
+so each device publishes its summary ``(A, B) = (z[0], p[0])``, folds
+the summaries of all *later* blocks onto the global ``init`` to get its
+own ``carry_in``, and finishes locally. Communication is ``[B]``-sized
+regardless of T.
+
+All functions here are collective: call them inside ``shard_map`` (or a
+``pjit`` body with the time axis sharded) with ``axis_name`` bound to
+the mesh axis that partitions time.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _axis_size(axis_name: str) -> int:
+    # psum of a concrete 1 folds to the (static) axis size.
+    return jax.lax.psum(1, axis_name)
+
+
+def shift_from_next(x: jax.Array, *, axis_name: str, last: jax.Array):
+    """Each device's successor boundary element, for time-sharded ``x``.
+
+    Device k receives device k+1's ``x[0]`` (its own ``x[L]`` in global
+    indexing); the final device receives ``last``. Used to build
+    ``V(s_{t+1})`` across shard boundaries.
+    """
+    n = _axis_size(axis_name)
+    if n == 1:
+        return jnp.asarray(last)
+    idx = jax.lax.axis_index(axis_name)
+    recv = jax.lax.ppermute(
+        x[0], axis_name, [(k, k - 1) for k in range(1, n)]
+    )
+    return jnp.where(idx == n - 1, jnp.asarray(last), recv)
+
+
+def sp_linear_backward_scan(
+    deltas: jax.Array,
+    decays: jax.Array,
+    *,
+    axis_name: str,
+    init: jax.Array | None = None,
+):
+    """Backward recurrence ``acc_t = delta_t + decay_t * acc_{t+1}``
+    with the time axis sharded over ``axis_name``.
+
+    Args:
+      deltas: ``[L, ...]`` local time-shard (global ``T = D * L``).
+      decays: ``[L, ...]`` matching decay factors.
+      axis_name: mesh axis partitioning global time.
+      init: ``[...]`` global carry entering after the LAST time step
+        (defaults to zeros, the GAE/V-trace convention).
+
+    Returns:
+      ``[L, ...]`` this device's shard of the exact global scan.
+    """
+    deltas = jnp.asarray(deltas)
+    decays = jnp.asarray(decays)
+
+    def _step(carry, inp):
+        d, c = inp
+        carry = d + c * carry
+        return carry, carry
+
+    _, z_rev = jax.lax.scan(
+        _step, jnp.zeros_like(deltas[0]), (deltas[::-1], decays[::-1])
+    )
+    z = z_rev[::-1]
+    p = jnp.cumprod(decays[::-1], axis=0)[::-1]
+
+    n = _axis_size(axis_name)
+    carry = (
+        jnp.zeros_like(deltas[0]) if init is None else
+        jnp.broadcast_to(jnp.asarray(init), deltas[0].shape).astype(deltas.dtype)
+    )
+    if n == 1:
+        return z + p * carry
+
+    summaries = jax.lax.all_gather(
+        jnp.stack([z[0], p[0]]), axis_name
+    )  # [D, 2, ...]
+    summaries_a, summaries_b = summaries[:, 0], summaries[:, 1]
+    idx = jax.lax.axis_index(axis_name)
+
+    def _fold(j, carry):
+        block = n - 1 - j  # walk blocks from the future backward
+        folded = summaries_a[block] + summaries_b[block] * carry
+        return jnp.where(block > idx, folded, carry)
+
+    carry = jax.lax.fori_loop(0, n, _fold, carry)
+    return z + p * carry
+
+
+def sp_gae_advantages(
+    rewards: jax.Array,
+    values: jax.Array,
+    dones: jax.Array,
+    last_value: jax.Array,
+    *,
+    axis_name: str,
+    gamma: float = 0.99,
+    lam: float = 0.95,
+    terminations: jax.Array | None = None,
+    truncation_values: jax.Array | None = None,
+):
+    """GAE(lambda) with the rollout axis sharded over ``axis_name``.
+
+    Semantics match ``ops.gae.gae_advantages`` exactly (including the
+    truncation-bootstrap option); inputs are the local ``[L, ...]``
+    time-shards and ``last_value`` is the GLOBAL bootstrap ``V(s_T)``
+    (only the final device consumes it).
+    """
+    rewards = jnp.asarray(rewards)
+    values = jnp.asarray(values)
+    dones = jnp.asarray(dones, dtype=rewards.dtype)
+    boundary_value = shift_from_next(
+        values, axis_name=axis_name, last=last_value
+    )
+    values_tp1 = jnp.concatenate([values[1:], boundary_value[None]], axis=0)
+    if terminations is None or truncation_values is None:
+        bootstrap_cut = dones
+    else:
+        terminations = jnp.asarray(terminations, dtype=rewards.dtype)
+        bootstrap_cut = terminations
+        truncated = dones * (1.0 - terminations)
+        values_tp1 = jnp.where(
+            truncated > 0.5, jnp.asarray(truncation_values), values_tp1
+        )
+    deltas = rewards + gamma * (1.0 - bootstrap_cut) * values_tp1 - values
+    advantages = sp_linear_backward_scan(
+        deltas, gamma * lam * (1.0 - dones), axis_name=axis_name
+    )
+    return advantages, advantages + values
+
+
+def sp_discounted_returns(
+    rewards: jax.Array,
+    dones: jax.Array,
+    last_value: jax.Array,
+    *,
+    axis_name: str,
+    gamma: float = 0.99,
+):
+    """Bootstrapped n-step returns with time sharded over ``axis_name``."""
+    rewards = jnp.asarray(rewards)
+    dones = jnp.asarray(dones, dtype=rewards.dtype)
+    return sp_linear_backward_scan(
+        rewards, gamma * (1.0 - dones), axis_name=axis_name, init=last_value
+    )
+
+
+class SPVTraceOutput(NamedTuple):
+    vs: jax.Array
+    pg_advantages: jax.Array
+    rhos: jax.Array
+
+
+def sp_vtrace(
+    behaviour_log_probs: jax.Array,
+    target_log_probs: jax.Array,
+    rewards: jax.Array,
+    values: jax.Array,
+    dones: jax.Array,
+    bootstrap_value: jax.Array,
+    *,
+    axis_name: str,
+    gamma: float = 0.99,
+    lam: float = 1.0,
+    rho_bar: float = 1.0,
+    c_bar: float = 1.0,
+    pg_rho_bar: float | None = None,
+) -> SPVTraceOutput:
+    """V-trace (Espeholt et al. 2018 eqs. 1-2) with the trajectory axis
+    sharded over ``axis_name``; semantics match ``ops.vtrace.vtrace``.
+    """
+    rewards = jnp.asarray(rewards)
+    values = jnp.asarray(values)
+    bootstrap_value = jnp.asarray(bootstrap_value)
+    dones = jnp.asarray(dones, dtype=rewards.dtype)
+    rhos = jnp.exp(target_log_probs - behaviour_log_probs)
+    clipped_rhos = jnp.minimum(rho_bar, rhos)
+    cs = lam * jnp.minimum(c_bar, rhos)
+
+    boundary_value = shift_from_next(
+        values, axis_name=axis_name, last=bootstrap_value
+    )
+    values_tp1 = jnp.concatenate([values[1:], boundary_value[None]], axis=0)
+    discounts = gamma * (1.0 - dones)
+    deltas = clipped_rhos * (rewards + discounts * values_tp1 - values)
+    vs_minus_v = sp_linear_backward_scan(
+        deltas, discounts * cs, axis_name=axis_name
+    )
+    vs = values + vs_minus_v
+
+    boundary_vs = shift_from_next(
+        vs, axis_name=axis_name, last=bootstrap_value
+    )
+    vs_tp1 = jnp.concatenate([vs[1:], boundary_vs[None]], axis=0)
+    clipped_pg_rhos = jnp.minimum(
+        rho_bar if pg_rho_bar is None else pg_rho_bar, rhos
+    )
+    pg_advantages = clipped_pg_rhos * (rewards + discounts * vs_tp1 - values)
+    return SPVTraceOutput(vs=vs, pg_advantages=pg_advantages, rhos=rhos)

@@ -1,0 +1,163 @@
+"""Sequence-parallel temporal scans must exactly match their
+single-device counterparts when the time axis is sharded over the
+8-device mesh (SURVEY.md §4.3 discipline: distributed correctness
+without a pod)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from actor_critic_algs_on_tensorflow_tpu.ops import (
+    discounted_returns,
+    gae_advantages,
+    sp_discounted_returns,
+    sp_gae_advantages,
+    sp_linear_backward_scan,
+    sp_vtrace,
+    vtrace,
+)
+
+TIME = "time"
+T, B = 64, 16  # global rollout length, batch
+
+
+def time_mesh(n=8):
+    return Mesh(np.asarray(jax.devices()[:n]), (TIME,))
+
+
+def sharded_call(fn, mesh, n_in, n_out, **kw):
+    """shard_map wrapper: first n_in args time-sharded, rest replicated."""
+    return shard_map(
+        functools.partial(fn, **kw),
+        mesh=mesh,
+        in_specs=tuple([P(TIME)] * n_in + [P()]),
+        out_specs=tuple([P(TIME)] * n_out) if n_out > 1 else P(TIME),
+        check_vma=False,
+    )
+
+
+def rollout_data(key, with_ratios=False):
+    ks = jax.random.split(key, 8)
+    rewards = jax.random.normal(ks[0], (T, B))
+    values = jax.random.normal(ks[1], (T, B))
+    dones = (jax.random.uniform(ks[2], (T, B)) < 0.15).astype(jnp.float32)
+    last_value = jax.random.normal(ks[3], (B,))
+    if not with_ratios:
+        return rewards, values, dones, last_value
+    behaviour = jax.random.normal(ks[4], (T, B))
+    target = behaviour + 0.3 * jax.random.normal(ks[5], (T, B))
+    return rewards, values, dones, last_value, behaviour, target
+
+
+def test_sp_linear_backward_scan_matches_scan():
+    key = jax.random.PRNGKey(0)
+    deltas = jax.random.normal(key, (T, B))
+    decays = jax.random.uniform(jax.random.fold_in(key, 1), (T, B), minval=0.3, maxval=1.0)
+    init = jax.random.normal(jax.random.fold_in(key, 2), (B,))
+
+    def _step(carry, inp):
+        d, c = inp
+        carry = d + c * carry
+        return carry, carry
+
+    _, ref_rev = jax.lax.scan(_step, init, (deltas[::-1], decays[::-1]))
+    ref = ref_rev[::-1]
+
+    mesh = time_mesh()
+
+    def sp(d, c, i):
+        return sp_linear_backward_scan(d, c, axis_name=TIME, init=i)
+
+    got = sharded_call(sp, mesh, 2, 1)(deltas, decays, init)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_sp_gae_matches_single_device():
+    rewards, values, dones, last_value = rollout_data(jax.random.PRNGKey(1))
+    ref_adv, ref_ret = gae_advantages(rewards, values, dones, last_value)
+
+    mesh = time_mesh()
+    adv, ret = sharded_call(
+        sp_gae_advantages, mesh, 3, 2, axis_name=TIME
+    )(rewards, values, dones, last_value)
+    np.testing.assert_allclose(np.asarray(adv), np.asarray(ref_adv), rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(ret), np.asarray(ref_ret), rtol=2e-5, atol=2e-5)
+
+
+def test_sp_gae_truncation_bootstrap_matches():
+    rewards, values, dones, last_value = rollout_data(jax.random.PRNGKey(2))
+    key = jax.random.PRNGKey(3)
+    terminations = dones * (jax.random.uniform(key, (T, B)) < 0.5)
+    trunc_values = jax.random.normal(jax.random.fold_in(key, 1), (T, B))
+    ref_adv, ref_ret = gae_advantages(
+        rewards, values, dones, last_value,
+        terminations=terminations, truncation_values=trunc_values,
+    )
+    mesh = time_mesh()
+
+    def sp(rew, val, don, term, tv, last):
+        return sp_gae_advantages(
+            rew, val, don, last, axis_name=TIME,
+            terminations=term, truncation_values=tv,
+        )
+
+    adv, ret = shard_map(
+        sp, mesh=mesh,
+        in_specs=(P(TIME),) * 5 + (P(),),
+        out_specs=(P(TIME), P(TIME)),
+        check_vma=False,
+    )(rewards, values, dones, terminations, trunc_values, last_value)
+    np.testing.assert_allclose(np.asarray(adv), np.asarray(ref_adv), rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(ret), np.asarray(ref_ret), rtol=2e-5, atol=2e-5)
+
+
+def test_sp_discounted_returns_matches():
+    rewards, _, dones, last_value = rollout_data(jax.random.PRNGKey(4))
+    ref = discounted_returns(rewards, dones, last_value)
+    mesh = time_mesh()
+    got = sharded_call(
+        sp_discounted_returns, mesh, 2, 1, axis_name=TIME
+    )(rewards, dones, last_value)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_sp_vtrace_matches():
+    rewards, values, dones, last_value, behaviour, target = rollout_data(
+        jax.random.PRNGKey(5), with_ratios=True
+    )
+    ref = vtrace(
+        behaviour, target, rewards, values, dones, last_value,
+        rho_bar=1.0, c_bar=1.0, lam=0.9,
+    )
+    mesh = time_mesh()
+
+    def sp(blp, tlp, rew, val, don, boot):
+        return tuple(sp_vtrace(
+            blp, tlp, rew, val, don, boot, axis_name=TIME,
+            rho_bar=1.0, c_bar=1.0, lam=0.9,
+        ))
+
+    vs, pg, rhos = shard_map(
+        sp, mesh=mesh,
+        in_specs=(P(TIME),) * 5 + (P(),),
+        out_specs=(P(TIME),) * 3,
+        check_vma=False,
+    )(behaviour, target, rewards, values, dones, last_value)
+    np.testing.assert_allclose(np.asarray(vs), np.asarray(ref.vs), rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(pg), np.asarray(ref.pg_advantages), rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(rhos), np.asarray(ref.rhos), rtol=2e-5, atol=2e-5)
+
+
+def test_sp_single_shard_degenerates_to_scan():
+    """n=1 mesh: the sp path must still be exact (no collectives)."""
+    rewards, values, dones, last_value = rollout_data(jax.random.PRNGKey(6))
+    ref_adv, _ = gae_advantages(rewards, values, dones, last_value)
+    mesh = Mesh(np.asarray(jax.devices()[:1]), (TIME,))
+    adv, _ = sharded_call(
+        sp_gae_advantages, mesh, 3, 2, axis_name=TIME
+    )(rewards, values, dones, last_value)
+    np.testing.assert_allclose(np.asarray(adv), np.asarray(ref_adv), rtol=2e-5, atol=2e-5)
