@@ -3,10 +3,12 @@
 from actor_critic_algs_on_tensorflow_tpu.models.networks import (  # noqa: F401
     DeterministicActor,
     DiscreteActorCritic,
+    FrameTransformerEncoder,
     GaussianActorCritic,
     MLPTorso,
     NatureCNN,
     QCritic,
     SquashedGaussianActor,
+    TransformerTorso,
     TwinQCritic,
 )

@@ -16,6 +16,10 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from actor_critic_algs_on_tensorflow_tpu.ops.ring_attention import (
+    ring_attention,
+)
+
 Dtype = Any
 
 
@@ -84,11 +88,132 @@ class NatureCNN(nn.Module):
         return x.reshape(batch_shape + (self.hidden_size,))
 
 
+def _sinusoidal_positions(positions, d_model, dtype):
+    """Sinusoidal position embedding for (possibly shard-offset) indices."""
+    half = d_model // 2
+    freqs = jnp.exp(
+        -jnp.log(10_000.0) * jnp.arange(half, dtype=jnp.float32) / half
+    )
+    angles = positions.astype(jnp.float32)[..., None] * freqs
+    emb = jnp.concatenate([jnp.sin(angles), jnp.cos(angles)], axis=-1)
+    return emb.astype(dtype)
+
+
+class TransformerTorso(nn.Module):
+    """Pre-LN transformer encoder over a token sequence.
+
+    Attention runs through ``ops.ring_attention``, so the SAME module
+    serves single-device policies (``axis_name=None``, one blockwise
+    pass) and long-history policies whose token axis is sharded over a
+    mesh axis inside ``shard_map`` (``axis_name='time'`` + positions
+    offset per shard) — the framework's attention-model long-context
+    path, complementing the sequence-parallel temporal scans.
+
+    Input ``[..., L, F]`` tokens; output ``[..., d_model]`` (mean-pooled)
+    or ``[..., L, d_model]`` with ``pool=False``.
+    """
+
+    d_model: int = 128
+    num_heads: int = 4
+    num_layers: int = 2
+    mlp_ratio: int = 4
+    causal: bool = True
+    axis_name: str | None = None
+    pool: bool = True
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens):
+        batch_shape = tokens.shape[:-2]
+        seq_len, feat = tokens.shape[-2:]
+        x = tokens.reshape((-1, seq_len, feat)).astype(self.dtype)
+        x = nn.Dense(self.d_model, kernel_init=_orthogonal(), dtype=self.dtype)(x)
+        if self.axis_name is None:
+            positions = jnp.arange(seq_len)
+        else:
+            positions = (
+                jax.lax.axis_index(self.axis_name) * seq_len
+                + jnp.arange(seq_len)
+            )
+        x = x + _sinusoidal_positions(positions, self.d_model, self.dtype)
+
+        head_dim = self.d_model // self.num_heads
+        for _ in range(self.num_layers):
+            h = nn.LayerNorm(dtype=self.dtype)(x)
+            qkv = nn.Dense(
+                3 * self.d_model, kernel_init=_orthogonal(), dtype=self.dtype
+            )(h)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            shape = (x.shape[0], seq_len, self.num_heads, head_dim)
+            attn = ring_attention(
+                q.reshape(shape), k.reshape(shape), v.reshape(shape),
+                axis_name=self.axis_name, causal=self.causal,
+            )
+            attn = attn.reshape(x.shape[0], seq_len, self.d_model)
+            x = x + nn.Dense(
+                self.d_model, kernel_init=_orthogonal(), dtype=self.dtype
+            )(attn)
+            h = nn.LayerNorm(dtype=self.dtype)(x)
+            h = nn.Dense(
+                self.mlp_ratio * self.d_model,
+                kernel_init=_orthogonal(),
+                dtype=self.dtype,
+            )(h)
+            h = nn.gelu(h)
+            x = x + nn.Dense(
+                self.d_model, kernel_init=_orthogonal(), dtype=self.dtype
+            )(h)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        if self.pool:
+            x = x.mean(axis=-2)
+            if self.axis_name is not None:
+                # Local means are per-shard; equal shard lengths make
+                # their pmean the exact global-token mean.
+                x = jax.lax.pmean(x, self.axis_name)
+            return x.reshape(batch_shape + (self.d_model,))
+        return x.reshape(batch_shape + (seq_len, self.d_model))
+
+
+class FrameTransformerEncoder(nn.Module):
+    """Atari-class encoder: per-frame Nature-CNN features as tokens,
+    attended over the frame-history axis by ``TransformerTorso``.
+
+    The attention-based alternative to channel-stacked ``NatureCNN``:
+    input ``[..., 84, 84, C]`` (C stacked frames) becomes C one-channel
+    tokens, so the history length is decoupled from the conv input
+    channels and can grow to long contexts (sharded via ``axis_name``).
+    """
+
+    hidden_size: int = 256
+    d_model: int = 128
+    num_heads: int = 4
+    num_layers: int = 2
+    axis_name: str | None = None
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs):
+        frames = jnp.moveaxis(obs[..., None], -2, -4)  # [..., C, 84, 84, 1]
+        tokens = NatureCNN(hidden_size=self.hidden_size, dtype=self.dtype)(
+            frames
+        )  # [..., C, hidden]
+        return TransformerTorso(
+            d_model=self.d_model,
+            num_heads=self.num_heads,
+            num_layers=self.num_layers,
+            causal=True,
+            axis_name=self.axis_name,
+            dtype=self.dtype,
+        )(tokens)
+
+
 class DiscreteActorCritic(nn.Module):
     """Shared-torso policy + value heads for discrete action spaces.
 
     ``torso='mlp'`` gives the CartPole 2-layer MLP (BASELINE.json:7);
-    ``torso='nature_cnn'`` the Atari encoder (BASELINE.json:8).
+    ``torso='nature_cnn'`` the Atari encoder (BASELINE.json:8);
+    ``torso='frame_transformer'`` the attention-over-frame-history
+    encoder backed by ring attention.
     """
 
     num_actions: int
@@ -100,6 +225,8 @@ class DiscreteActorCritic(nn.Module):
     def __call__(self, obs):
         if self.torso == "nature_cnn":
             z = NatureCNN(dtype=self.dtype)(obs)
+        elif self.torso == "frame_transformer":
+            z = FrameTransformerEncoder(dtype=self.dtype)(obs)
         else:
             z = MLPTorso(self.hidden_sizes, dtype=self.dtype)(obs)
         logits = nn.Dense(

@@ -25,6 +25,9 @@ from actor_critic_algs_on_tensorflow_tpu.ops.noise import (  # noqa: F401
     ou_reset_where,
     ou_step,
 )
+from actor_critic_algs_on_tensorflow_tpu.ops.ring_attention import (  # noqa: F401
+    ring_attention,
+)
 from actor_critic_algs_on_tensorflow_tpu.ops.sequence_parallel import (  # noqa: F401
     SPVTraceOutput,
     shift_from_next,

@@ -1,0 +1,127 @@
+"""Ring attention: exact attention with the sequence axis sharded
+over a mesh axis.
+
+Long-context counterpart to ``ops.sequence_parallel``: where that
+module shards the rollout axis of the temporal-credit scans, this op
+shards the token axis of attention itself, so attention-based policies
+(``models.TransformerTorso``) can attend over histories longer than one
+chip's memory. The algorithm is blockwise flash-style attention with
+the KV shards rotating around the mesh ring (Liu et al. 2023, "Ring
+Attention with Blockwise Transformers"): each of the D devices holds
+``L = T / D`` queries resident, and per ring step computes one local
+[L, L] attention block against the visiting KV shard, folds it into an
+online-softmax accumulator (running max ``m``, normalizer ``l``,
+weighted sum ``o``), then forwards the KV shard to the next device with
+``ppermute`` over ICI. Compute stays on the MXU as [L, L] matmul
+blocks; communication is the KV shard per step, overlappable by XLA
+with the block matmuls; memory is O(L) per device regardless of T.
+
+With ``axis_name=None`` the same code runs as single-device blockwise
+attention (one block), so models are written once and sharded by
+wrapping in ``shard_map``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_BIG_NEG = -1e30
+
+
+def _attend_block(q, k, v, o, m, l, q_pos, kv_pos, causal, scale):
+    """Fold one KV block into the online-softmax accumulator.
+
+    q: [B, Lq, H, D]; k/v: [B, Lk, H, D]; o: [B, Lq, H, D] f32;
+    m/l: [B, Lq, H] f32; *_pos: global token positions [Lq]/[Lk].
+    """
+    scores = jnp.einsum(
+        "blhd,bmhd->bhlm", q, k, preferred_element_type=jnp.float32
+    ) * scale  # [B, H, Lq, Lk]
+    if causal:
+        allowed = q_pos[:, None] >= kv_pos[None, :]  # [Lq, Lk]
+        scores = jnp.where(allowed[None, None], scores, _BIG_NEG)
+    block_max = jnp.max(scores, axis=-1)                    # [B, H, Lq]
+    block_max = jnp.moveaxis(block_max, 1, -1)              # [B, Lq, H]
+    m_new = jnp.maximum(m, block_max)
+    # exp with the new running max; re-mask so a fully-masked row
+    # contributes exactly zero instead of exp(0).
+    p = jnp.exp(scores - jnp.moveaxis(m_new, -1, 1)[..., None])
+    if causal:
+        p = jnp.where(allowed[None, None], p, 0.0)
+    corr = jnp.exp(m - m_new)                               # [B, Lq, H]
+    l_new = l * corr + jnp.moveaxis(jnp.sum(p, axis=-1), 1, -1)
+    pv = jnp.einsum(
+        "bhlm,bmhd->blhd", p, v, preferred_element_type=jnp.float32
+    )
+    o_new = o * corr[..., None] + pv
+    return o_new, m_new, l_new
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str | None = None,
+    causal: bool = True,
+    scale: float | None = None,
+):
+    """Exact (flash-style) attention; sequence axis optionally sharded.
+
+    Args:
+      q, k, v: ``[B, L, H, D]`` local sequence shards (global length is
+        ``L * axis_size``; positions are contiguous per device, device
+        ``i`` holding ``[i*L, (i+1)*L)``).
+      axis_name: mesh axis the sequence is sharded over (call inside
+        ``shard_map``); ``None`` = single-device blockwise attention.
+      causal: apply a causal mask in GLOBAL position space.
+      scale: score scale; default ``1/sqrt(D)``.
+
+    Returns:
+      ``[B, L, H, D]`` attention output in ``q``'s dtype.
+    """
+    orig_dtype = q.dtype
+    lq = q.shape[1]
+    d = q.shape[-1]
+    scale = (1.0 / d**0.5) if scale is None else scale
+
+    n = 1 if axis_name is None else jax.lax.psum(1, axis_name)
+    idx = 0 if axis_name is None else jax.lax.axis_index(axis_name)
+    local_pos = jnp.arange(lq)
+    q_pos = idx * lq + local_pos
+
+    o = jnp.zeros(q.shape, jnp.float32)
+    m = jnp.full(q.shape[:2] + (q.shape[2],), _BIG_NEG, jnp.float32)
+    l = jnp.zeros_like(m)
+
+    if n == 1:
+        o, m, l = _attend_block(
+            q, k, v, o, m, l, q_pos, q_pos, causal, scale
+        )
+        return (o / jnp.maximum(l, 1e-30)[..., None]).astype(orig_dtype)
+
+    perm = [(i, (i - 1) % n) for i in range(n)]
+
+    def ring_step(s, carry):
+        o, m, l, k_blk, v_blk = carry
+        src = (idx + s) % n  # device the visiting KV shard started on
+        kv_pos = src * lq + local_pos
+        o, m, l = _attend_block(
+            q, k_blk, v_blk, o, m, l, q_pos, kv_pos, causal, scale
+        )
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return o, m, l, k_blk, v_blk
+
+    # n-1 attend+rotate rounds in the loop; the last visiting block is
+    # attended outside so no wasted final rotation is sent.
+    o, m, l, k_last, v_last = jax.lax.fori_loop(
+        0, n - 1, ring_step, (o, m, l, k, v)
+    )
+    last_src = (idx + n - 1) % n
+    o, m, l = _attend_block(
+        q, k_last, v_last, o, m, l, q_pos,
+        last_src * lq + local_pos, causal, scale,
+    )
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(orig_dtype)
