@@ -87,7 +87,28 @@ def replicated_specs(tree):
 
 
 def put_by_specs(tree, specs, mesh: Mesh):
-    """``device_put`` a pytree onto the mesh per a PartitionSpec pytree."""
+    """``device_put`` a pytree onto the mesh per a PartitionSpec pytree.
+
+    Host-built states can hold the SAME array object in two leaves
+    (e.g. ``FrameStack.reset`` returns its frame buffer as both
+    ``env_state.frames`` and ``obs``). ``device_put`` preserves that
+    aliasing when no resharding copy is needed (1-device mesh), and a
+    donated jit then fails with "donate the same buffer twice" — so
+    repeated leaves are copied before placement.
+    """
+    seen: set[int] = set()
+
+    def _unalias(x):
+        if isinstance(x, (jax.Array, np.ndarray)):
+            if id(x) in seen:
+                return (
+                    x.copy() if isinstance(x, np.ndarray)
+                    else jax.numpy.array(x, copy=True)
+                )
+            seen.add(id(x))
+        return x
+
+    tree = jax.tree_util.tree_map(_unalias, tree)
     shardings = jax.tree_util.tree_map(
         lambda spec: NamedSharding(mesh, spec),
         specs,
