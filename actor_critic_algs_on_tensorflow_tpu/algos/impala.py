@@ -35,6 +35,7 @@ from typing import Any, Dict, List, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from flax import struct
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -390,6 +391,75 @@ def stack_trajectories(trajs: List[ActorTrajectory]) -> ActorTrajectory:
     )
 
 
+def _learner_loop(
+    cfg: ImpalaConfig,
+    state: LearnerState,
+    learner_step,
+    q: TrajectoryQueue,
+    *,
+    publish,
+    check_health,
+    extra_metrics,
+    log_interval: int,
+    log_fn,
+    summary_writer,
+) -> Tuple[LearnerState, List[Tuple[int, Dict[str, float]]]]:
+    """Shared learner loop of the in-process and cross-process modes.
+
+    ``publish(params)`` broadcasts weights; ``check_health(it)`` is
+    called on every queue poll (restart/raise on dead actors, inject
+    faults); ``extra_metrics()`` contributes mode-specific scalars.
+    """
+    from actor_critic_algs_on_tensorflow_tpu.utils.metrics import (
+        device_get_metrics,
+        format_metrics,
+    )
+
+    steps_per_batch = (
+        cfg.batch_trajectories * cfg.envs_per_actor * cfg.rollout_length
+    )
+    num_learner_steps = max(1, cfg.total_env_steps // steps_per_batch)
+    history: List[Tuple[int, Dict[str, float]]] = []
+    t0 = time.perf_counter()
+    for it in range(num_learner_steps):
+        trajs, eps = [], []
+        while len(trajs) < cfg.batch_trajectories:
+            check_health(it)
+            try:
+                traj, ep = q.get(timeout=1.0)
+            except queue_lib.Empty:  # re-check actor health
+                continue
+            trajs.append(traj)
+            eps.append(ep)
+        batch = stack_trajectories(trajs)
+        state, metrics = learner_step(state, batch)
+        if (it + 1) % cfg.publish_interval == 0:
+            publish(state.params)
+        if (it + 1) % log_interval == 0 or it == num_learner_steps - 1:
+            m = device_get_metrics(metrics)
+            done = jnp.concatenate(
+                [jnp.asarray(e["done_episode"]).reshape(-1) for e in eps]
+            )
+            rets = jnp.concatenate(
+                [jnp.asarray(e["episode_return"]).reshape(-1) for e in eps]
+            )
+            n_ep = float(jnp.sum(done))
+            if n_ep > 0:
+                m["avg_return"] = float(jnp.sum(rets * done) / n_ep)
+            env_steps = (it + 1) * steps_per_batch
+            m["steps_per_sec"] = env_steps / (time.perf_counter() - t0)
+            m.update(q.metrics())
+            m.update(extra_metrics())
+            history.append((env_steps, m))
+            if summary_writer is not None:
+                summary_writer.add_scalars(m, env_steps)
+            if log_fn is not None:
+                log_fn(env_steps, m)
+            else:
+                print(format_metrics(env_steps, m), flush=True)
+    return state, history
+
+
 def run_impala(
     cfg: ImpalaConfig,
     *,
@@ -407,18 +477,13 @@ def run_impala(
     detection / elastic recovery"). ``inject_failure_at`` kills one
     actor at that learner step to exercise the path in tests.
     """
-    from actor_critic_algs_on_tensorflow_tpu.utils.metrics import (
-        device_get_metrics,
-        format_metrics,
-    )
-
     init, learner_step, make_actor_programs, mesh = make_impala(cfg)
     state = init(jax.random.PRNGKey(cfg.seed))
     store = ParamStore(state.params)
     q = TrajectoryQueue(cfg.queue_size)
     stop = threading.Event()
-    traj_per_batch = cfg.batch_trajectories
     restarts = 0
+    injected = False
 
     def spawn(i: int, generation: int) -> ImpalaActor:
         a = ImpalaActor(
@@ -430,8 +495,11 @@ def run_impala(
 
     actors = [spawn(i, 0) for i in range(cfg.num_actors)]
 
-    def check_health():
-        nonlocal restarts
+    def check_health(it: int):
+        nonlocal restarts, injected
+        if inject_failure_at is not None and it == inject_failure_at and not injected:
+            injected = True
+            actors[0].inject_fault()
         for idx, a in enumerate(actors):
             if a.error is None:
                 continue
@@ -449,55 +517,203 @@ def run_impala(
             )
             actors[idx] = spawn(a.actor_id, restarts)
 
-    steps_per_batch = (
-        cfg.batch_trajectories * cfg.envs_per_actor * cfg.rollout_length
-    )
-    num_learner_steps = max(1, cfg.total_env_steps // steps_per_batch)
-    history: List[Tuple[int, Dict[str, float]]] = []
-    t0 = time.perf_counter()
     try:
-        for it in range(num_learner_steps):
-            if inject_failure_at is not None and it == inject_failure_at:
-                actors[0].inject_fault()
-            trajs, eps = [], []
-            while len(trajs) < traj_per_batch:
-                check_health()
-                try:
-                    traj, ep = q.get(timeout=1.0)
-                except queue_lib.Empty:  # re-check actor health
-                    continue
-                trajs.append(traj)
-                eps.append(ep)
-            batch = stack_trajectories(trajs)
-            state, metrics = learner_step(state, batch)
-            if (it + 1) % cfg.publish_interval == 0:
-                store.publish(state.params)
-            if (it + 1) % log_interval == 0 or it == num_learner_steps - 1:
-                m = device_get_metrics(metrics)
-                done = jnp.concatenate(
-                    [e["done_episode"].reshape(-1) for e in eps]
-                )
-                rets = jnp.concatenate(
-                    [e["episode_return"].reshape(-1) for e in eps]
-                )
-                n_ep = float(jnp.sum(done))
-                if n_ep > 0:
-                    m["avg_return"] = float(jnp.sum(rets * done) / n_ep)
-                env_steps = (it + 1) * steps_per_batch
-                m["steps_per_sec"] = env_steps / (time.perf_counter() - t0)
-                m.update(q.metrics())
-                m["param_version"] = store.version
-                m["actor_restarts"] = restarts
-                history.append((env_steps, m))
-                if summary_writer is not None:
-                    summary_writer.add_scalars(m, env_steps)
-                if log_fn is not None:
-                    log_fn(env_steps, m)
-                else:
-                    print(format_metrics(env_steps, m), flush=True)
+        state, history = _learner_loop(
+            cfg, state, learner_step, q,
+            publish=store.publish,
+            check_health=check_health,
+            extra_metrics=lambda: {
+                "param_version": store.version,
+                "actor_restarts": restarts,
+            },
+            log_interval=log_interval,
+            log_fn=log_fn,
+            summary_writer=summary_writer,
+        )
     finally:
         stop.set()
         q.close()
         for a in actors:
             a.join(timeout=5.0)
+    return state, history
+
+
+# ---- cross-process mode: actors over the socket transport (DCN leg) ----
+
+def _actor_process_main(
+    cfg: ImpalaConfig, actor_id: int, host: str, port: int, seed: int
+) -> None:
+    """Entry point of one spawned actor PROCESS.
+
+    The process analog of ``ImpalaActor``: jitted rollouts on the host
+    CPU (actors never claim the learner's chips), trajectories streamed
+    to the learner over the TCP transport, weights re-fetched whenever
+    a push-ack reveals a newer published version (SURVEY.md §3.3:
+    actor ⇄ learner is the distributed-systems surface; §5 DCN row).
+    Exits cleanly when the learner closes the connection.
+    """
+    jax.config.update("jax_platforms", "cpu")
+    from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+        ActorClient,
+    )
+
+    acfg = dataclasses.replace(cfg, num_devices=1)
+    init, _, make_actor_programs, _ = make_impala(acfg)
+    rollout_fn, env_reset_fn = make_actor_programs(actor_id)
+    params_def = jax.tree_util.tree_structure(
+        jax.eval_shape(lambda k: init(k).params, jax.random.PRNGKey(0))
+    )
+    client = ActorClient(host, port)
+    try:
+        version, leaves = client.fetch_params()
+        while version == 0:  # learner has not published init weights yet
+            time.sleep(0.05)
+            version, leaves = client.fetch_params()
+        params = jax.tree_util.tree_unflatten(params_def, leaves)
+        key = jax.random.PRNGKey(seed)
+        key, k = jax.random.split(key)
+        env_state, obs = env_reset_fn(k)
+        while True:
+            key, k = jax.random.split(key)
+            env_state, obs, traj, ep = rollout_fn(params, env_state, obs, k)
+            server_version = client.push_trajectory(
+                [np.asarray(x) for x in jax.tree_util.tree_leaves(traj)],
+                [np.asarray(x) for x in jax.tree_util.tree_leaves(ep)],
+            )
+            if server_version > version:
+                version, leaves = client.fetch_params()
+                params = jax.tree_util.tree_unflatten(params_def, leaves)
+    except (ConnectionError, OSError) as e:
+        # Normal at learner shutdown (it closes the sockets); the
+        # message makes a genuine mid-training transport fault
+        # diagnosable from the actor's stderr either way.
+        print(
+            f"[impala-actor {actor_id}] transport closed: "
+            f"{type(e).__name__}: {e}",
+            flush=True,
+        )
+    finally:
+        try:
+            client.close()
+        except Exception:
+            pass
+
+
+def run_impala_distributed(
+    cfg: ImpalaConfig,
+    *,
+    log_interval: int = 20,
+    log_fn=None,
+    summary_writer=None,
+) -> Tuple[LearnerState, List[Tuple[int, Dict[str, float]]]]:
+    """IMPALA with actors in separate PROCESSES streaming trajectories
+    through ``distributed.transport`` — the same topology that spans
+    hosts over DCN (actors on actor hosts, learner on the TPU slice).
+
+    The learner-side ``TrajectoryQueue`` (bounded, watchdogged) sits
+    between the server threads and the learner loop, so backpressure
+    and starvation detection apply to remote actors unchanged. Dead
+    actor processes are restarted statelessly up to
+    ``cfg.max_actor_restarts`` times, mirroring ``run_impala``.
+    """
+    import multiprocessing as mp
+
+    from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+        LearnerServer,
+    )
+
+    init, learner_step, make_actor_programs, mesh = make_impala(cfg)
+    state = init(jax.random.PRNGKey(cfg.seed))
+
+    # Treedefs for rebuilding pytrees from wire leaves (leaf ORDER is
+    # tree_flatten order on both sides; structures match because both
+    # sides build them from the same config).
+    rollout_fn, env_reset_fn = make_actor_programs(0)
+    k0 = jax.random.PRNGKey(0)
+    es_shape, obs_shape = jax.eval_shape(env_reset_fn, k0)
+    _, _, traj_shape, ep_shape = jax.eval_shape(
+        rollout_fn, state.params, es_shape, obs_shape, k0
+    )
+    traj_def = jax.tree_util.tree_structure(traj_shape)
+    ep_def = jax.tree_util.tree_structure(ep_shape)
+
+    q = TrajectoryQueue(cfg.queue_size)
+    closing = threading.Event()
+
+    def on_trajectory(traj_leaves, ep_leaves):
+        item = (
+            jax.tree_util.tree_unflatten(traj_def, traj_leaves),
+            jax.tree_util.tree_unflatten(ep_def, ep_leaves),
+        )
+        while not closing.is_set():
+            try:
+                q.put(item, timeout=0.5)
+                return
+            except queue_lib.Full:
+                continue
+
+    server = LearnerServer(on_trajectory)
+    server.publish(jax.tree_util.tree_leaves(jax.device_get(state.params)))
+
+    ctx = mp.get_context("spawn")
+
+    def spawn(i: int, generation: int):
+        p = ctx.Process(
+            target=_actor_process_main,
+            args=(
+                cfg, i, "127.0.0.1", server.port,
+                cfg.seed * 10_000 + generation * 1_000 + i,
+            ),
+            daemon=True,
+        )
+        p.start()
+        return p
+
+    procs = [spawn(i, 0) for i in range(cfg.num_actors)]
+    restarts = 0
+
+    def check_health(it: int):
+        nonlocal restarts
+        for idx, p in enumerate(procs):
+            if p.is_alive():
+                continue
+            if restarts >= cfg.max_actor_restarts:
+                raise RuntimeError(
+                    f"actor process {idx} died (exitcode {p.exitcode}) "
+                    f"and restart budget ({cfg.max_actor_restarts}) is "
+                    f"exhausted"
+                )
+            restarts += 1
+            print(
+                f"[impala] actor process {idx} died "
+                f"(exitcode {p.exitcode}); restart "
+                f"{restarts}/{cfg.max_actor_restarts}",
+                flush=True,
+            )
+            procs[idx] = spawn(idx, restarts)
+
+    def publish(params):
+        server.publish(jax.tree_util.tree_leaves(jax.device_get(params)))
+
+    try:
+        state, history = _learner_loop(
+            cfg, state, learner_step, q,
+            publish=publish,
+            check_health=check_health,
+            extra_metrics=lambda: {
+                "param_version": server.version,
+                "actor_restarts": restarts,
+            },
+            log_interval=log_interval,
+            log_fn=log_fn,
+            summary_writer=summary_writer,
+        )
+    finally:
+        closing.set()
+        server.close()
+        q.close()
+        for p in procs:
+            p.join(timeout=5.0)
+            if p.is_alive():
+                p.terminate()
     return state, history

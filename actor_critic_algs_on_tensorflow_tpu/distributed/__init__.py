@@ -1,1 +1,15 @@
-"""distributed subpackage."""
+"""Distributed actor-learner plumbing: bounded trajectory queue with a
+starvation watchdog (in-process) and the socket transport that carries
+the same stream across process/host boundaries (the DCN leg)."""
+
+from actor_critic_algs_on_tensorflow_tpu.distributed.queue import (  # noqa: F401
+    QueueStats,
+    TrajectoryQueue,
+)
+from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (  # noqa: F401
+    ActorClient,
+    LearnerServer,
+    pack_arrays,
+    recv_msg,
+    send_msg,
+)

@@ -1,0 +1,240 @@
+"""Cross-process / cross-host trajectory transport: the DCN leg.
+
+Capability parity: the reference's distributed mode runs actors and the
+learner in separate processes/hosts with NCCL/gRPC-era transports
+(SURVEY.md §3.3: "actor ⇄ learner (per trajectory) — THE
+distributed-systems surface of the repo"; §5 "DCN/host networking for
+the IMPALA actor→learner trajectory stream and weight broadcast").
+In-process actors use ``distributed.queue.TrajectoryQueue`` directly;
+this module carries the same stream across process/host boundaries:
+
+  - ``ActorClient`` (actor process) pushes flattened trajectory pytrees
+    and pulls fresh weights.
+  - ``LearnerServer`` (learner process) ingests trajectories into a
+    callback (normally a ``TrajectoryQueue.put``) and serves the latest
+    published params.
+
+Wire format (version-tagged, pickle-free — only raw ndarray bytes and
+integer headers ever cross the socket, so a malicious peer can at worst
+send garbage data, not code):
+
+  frame   := MAGIC(4) kind(u8) tag(u64) n_arrays(u32) array*
+  array   := dtype_len(u8) dtype_str ndim(u8) dim(u64)* payload_len(u64) payload
+
+``tag`` is message-dependent: the param version for PARAMS/ACK frames,
+the count of trajectory leaves (vs trailing episode-info leaves) for
+TRAJ frames.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct as struct_lib
+import threading
+from typing import Callable, List, Sequence, Tuple
+
+import numpy as np
+
+MAGIC = b"ACTT"
+KIND_TRAJ = 1         # actor -> learner: trajectory + episode-info leaves
+KIND_ACK = 2          # learner -> actor: tag = current param version
+KIND_GET_PARAMS = 3   # actor -> learner: request weights
+KIND_PARAMS = 4       # learner -> actor: tag = version, arrays = leaves
+KIND_CLOSE = 5        # either side: orderly shutdown
+
+_HEADER = struct_lib.Struct(">4sBQI")
+_ARRAY_HEADER = struct_lib.Struct(">B")
+
+
+def pack_arrays(kind: int, tag: int, arrays: Sequence[np.ndarray]) -> bytes:
+    parts = [_HEADER.pack(MAGIC, kind, tag, len(arrays))]
+    for a in arrays:
+        a = np.asarray(a)
+        shape = a.shape  # before ascontiguousarray, which promotes 0-d to 1-d
+        a = np.ascontiguousarray(a)
+        dtype = a.dtype.str.encode()
+        parts.append(_ARRAY_HEADER.pack(len(dtype)))
+        parts.append(dtype)
+        parts.append(struct_lib.pack(">B", len(shape)))
+        parts.append(struct_lib.pack(f">{len(shape)}Q", *shape))
+        payload = a.tobytes()
+        parts.append(struct_lib.pack(">Q", len(payload)))
+        parts.append(payload)
+    return b"".join(parts)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ConnectionError("peer closed mid-frame")
+        got += r
+    return bytes(buf)
+
+
+def send_msg(
+    sock: socket.socket,
+    kind: int,
+    tag: int = 0,
+    arrays: Sequence[np.ndarray] = (),
+) -> None:
+    sock.sendall(pack_arrays(kind, tag, arrays))
+
+
+def recv_msg(sock: socket.socket) -> Tuple[int, int, List[np.ndarray]]:
+    magic, kind, tag, n = _HEADER.unpack(_recv_exact(sock, _HEADER.size))
+    if magic != MAGIC:
+        raise ConnectionError(f"bad frame magic {magic!r}")
+    arrays = []
+    for _ in range(n):
+        (dtype_len,) = _ARRAY_HEADER.unpack(_recv_exact(sock, 1))
+        dtype = np.dtype(_recv_exact(sock, dtype_len).decode())
+        (ndim,) = struct_lib.unpack(">B", _recv_exact(sock, 1))
+        shape = struct_lib.unpack(f">{ndim}Q", _recv_exact(sock, 8 * ndim))
+        (nbytes,) = struct_lib.unpack(">Q", _recv_exact(sock, 8))
+        payload = _recv_exact(sock, nbytes)
+        arrays.append(np.frombuffer(payload, dtype=dtype).reshape(shape))
+    return kind, tag, arrays
+
+
+class LearnerServer:
+    """Accept actor connections; feed trajectories to ``on_trajectory``
+    and serve the latest published weights.
+
+    ``on_trajectory(traj_leaves, ep_leaves)`` runs on the connection's
+    thread — typically a bounded ``TrajectoryQueue.put`` so the queue's
+    backpressure and starvation watchdog apply unchanged to remote
+    actors.
+    """
+
+    def __init__(
+        self,
+        on_trajectory: Callable[[List[np.ndarray], List[np.ndarray]], None],
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self._on_trajectory = on_trajectory
+        self._params_lock = threading.Lock()
+        self._param_leaves: List[np.ndarray] = []
+        self._version = 0
+        self._stopping = threading.Event()
+        self._conn_threads: List[threading.Thread] = []
+        self._conns: List[socket.socket] = []
+        self._listener = socket.create_server((host, port))
+        self._listener.settimeout(0.2)
+        self.port = self._listener.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="learner-server-accept", daemon=True
+        )
+        self._accept_thread.start()
+
+    def publish(self, param_leaves: Sequence[np.ndarray]) -> int:
+        """Publish new weights; returns the new version."""
+        with self._params_lock:
+            self._param_leaves = [np.asarray(p) for p in param_leaves]
+            self._version += 1
+            return self._version
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    def _accept_loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            self._conns.append(conn)
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,),
+                name="learner-server-conn", daemon=True,
+            )
+            t.start()
+            self._conn_threads.append(t)
+        self._listener.close()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while not self._stopping.is_set():
+                kind, tag, arrays = recv_msg(conn)
+                if kind == KIND_TRAJ:
+                    self._on_trajectory(arrays[:tag], arrays[tag:])
+                    send_msg(conn, KIND_ACK, self._version)
+                elif kind == KIND_GET_PARAMS:
+                    with self._params_lock:
+                        leaves, version = self._param_leaves, self._version
+                    send_msg(conn, KIND_PARAMS, version, leaves)
+                elif kind == KIND_CLOSE:
+                    break
+                else:
+                    raise ConnectionError(f"unknown frame kind {kind}")
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def close(self) -> None:
+        self._stopping.set()
+        # Force-close live connections so peers (and the threads blocked
+        # in recv on them) observe shutdown instead of hanging.
+        for c in self._conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._accept_thread.join(timeout=2.0)
+        for t in self._conn_threads:
+            t.join(timeout=2.0)
+
+
+class ActorClient:
+    """Actor-process side: push trajectories, pull weights."""
+
+    def __init__(self, host: str, port: int, *, connect_timeout: float = 60.0):
+        self._sock = socket.create_connection(
+            (host, port), timeout=connect_timeout
+        )
+        # Blocking I/O after connect: a stalled learner (queue-full
+        # backpressure, long jit compile) must block the actor, not
+        # time it out — backpressure is the flow control.
+        self._sock.settimeout(None)
+
+    def push_trajectory(
+        self,
+        traj_leaves: Sequence[np.ndarray],
+        ep_leaves: Sequence[np.ndarray] = (),
+    ) -> int:
+        """Send one rollout; returns the learner's current param version
+        (from the ack), so the caller knows when to re-fetch weights."""
+        arrays = [np.asarray(x) for x in traj_leaves]
+        arrays += [np.asarray(x) for x in ep_leaves]
+        send_msg(self._sock, KIND_TRAJ, len(traj_leaves), arrays)
+        kind, tag, _ = recv_msg(self._sock)
+        if kind != KIND_ACK:
+            raise ConnectionError(f"expected ACK, got kind {kind}")
+        return tag
+
+    def fetch_params(self) -> Tuple[int, List[np.ndarray]]:
+        send_msg(self._sock, KIND_GET_PARAMS)
+        kind, version, leaves = recv_msg(self._sock)
+        if kind != KIND_PARAMS:
+            raise ConnectionError(f"expected PARAMS, got kind {kind}")
+        return version, leaves
+
+    def close(self) -> None:
+        try:
+            send_msg(self._sock, KIND_CLOSE)
+        except OSError:
+            pass
+        self._sock.close()
