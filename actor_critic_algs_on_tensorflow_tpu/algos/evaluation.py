@@ -1,0 +1,152 @@
+"""Checkpoint evaluation: the reference-era "enjoy/eval script".
+
+Capability parity: TF actor-critic repos pair every train.py with an
+evaluation path that restores a checkpoint and rolls the greedy (or
+stochastic) policy (SURVEY.md L6 entry-point surface; §5
+checkpoint/resume row). TPU-native: the whole evaluation — env scan +
+policy forward — is one jitted program via ``common.evaluate``.
+
+Model reconstruction mirrors each trainer's construction in
+``make_a2c``/``make_ppo``/``make_ddpg``/``make_sac``/``make_impala``;
+if a trainer's architecture wiring changes, change ``_act_fn`` to
+match (the round-trip test in tests/test_cli.py catches drift).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from actor_critic_algs_on_tensorflow_tpu import envs as envs_lib
+from actor_critic_algs_on_tensorflow_tpu.algos import common
+from actor_critic_algs_on_tensorflow_tpu.models import (
+    DeterministicActor,
+    DiscreteActorCritic,
+    GaussianActorCritic,
+    SquashedGaussianActor,
+)
+from actor_critic_algs_on_tensorflow_tpu.ops import (
+    Categorical,
+    DiagGaussian,
+    TanhGaussian,
+)
+
+
+def _act_fn(algo: str, cfg, aspace, params, stochastic: bool):
+    """Policy action function matching the trainer's architecture."""
+    if algo in ("a2c", "ppo", "impala"):
+        if hasattr(aspace, "n"):
+            model = DiscreteActorCritic(
+                num_actions=aspace.n,
+                torso=cfg.torso,
+                hidden_sizes=cfg.hidden_sizes,
+                dtype=jnp.dtype(cfg.compute_dtype),
+            )
+
+            def act(obs, key):
+                logits, _ = model.apply(params, obs)
+                if stochastic:
+                    return Categorical(logits).sample(key)
+                return jnp.argmax(logits, axis=-1)
+        else:
+            model = GaussianActorCritic(
+                action_dim=aspace.shape[-1],
+                hidden_sizes=cfg.hidden_sizes,
+                dtype=jnp.dtype(cfg.compute_dtype),
+            )
+
+            def act(obs, key):
+                mean, log_std, _ = model.apply(params, obs)
+                if stochastic:
+                    return DiagGaussian(mean, log_std).sample(key)
+                return mean
+    elif algo == "ddpg":
+        actor = DeterministicActor(aspace.shape[-1], cfg.hidden_sizes)
+        scale = float(aspace.high)
+
+        def act(obs, key):
+            return actor.apply(params.actor, obs) * scale
+    elif algo == "sac":
+        actor = SquashedGaussianActor(aspace.shape[-1], cfg.hidden_sizes)
+        scale = float(aspace.high)
+
+        def act(obs, key):
+            mean, log_std = actor.apply(params.actor, obs)
+            if stochastic:
+                return TanhGaussian(mean, log_std).sample(key) * scale
+            return jnp.tanh(mean) * scale
+    else:
+        raise ValueError(f"unknown algo {algo!r}")
+    return act
+
+
+def _make_init(algo: str, cfg):
+    if algo == "a2c":
+        from actor_critic_algs_on_tensorflow_tpu.algos.a2c import make_a2c
+
+        return make_a2c(cfg).init
+    if algo == "ppo":
+        from actor_critic_algs_on_tensorflow_tpu.algos.ppo import make_ppo
+
+        return make_ppo(cfg).init
+    if algo == "ddpg":
+        from actor_critic_algs_on_tensorflow_tpu.algos.ddpg import make_ddpg
+
+        return make_ddpg(cfg).init
+    if algo == "sac":
+        from actor_critic_algs_on_tensorflow_tpu.algos.sac import make_sac
+
+        return make_sac(cfg).init
+    if algo == "impala":
+        from actor_critic_algs_on_tensorflow_tpu.algos.impala import (
+            make_impala,
+        )
+
+        return make_impala(cfg)[0]
+    raise ValueError(f"unknown algo {algo!r}")
+
+
+def evaluate_checkpoint(
+    algo: str,
+    cfg: Any,
+    checkpoint_dir: str,
+    *,
+    num_envs: int = 32,
+    max_steps: int = 1000,
+    stochastic: bool = False,
+    seed: int = 1234,
+) -> Tuple[float, np.ndarray, float]:
+    """Restore the latest checkpoint and roll the policy.
+
+    Returns ``(mean_return, per_env_returns, fraction_finished)`` over
+    each env's first episode (capped at ``max_steps``).
+    """
+    from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
+        Checkpointer,
+    )
+
+    ckpt = Checkpointer(checkpoint_dir)
+    if ckpt.latest_step() is None:
+        raise FileNotFoundError(f"no checkpoint in {checkpoint_dir}")
+    template = _make_init(algo, cfg)(jax.random.PRNGKey(cfg.seed))
+    state = ckpt.restore(template)
+    ckpt.close()
+
+    env, env_params = envs_lib.make(
+        cfg.env,
+        num_envs=num_envs,
+        frame_stack=getattr(cfg, "frame_stack", 0),
+    )
+    act = _act_fn(
+        algo, cfg, env.action_space(env_params), state.params, stochastic
+    )
+    mean_ret, per_env, frac = jax.jit(
+        lambda key: common.evaluate(
+            env, env_params, act, key,
+            num_envs=num_envs, max_steps=max_steps,
+        )
+    )(jax.random.PRNGKey(seed))
+    return float(mean_ret), np.asarray(per_env), float(frac)

@@ -403,6 +403,8 @@ def _learner_loop(
     log_interval: int,
     log_fn,
     summary_writer,
+    checkpointer=None,
+    checkpoint_interval: int = 200,
 ) -> Tuple[LearnerState, List[Tuple[int, Dict[str, float]]]]:
     """Shared learner loop of the in-process and cross-process modes.
 
@@ -418,10 +420,20 @@ def _learner_loop(
     steps_per_batch = (
         cfg.batch_trajectories * cfg.envs_per_actor * cfg.rollout_length
     )
-    num_learner_steps = max(1, cfg.total_env_steps // steps_per_batch)
+    # ``state.step`` counts learner iterations; total_env_steps is a
+    # global budget, so a resumed state trains only the remainder (same
+    # contract as common.run_loop). Checkpoint ids are env steps.
+    iters_done0 = int(jax.device_get(state.step))
+    steps_done0 = iters_done0 * steps_per_batch
+    num_learner_steps = (cfg.total_env_steps - steps_done0) // steps_per_batch
+    if iters_done0 == 0:
+        num_learner_steps = max(1, num_learner_steps)
+    if num_learner_steps <= 0:
+        return state, []
     history: List[Tuple[int, Dict[str, float]]] = []
     t0 = time.perf_counter()
-    for it in range(num_learner_steps):
+    for i in range(num_learner_steps):
+        it = iters_done0 + i
         trajs, eps = [], []
         while len(trajs) < cfg.batch_trajectories:
             check_health(it)
@@ -433,9 +445,16 @@ def _learner_loop(
             eps.append(ep)
         batch = stack_trajectories(trajs)
         state, metrics = learner_step(state, batch)
+        env_steps = steps_done0 + (i + 1) * steps_per_batch
         if (it + 1) % cfg.publish_interval == 0:
             publish(state.params)
-        if (it + 1) % log_interval == 0 or it == num_learner_steps - 1:
+        if (
+            checkpointer is not None
+            and checkpoint_interval
+            and (i + 1) % checkpoint_interval == 0
+        ):
+            checkpointer.save(env_steps, state)
+        if (i + 1) % log_interval == 0 or i == num_learner_steps - 1:
             m = device_get_metrics(metrics)
             done = jnp.concatenate(
                 [jnp.asarray(e["done_episode"]).reshape(-1) for e in eps]
@@ -446,8 +465,9 @@ def _learner_loop(
             n_ep = float(jnp.sum(done))
             if n_ep > 0:
                 m["avg_return"] = float(jnp.sum(rets * done) / n_ep)
-            env_steps = (it + 1) * steps_per_batch
-            m["steps_per_sec"] = env_steps / (time.perf_counter() - t0)
+            m["steps_per_sec"] = (
+                (i + 1) * steps_per_batch / (time.perf_counter() - t0)
+            )
             m.update(q.metrics())
             m.update(extra_metrics())
             history.append((env_steps, m))
@@ -467,6 +487,9 @@ def run_impala(
     log_fn=None,
     inject_failure_at: int | None = None,
     summary_writer=None,
+    checkpointer=None,
+    checkpoint_interval: int = 200,
+    initial_state: LearnerState | None = None,
 ) -> Tuple[LearnerState, List[Tuple[int, Dict[str, float]]]]:
     """Drive actors + learner until the env-step budget is consumed.
 
@@ -478,7 +501,10 @@ def run_impala(
     actor at that learner step to exercise the path in tests.
     """
     init, learner_step, make_actor_programs, mesh = make_impala(cfg)
-    state = init(jax.random.PRNGKey(cfg.seed))
+    state = (
+        initial_state if initial_state is not None
+        else init(jax.random.PRNGKey(cfg.seed))
+    )
     store = ParamStore(state.params)
     q = TrajectoryQueue(cfg.queue_size)
     stop = threading.Event()
@@ -529,6 +555,8 @@ def run_impala(
             log_interval=log_interval,
             log_fn=log_fn,
             summary_writer=summary_writer,
+            checkpointer=checkpointer,
+            checkpoint_interval=checkpoint_interval,
         )
     finally:
         stop.set()
@@ -605,6 +633,9 @@ def run_impala_distributed(
     log_interval: int = 20,
     log_fn=None,
     summary_writer=None,
+    checkpointer=None,
+    checkpoint_interval: int = 200,
+    initial_state: LearnerState | None = None,
 ) -> Tuple[LearnerState, List[Tuple[int, Dict[str, float]]]]:
     """IMPALA with actors in separate PROCESSES streaming trajectories
     through ``distributed.transport`` — the same topology that spans
@@ -623,7 +654,10 @@ def run_impala_distributed(
     )
 
     init, learner_step, make_actor_programs, mesh = make_impala(cfg)
-    state = init(jax.random.PRNGKey(cfg.seed))
+    state = (
+        initial_state if initial_state is not None
+        else init(jax.random.PRNGKey(cfg.seed))
+    )
 
     # Treedefs for rebuilding pytrees from wire leaves (leaf ORDER is
     # tree_flatten order on both sides; structures match because both
@@ -707,6 +741,8 @@ def run_impala_distributed(
             log_interval=log_interval,
             log_fn=log_fn,
             summary_writer=summary_writer,
+            checkpointer=checkpointer,
+            checkpoint_interval=checkpoint_interval,
         )
     finally:
         closing.set()

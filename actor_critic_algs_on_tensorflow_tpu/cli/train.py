@@ -143,6 +143,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--log-interval", type=int, default=20)
     p.add_argument("--tensorboard-dir", default=None,
                    help="write TensorBoard scalar event files here")
+    p.add_argument("--eval", action="store_true",
+                   help="evaluate the latest checkpoint in "
+                        "--checkpoint-dir instead of training")
+    p.add_argument("--eval-envs", type=int, default=32)
+    p.add_argument("--eval-steps", type=int, default=1000,
+                   help="max env steps per eval episode")
+    p.add_argument("--stochastic", action="store_true",
+                   help="sample the policy during --eval (default: greedy)")
+    p.add_argument("--actor-processes", action="store_true",
+                   help="impala: run actors as separate processes "
+                        "streaming over the TCP transport (the "
+                        "multi-host topology) instead of threads")
     return p
 
 
@@ -197,12 +209,90 @@ def main(argv=None) -> int:
             writer.close()
 
 
-def _run(args, algo, cfg, writer) -> int:
-    if algo == "impala":
-        from actor_critic_algs_on_tensorflow_tpu.algos.impala import run_impala
+def _open_checkpointer(args, make_template):
+    """(checkpointer, restored_state) from --checkpoint-dir/--resume.
 
-        state, _ = run_impala(
-            cfg, log_interval=args.log_interval, summary_writer=writer
+    ``make_template`` is called lazily only when a restore happens; it
+    must return a state pytree with the structure (and, where sharding
+    matters, the shardings) the restored arrays should adopt.
+    """
+    if not args.checkpoint_dir:
+        return None, None
+    from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
+        Checkpointer,
+    )
+
+    checkpointer = Checkpointer(args.checkpoint_dir)
+    state = None
+    if args.resume and checkpointer.latest_step() is not None:
+        state = checkpointer.restore(make_template())
+        print(f"[train] resumed from step {checkpointer.latest_step()}")
+    return checkpointer, state
+
+
+def _finalize_checkpointer(checkpointer, env_steps: int, state) -> None:
+    """Save the final state (unless the loop just saved this step id),
+    flush async saves, and close."""
+    if checkpointer is None:
+        return
+    if checkpointer.latest_step() != int(env_steps):
+        checkpointer.save(int(env_steps), state)
+    checkpointer.wait()
+    checkpointer.close()
+
+
+def _run(args, algo, cfg, writer) -> int:
+    if args.eval:
+        if not args.checkpoint_dir:
+            raise SystemExit("--eval requires --checkpoint-dir")
+        from actor_critic_algs_on_tensorflow_tpu.algos.evaluation import (
+            evaluate_checkpoint,
+        )
+
+        mean_ret, per_env, frac = evaluate_checkpoint(
+            algo, cfg, args.checkpoint_dir,
+            num_envs=args.eval_envs,
+            max_steps=args.eval_steps,
+            stochastic=args.stochastic,
+            seed=args.seed if args.seed is not None else 1234,
+        )
+        print(
+            f"[eval] avg_return={mean_ret:.2f} "
+            f"min={per_env.min():.2f} max={per_env.max():.2f} "
+            f"episodes_finished={frac * args.eval_envs:.0f}/{args.eval_envs}"
+        )
+        return 0
+
+    if algo == "impala":
+        from actor_critic_algs_on_tensorflow_tpu.algos.impala import (
+            make_impala,
+            run_impala,
+            run_impala_distributed,
+        )
+
+        def make_template():
+            import jax
+
+            # Structure only — restore converts to shape/dtype structs.
+            return jax.eval_shape(
+                make_impala(cfg)[0], jax.random.PRNGKey(cfg.seed)
+            )
+
+        checkpointer, initial_state = _open_checkpointer(args, make_template)
+        runner = run_impala_distributed if args.actor_processes else run_impala
+        state, _ = runner(
+            cfg,
+            log_interval=args.log_interval,
+            summary_writer=writer,
+            checkpointer=checkpointer,
+            checkpoint_interval=args.checkpoint_interval,
+            initial_state=initial_state,
+        )
+        steps_per_batch = (
+            cfg.batch_trajectories * cfg.envs_per_actor * cfg.rollout_length
+        )
+        _finalize_checkpointer(
+            checkpointer, int(state.step) * steps_per_batch, state
         )
         print(f"[train] done: learner steps={int(state.step)}")
         return 0
@@ -226,21 +316,12 @@ def _run(args, algo, cfg, writer) -> int:
 
         fns = make_sac(cfg)
 
-    checkpointer = None
-    state = None
-    if args.checkpoint_dir:
-        from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
-            Checkpointer,
-        )
+    def make_template():
+        import jax
 
-        checkpointer = Checkpointer(args.checkpoint_dir)
-        if args.resume and checkpointer.latest_step() is not None:
-            import jax
+        return fns.init(jax.random.PRNGKey(cfg.seed))
 
-            template = fns.init(jax.random.PRNGKey(cfg.seed))
-            state = checkpointer.restore(template)
-            print(f"[train] resumed from step {checkpointer.latest_step()}")
-
+    checkpointer, state = _open_checkpointer(args, make_template)
     state, history = common.run_loop(
         fns,
         total_env_steps=cfg.total_env_steps,
@@ -251,10 +332,9 @@ def _run(args, algo, cfg, writer) -> int:
         state=state,
         summary_writer=writer,
     )
-    if checkpointer is not None:
-        checkpointer.save(int(state.step), state)
-        checkpointer.wait()
-        checkpointer.close()
+    _finalize_checkpointer(
+        checkpointer, int(state.step) * fns.steps_per_iteration, state
+    )
     if history:
         final = history[-1][1]
         print(
