@@ -95,6 +95,7 @@ def collect_rollout(
     length: int,
     *,
     keep_final_obs: bool = False,
+    store_obs_fn=None,
 ):
     """Collect a ``[T, B]`` trajectory with one ``lax.scan``.
 
@@ -103,6 +104,11 @@ def collect_rollout(
     ``terminated`` mask (and, with ``keep_final_obs``, the pre-reset
     ``final_obs`` for time-limit bootstrapping — costs a full extra
     ``[T, B, obs]`` buffer, so off by default for image envs).
+
+    ``store_obs_fn`` reduces each step's obs before it is stacked into
+    the trajectory (the policy still sees the full obs) — e.g. keeping
+    only the newest frame of a frame stack so the scan never
+    materialises the redundant ``[T, B, full-stack]`` buffer.
     """
 
     def _step(carry, step_key):
@@ -113,7 +119,7 @@ def collect_rollout(
             k_env, env_state, action, env_params
         )
         traj = Trajectory(
-            obs=obs,
+            obs=obs if store_obs_fn is None else store_obs_fn(obs),
             actions=action,
             rewards=reward,
             dones=done,

@@ -28,6 +28,8 @@ from actor_critic_algs_on_tensorflow_tpu import envs as envs_lib
 from actor_critic_algs_on_tensorflow_tpu.algos import common
 from actor_critic_algs_on_tensorflow_tpu.data.rollout import (
     flatten_time_batch,
+    frame_storage_context,
+    gather_stacked_obs,
     minibatch_iter_indices,
     take_minibatch,
 )
@@ -73,6 +75,10 @@ class PPOConfig:
     num_minibatches: int = 4
     normalize_adv: bool = True
     time_limit_bootstrap: bool = True
+    # Store only the newest frame per rollout step and rebuild stacks
+    # during the update (exact; frame_stack-x smaller rollout buffer).
+    # Requires frame_stack >= 2 and time_limit_bootstrap=False.
+    compact_frames: bool = False
     compute_dtype: str = "float32"  # "bfloat16" runs torsos on the MXU in bf16
     use_pallas_scan: bool = False   # fused Pallas VMEM kernel for GAE
     seed: int = 0
@@ -154,16 +160,32 @@ def make_ppo(cfg: PPOConfig) -> common.IterationFns:
         )
         return put_by_specs(state, common.state_specs(state), mesh)
 
+    if cfg.compact_frames:
+        if cfg.frame_stack < 2:
+            raise ValueError("compact_frames requires frame_stack >= 2")
+        if cfg.time_limit_bootstrap:
+            raise ValueError(
+                "compact_frames requires time_limit_bootstrap=False "
+                "(final_obs would still store full stacks)"
+            )
+
     def local_iteration(state: common.OnPolicyState):
         dev = jax.lax.axis_index(DATA_AXIS)
         it_key = jax.random.fold_in(jax.random.fold_in(state.key, state.step), dev)
         k_roll, k_perm = jax.random.split(it_key)
 
+        if cfg.compact_frames:
+            frame_c = state.obs.shape[-1] // cfg.frame_stack
+            store_obs_fn = lambda o: o[..., -frame_c:]
+        else:
+            store_obs_fn = None
+        obs0 = state.obs
         env_state, obs, traj, ep_info = common.collect_rollout(
             env, env_params, policy_fn,
             state.params, state.env_state, state.obs, k_roll,
             cfg.rollout_length,
             keep_final_obs=cfg.time_limit_bootstrap,
+            store_obs_fn=store_obs_fn,
         )
         _, last_value = dist_and_value(state.params, obs)
         if cfg.time_limit_bootstrap:
@@ -182,7 +204,6 @@ def make_ppo(cfg: PPOConfig) -> common.IterationFns:
 
         batch = flatten_time_batch(
             {
-                "obs": traj.obs,
                 "actions": traj.actions,
                 "old_log_probs": traj.log_probs,
                 "old_values": traj.values,
@@ -190,10 +211,26 @@ def make_ppo(cfg: PPOConfig) -> common.IterationFns:
                 "returns": returns,
             }
         )
+        if cfg.compact_frames:
+            extended, resets = frame_storage_context(
+                obs0, traj.obs, traj.dones, cfg.frame_stack
+            )
+            resets_flat = resets.reshape(-1)
+
+            def minibatch_obs(idx):
+                return gather_stacked_obs(
+                    extended, resets_flat, idx, local_envs, cfg.frame_stack
+                )
+        else:
+            obs_flat = traj.obs.reshape((-1,) + traj.obs.shape[2:])
+
+            def minibatch_obs(idx):
+                return jnp.take(obs_flat, idx, axis=0)
 
         def minibatch_step(carry, idx):
             params, opt_state = carry
             mb = take_minibatch(batch, idx)
+            mb["obs"] = minibatch_obs(idx)
             adv = mb["advantages"]
             if cfg.normalize_adv:
                 adv = common.global_normalize_advantages(adv)

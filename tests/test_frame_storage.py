@@ -1,0 +1,127 @@
+"""Compact frame storage must reconstruct the exact stacks the
+FrameStack wrapper would have produced, and compact-mode PPO must be
+numerically identical to full-storage PPO."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from actor_critic_algs_on_tensorflow_tpu.data.rollout import (
+    frame_storage_context,
+    gather_stacked_obs,
+)
+
+S = 4  # stack depth
+
+
+def simulate_framestack(first_stacks, frames, dones):
+    """Reference: replay AutoReset(FrameStack) semantics in numpy.
+
+    first_stacks: [B, H, W, S] stack entering the rollout; frames:
+    [T, B, H, W, 1] newest frame per step; dones: [T, B]. Returns the
+    full stacks [T, B, H, W, S] the wrapper would emit.
+    """
+    T, B = frames.shape[:2]
+    stacks = np.empty(frames.shape[:-1] + (S,), frames.dtype)
+    cur = np.array(first_stacks)
+    for t in range(T):
+        # obs_t: current stack must end with frame_t by construction.
+        np.testing.assert_array_equal(cur[..., -1:], frames[t])
+        stacks[t] = cur
+        if t + 1 < T:
+            nxt = np.empty_like(cur)
+            for b in range(B):
+                if dones[t, b] > 0.5:
+                    # reset: stack is the new first frame repeated
+                    nxt[b] = np.repeat(frames[t + 1, b], S, axis=-1)
+                else:
+                    nxt[b] = np.concatenate(
+                        [cur[b][..., 1:], frames[t + 1, b]], axis=-1
+                    )
+            cur = nxt
+    return stacks
+
+
+def make_rollout(key, T=12, B=3, H=4, W=4):
+    ks = jax.random.split(key, 3)
+    frames = jax.random.randint(ks[0], (T, B, H, W, 1), 0, 255).astype(jnp.uint8)
+    dones = (jax.random.uniform(ks[1], (T, B)) < 0.25).astype(jnp.float32)
+    hist = jax.random.randint(ks[2], (B, H, W, S - 1), 0, 255).astype(jnp.uint8)
+    first_stacks = jnp.concatenate([hist, frames[0]], axis=-1)
+    return first_stacks, frames, dones
+
+
+def test_reconstruction_matches_framestack_simulation():
+    first_stacks, frames, dones = make_rollout(jax.random.PRNGKey(0))
+    T, B = frames.shape[:2]
+    ref = simulate_framestack(
+        np.asarray(first_stacks), np.asarray(frames), np.asarray(dones)
+    )
+    extended, resets = frame_storage_context(first_stacks, frames, dones, S)
+    idx = jnp.arange(T * B)
+    got = gather_stacked_obs(extended, resets.reshape(-1), idx, B, S)
+    np.testing.assert_array_equal(
+        np.asarray(got).reshape(T, B, *ref.shape[2:]), ref
+    )
+
+
+def test_reconstruction_no_resets_is_pure_shift():
+    first_stacks, frames, _ = make_rollout(jax.random.PRNGKey(1))
+    dones = jnp.zeros(frames.shape[:2], jnp.float32)
+    extended, resets = frame_storage_context(first_stacks, frames, dones, S)
+    assert int(resets.max()) == -(S - 1)
+    T, B = frames.shape[:2]
+    got = gather_stacked_obs(
+        extended, resets.reshape(-1), jnp.arange(T * B), B, S
+    )
+    got = np.asarray(got).reshape(T, B, *got.shape[1:])
+    # Stack at t ends with frame_t and starts with frame_{t-3}/history.
+    np.testing.assert_array_equal(got[5][..., -1:], np.asarray(frames[5]))
+    np.testing.assert_array_equal(got[5][..., 0:1], np.asarray(frames[2]))
+    np.testing.assert_array_equal(
+        got[0], np.asarray(first_stacks)
+    )
+
+
+def test_ppo_compact_frames_exactly_matches_full_storage():
+    """One full PPO iteration on PongTPU: compact storage must produce
+    bit-identical params/metrics (same seed, same permutations)."""
+    from actor_critic_algs_on_tensorflow_tpu.algos.ppo import (
+        PPOConfig,
+        make_ppo,
+    )
+
+    base = dict(
+        env="PongTPU-v0",
+        num_envs=8,
+        rollout_length=16,
+        total_env_steps=8 * 16,
+        frame_stack=4,
+        torso="nature_cnn",
+        num_epochs=2,
+        num_minibatches=2,
+        time_limit_bootstrap=False,
+        num_devices=1,
+        seed=7,
+    )
+    outs = {}
+    for compact in (False, True):
+        fns = make_ppo(PPOConfig(compact_frames=compact, **base))
+        state = fns.init(jax.random.PRNGKey(7))
+        state, metrics = fns.iteration(state)
+        outs[compact] = (
+            jax.device_get(state.params),
+            jax.device_get(metrics),
+        )
+    params_full, metrics_full = outs[False]
+    params_compact, metrics_compact = outs[True]
+    for a, b in zip(
+        jax.tree_util.tree_leaves(params_full),
+        jax.tree_util.tree_leaves(params_compact),
+    ):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+    for k in metrics_full:
+        np.testing.assert_allclose(
+            metrics_full[k], metrics_compact[k], rtol=1e-5, atol=1e-6,
+            err_msg=k,
+        )
