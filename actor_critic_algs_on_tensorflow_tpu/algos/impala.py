@@ -38,7 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from flax import struct
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from actor_critic_algs_on_tensorflow_tpu import envs as envs_lib
 from actor_critic_algs_on_tensorflow_tpu.algos import common
@@ -49,6 +49,7 @@ from actor_critic_algs_on_tensorflow_tpu.models import DiscreteActorCritic
 from actor_critic_algs_on_tensorflow_tpu.ops import (
     Categorical,
     entropy_loss,
+    sp_vtrace,
     value_loss,
     vtrace,
 )
@@ -57,6 +58,8 @@ from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
     device_count,
     make_mesh,
 )
+
+TIME_AXIS = "time"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -90,6 +93,10 @@ class ImpalaConfig:
     max_actor_restarts: int = 2
     compute_dtype: str = "float32"  # "bfloat16" runs the torso on the MXU in bf16
     use_pallas_scan: bool = False   # fused Pallas VMEM kernel for V-trace
+    # Shard the trajectory TIME axis over this many devices (learner
+    # mesh becomes 2-D data x time; V-trace runs sequence-parallel via
+    # ops.sequence_parallel). For rollouts too long for one device.
+    time_shards: int = 1
     seed: int = 0
     num_devices: int = 0
 
@@ -196,14 +203,43 @@ def make_impala(cfg: ImpalaConfig):
         raise ValueError(
             f"correction must be 'vtrace' or 'none', got {cfg.correction!r}"
         )
-    mesh = make_mesh(cfg.num_devices or None)
-    n_dev = device_count(mesh)
+    if cfg.time_shards > 1:
+        n_dev = cfg.num_devices or len(jax.devices())
+        if n_dev > len(jax.devices()):
+            raise ValueError(
+                f"requested {n_dev} devices, have {len(jax.devices())}"
+            )
+        if n_dev % cfg.time_shards:
+            raise ValueError(
+                f"num_devices={n_dev} not divisible by "
+                f"time_shards={cfg.time_shards}"
+            )
+        if cfg.rollout_length % cfg.time_shards:
+            raise ValueError(
+                f"rollout_length={cfg.rollout_length} not divisible by "
+                f"time_shards={cfg.time_shards}"
+            )
+        if cfg.use_pallas_scan:
+            raise ValueError(
+                "use_pallas_scan is the single-device V-trace kernel; "
+                "it cannot combine with time_shards > 1"
+            )
+        mesh = Mesh(
+            np.asarray(jax.devices()[:n_dev]).reshape(
+                n_dev // cfg.time_shards, cfg.time_shards
+            ),
+            (DATA_AXIS, TIME_AXIS),
+        )
+        d_data = n_dev // cfg.time_shards
+    else:
+        mesh = make_mesh(cfg.num_devices or None)
+        d_data = device_count(mesh)
     # The learner shards the stacked env axis B = trajectories * envs.
-    if (cfg.batch_trajectories * cfg.envs_per_actor) % n_dev:
+    if (cfg.batch_trajectories * cfg.envs_per_actor) % d_data:
         raise ValueError(
             f"batch_trajectories*envs_per_actor="
             f"{cfg.batch_trajectories * cfg.envs_per_actor} not divisible "
-            f"by {n_dev} devices"
+            f"by {d_data} data-parallel devices"
         )
     env, env_params = envs_lib.make(
         cfg.env, num_envs=cfg.envs_per_actor, frame_stack=cfg.frame_stack
@@ -288,8 +324,14 @@ def make_impala(cfg: ImpalaConfig):
         )
         return jax.device_put(state, NamedSharding(mesh, P()))
 
+    mesh_axes = (
+        (DATA_AXIS, TIME_AXIS) if cfg.time_shards > 1 else (DATA_AXIS,)
+    )
+
     def local_learner_step(state: LearnerState, batch: ActorTrajectory):
-        """Batch fields are ``[T, B_local, ...]`` (B sharded on data)."""
+        """Batch fields are ``[T_local, B_local, ...]`` (B sharded on
+        ``data``; T additionally sharded on ``time`` when
+        ``cfg.time_shards > 1``, with V-trace sequence-parallel)."""
 
         def loss_fn(params):
             logits, values = model.apply(params, batch.obs)
@@ -303,19 +345,30 @@ def make_impala(cfg: ImpalaConfig):
                 behaviour = jax.lax.stop_gradient(target_log_probs)
             else:
                 behaviour = batch.behaviour_log_probs
-            vt = vtrace(
+            vtrace_args = (
                 behaviour,
                 jax.lax.stop_gradient(target_log_probs),
                 batch.rewards,
                 jax.lax.stop_gradient(values),
                 batch.dones,
                 jax.lax.stop_gradient(last_value),
+            )
+            vtrace_kw = dict(
                 gamma=cfg.gamma,
                 lam=cfg.vtrace_lam,
                 rho_bar=cfg.rho_bar,
                 c_bar=cfg.c_bar,
-                use_pallas=cfg.use_pallas_scan,
             )
+            if cfg.time_shards > 1:
+                vt = sp_vtrace(
+                    *vtrace_args, axis_name=TIME_AXIS, **vtrace_kw
+                )
+            else:
+                vt = vtrace(
+                    *vtrace_args,
+                    use_pallas=cfg.use_pallas_scan,
+                    **vtrace_kw,
+                )
             pg = -jnp.mean(
                 target_log_probs * jax.lax.stop_gradient(vt.pg_advantages)
             )
@@ -328,7 +381,8 @@ def make_impala(cfg: ImpalaConfig):
         (loss, (pg, vf, ent, rho)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(state.params)
-        grads = jax.lax.pmean(grads, DATA_AXIS)
+        # Equal-sized shards: pmean over all mesh axes = global mean.
+        grads = jax.lax.pmean(grads, mesh_axes)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         metrics = jax.lax.pmean(
@@ -339,7 +393,7 @@ def make_impala(cfg: ImpalaConfig):
                 "entropy": ent,
                 "mean_rho": rho,
             },
-            DATA_AXIS,
+            mesh_axes,
         )
         return (
             LearnerState(params=params, opt_state=opt_state, step=state.step + 1),
@@ -349,13 +403,15 @@ def make_impala(cfg: ImpalaConfig):
     example = jax.eval_shape(init, jax.random.PRNGKey(0))
     state_spec = jax.tree_util.tree_map(lambda _: P(), example)
     # Trajectory batches shard on axis 1 (the trajectory/env axis; axis 0
-    # is time) except last_obs, which is [B, ...] and shards on axis 0.
+    # is time, additionally sharded when time_shards > 1) except
+    # last_obs, which is [B, ...] and shards on axis 0.
+    t_axis = TIME_AXIS if cfg.time_shards > 1 else None
     batch_spec = ActorTrajectory(
-        obs=P(None, DATA_AXIS),
-        actions=P(None, DATA_AXIS),
-        rewards=P(None, DATA_AXIS),
-        dones=P(None, DATA_AXIS),
-        behaviour_log_probs=P(None, DATA_AXIS),
+        obs=P(t_axis, DATA_AXIS),
+        actions=P(t_axis, DATA_AXIS),
+        rewards=P(t_axis, DATA_AXIS),
+        dones=P(t_axis, DATA_AXIS),
+        behaviour_log_probs=P(t_axis, DATA_AXIS),
         last_obs=P(DATA_AXIS),
     )
     # NO donation here: ParamStore and in-flight actor snapshots alias
@@ -585,7 +641,9 @@ def _actor_process_main(
         ActorClient,
     )
 
-    acfg = dataclasses.replace(cfg, num_devices=1)
+    # Single-CPU rollout process: never runs the (possibly
+    # time-sharded) learner, so both mesh knobs reset to 1.
+    acfg = dataclasses.replace(cfg, num_devices=1, time_shards=1)
     init, _, make_actor_programs, _ = make_impala(acfg)
     rollout_fn, env_reset_fn = make_actor_programs(actor_id)
     params_def = jax.tree_util.tree_structure(

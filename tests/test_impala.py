@@ -157,3 +157,50 @@ def test_impala_learns_cartpole():
     mean_ret, frac_done = greedy_cartpole_return(state.params)
     assert frac_done == 1.0
     assert mean_ret >= 150.0, mean_ret
+
+
+def test_time_sharded_learner_matches_1d():
+    """time_shards=4 learner (2-D data x time mesh, sequence-parallel
+    V-trace) must produce the same update as the 1-D learner."""
+    import jax.numpy as jnp
+
+    base = dict(rollout_length=16, batch_trajectories=2, envs_per_actor=4)
+    cfg1 = _cfg(num_devices=2, **base)
+    cfg2 = _cfg(num_devices=8, time_shards=4, **base)  # data=2, time=4
+
+    init1, step1, _, _ = impala.make_impala(cfg1)
+    init2, step2, _, _ = impala.make_impala(cfg2)
+    state1 = init1(jax.random.PRNGKey(0))
+    state2 = init2(jax.random.PRNGKey(0))
+
+    T, B = 16, 8
+    key = jax.random.PRNGKey(42)
+    ks = jax.random.split(key, 6)
+    obs_dim = 4  # CartPole
+    batch = impala.ActorTrajectory(
+        obs=jax.random.normal(ks[0], (T, B, obs_dim)),
+        actions=jax.random.randint(ks[1], (T, B), 0, 2),
+        rewards=jax.random.normal(ks[2], (T, B)),
+        dones=(jax.random.uniform(ks[3], (T, B)) < 0.1).astype(jnp.float32),
+        behaviour_log_probs=-jnp.abs(jax.random.normal(ks[4], (T, B))),
+        last_obs=jax.random.normal(ks[5], (B, obs_dim)),
+    )
+
+    new1, m1 = step1(state1, batch)
+    new2, m2 = step2(state2, batch)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(jax.device_get(new1.params)),
+        jax.tree_util.tree_leaves(jax.device_get(new2.params)),
+    ):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+    for k in m1:
+        np.testing.assert_allclose(
+            float(m1[k]), float(m2[k]), rtol=1e-5, atol=1e-6, err_msg=k
+        )
+
+
+def test_time_shards_validation():
+    with pytest.raises(ValueError, match="rollout_length"):
+        impala.make_impala(_cfg(num_devices=8, time_shards=4, rollout_length=6))
+    with pytest.raises(ValueError, match="not divisible by time_shards"):
+        impala.make_impala(_cfg(num_devices=6, time_shards=4))
