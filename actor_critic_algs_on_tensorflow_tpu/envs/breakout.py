@@ -1,0 +1,220 @@
+"""BreakoutTPU: an Atari-Breakout-class environment in pure JAX.
+
+Second on-device Atari-class task (same rationale as
+``envs.pong.PongTPU``: ALE ROMs are unavailable and a TPU-first design
+wants the env on the device as vectorized XLA ops — BASELINE.json:8's
+Nature-CNN pixel pipeline generalizes beyond one game). Task surface
+mirrors Breakout: a 6x12 brick wall (Atari row values 7/7/4/4/1/1), a
+bottom paddle, 4 Atari actions (NOOP, FIRE, RIGHT, LEFT), 5 lives,
++row-value reward per brick, 84x84 grayscale frames. The wall respawns
+when cleared (the "second wall" continuation); the episode terminates
+when the last life is lost.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from actor_critic_algs_on_tensorflow_tpu.envs.core import Box, Discrete, JaxEnv
+
+_N_ROWS = 6
+_N_COLS = 12
+# Atari Breakout scoring: top two rows 7, middle two 4, bottom two 1.
+_ROW_VALUES = jnp.asarray([7.0, 7.0, 4.0, 4.0, 1.0, 1.0], jnp.float32)
+# NOOP, FIRE, RIGHT, LEFT -> paddle direction.
+_ACTION_DIRS = jnp.asarray([0.0, 0.0, 1.0, -1.0], jnp.float32)
+
+
+@struct.dataclass
+class BreakoutParams:
+    ball_speed: float = 1.5
+    max_ball_v: float = 2.5
+    paddle_speed: float = 3.0
+    spin: float = 0.3           # vx added per pixel of paddle-hit offset
+    lives: int = struct.field(pytree_node=False, default=5)
+    height: int = struct.field(pytree_node=False, default=84)
+    width: int = struct.field(pytree_node=False, default=84)
+    paddle_half: int = struct.field(pytree_node=False, default=6)
+    brick_top: int = struct.field(pytree_node=False, default=18)
+    brick_h: int = struct.field(pytree_node=False, default=3)
+    max_steps: int = struct.field(pytree_node=False, default=10_000)
+
+
+@struct.dataclass
+class BreakoutState:
+    ball_x: jax.Array
+    ball_y: jax.Array
+    ball_vx: jax.Array
+    ball_vy: jax.Array
+    paddle_x: jax.Array
+    bricks: jax.Array        # [6, 12] float32 alive mask
+    lives: jax.Array
+    score: jax.Array
+    t: jax.Array
+
+
+class BreakoutTPU(JaxEnv[BreakoutState, BreakoutParams]):
+    name = "BreakoutTPU-v0"
+
+    def default_params(self) -> BreakoutParams:
+        return BreakoutParams()
+
+    def _serve(self, key, params):
+        """Ball above the paddle, heading down at a random angle."""
+        kx, kv = jax.random.split(key)
+        x = jax.random.uniform(
+            kx, (), jnp.float32, params.width * 0.3, params.width * 0.7
+        )
+        vx = jax.random.uniform(kv, (), jnp.float32, -1.0, 1.0)
+        return (
+            x,
+            jnp.asarray(params.height * 0.55, jnp.float32),
+            vx,
+            jnp.asarray(params.ball_speed, jnp.float32),
+        )
+
+    def reset(self, key, params):
+        bx, by, vx, vy = self._serve(key, params)
+        state = BreakoutState(
+            ball_x=bx,
+            ball_y=by,
+            ball_vx=vx,
+            ball_vy=vy,
+            paddle_x=jnp.asarray(params.width / 2.0, jnp.float32),
+            bricks=jnp.ones((_N_ROWS, _N_COLS), jnp.float32),
+            lives=jnp.asarray(params.lives, jnp.int32),
+            score=jnp.zeros((), jnp.int32),
+            t=jnp.zeros((), jnp.int32),
+        )
+        return state, self._obs(state, params)
+
+    def step(self, key, state, action, params):
+        f32 = jnp.float32
+        h, w = f32(params.height), f32(params.width)
+        ph = f32(params.paddle_half)
+        paddle_y = h - 3.0
+        brick_w = params.width / _N_COLS
+
+        # --- paddle -----------------------------------------------------
+        dx = _ACTION_DIRS[jnp.asarray(action, jnp.int32)] * params.paddle_speed
+        paddle_x = jnp.clip(state.paddle_x + dx, ph, w - 1.0 - ph)
+
+        # --- ball flight ------------------------------------------------
+        bx = state.ball_x + state.ball_vx
+        by = state.ball_y + state.ball_vy
+        vx = state.ball_vx
+        vy = state.ball_vy
+        # side walls
+        bx = jnp.where(bx < 0.0, -bx, bx)
+        vx = jnp.where(state.ball_x + state.ball_vx < 0.0, jnp.abs(vx), vx)
+        over_r = bx > (w - 1.0)
+        bx = jnp.where(over_r, 2.0 * (w - 1.0) - bx, bx)
+        vx = jnp.where(over_r, -jnp.abs(vx), vx)
+        # ceiling
+        by_new = by
+        vy = jnp.where(by_new < 0.0, jnp.abs(vy), vy)
+        by = jnp.where(by_new < 0.0, -by_new, by_new)
+
+        # --- brick collision -------------------------------------------
+        row = jnp.floor((by - params.brick_top) / params.brick_h).astype(jnp.int32)
+        col = jnp.floor(bx / brick_w).astype(jnp.int32)
+        in_band = (row >= 0) & (row < _N_ROWS) & (col >= 0) & (col < _N_COLS)
+        row_c = jnp.clip(row, 0, _N_ROWS - 1)
+        col_c = jnp.clip(col, 0, _N_COLS - 1)
+        alive = state.bricks[row_c, col_c] > 0.5
+        hit_brick = in_band & alive
+        bricks = state.bricks.at[row_c, col_c].set(
+            jnp.where(hit_brick, 0.0, state.bricks[row_c, col_c])
+        )
+        brick_reward = jnp.where(hit_brick, _ROW_VALUES[row_c], f32(0.0))
+        vy = jnp.where(hit_brick, -vy, vy)
+
+        # wall cleared -> respawn (Atari's second wall, generalized)
+        cleared = jnp.sum(bricks) < 0.5
+        bricks = jnp.where(cleared, jnp.ones_like(bricks), bricks)
+
+        # --- paddle collision ------------------------------------------
+        hit_paddle = (
+            (by >= paddle_y - 1.0)
+            & (vy > 0.0)
+            & (jnp.abs(bx - paddle_x) <= ph + 1.0)
+        )
+        vy = jnp.where(hit_paddle, -jnp.abs(vy), vy)
+        vx = jnp.where(
+            hit_paddle,
+            jnp.clip(
+                vx + (bx - paddle_x) * params.spin,
+                -params.max_ball_v,
+                params.max_ball_v,
+            ),
+            vx,
+        )
+        by = jnp.where(hit_paddle, paddle_y - 1.0, by)
+
+        # --- life loss --------------------------------------------------
+        missed = by > (h - 1.0)
+        lives = state.lives - missed.astype(jnp.int32)
+        sx, sy, svx, svy = self._serve(key, params)
+        bx = jnp.where(missed, sx, bx)
+        by = jnp.where(missed, sy, by)
+        vx = jnp.where(missed, svx, vx)
+        vy = jnp.where(missed, svy, vy)
+
+        t = state.t + 1
+        score = state.score + brick_reward.astype(jnp.int32)
+        new_state = BreakoutState(
+            ball_x=bx,
+            ball_y=by,
+            ball_vx=vx,
+            ball_vy=vy,
+            paddle_x=paddle_x,
+            bricks=bricks,
+            lives=lives,
+            score=score,
+            t=t,
+        )
+        terminated = (lives <= 0).astype(f32)
+        truncated = (t >= params.max_steps).astype(f32)
+        done = jnp.maximum(terminated, truncated)
+        info: Dict[str, jax.Array] = {
+            "terminated": terminated,
+            "truncated": truncated,
+        }
+        return new_state, self._obs(new_state, params), brick_reward, done, info
+
+    def _obs(self, state: BreakoutState, params: BreakoutParams) -> jax.Array:
+        """Render an [H, W, 1] uint8 frame with broadcasted lookups."""
+        rows = jnp.arange(params.height, dtype=jnp.float32)[:, None]
+        cols = jnp.arange(params.width, dtype=jnp.float32)[None, :]
+        ph = jnp.float32(params.paddle_half)
+        h = jnp.float32(params.height)
+        brick_w = params.width / _N_COLS
+
+        paddle_mask = (rows >= h - 4.0) & (rows <= h - 2.0) & (
+            jnp.abs(cols - state.paddle_x) <= ph
+        )
+        ball_mask = (jnp.abs(cols - state.ball_x) <= 1.0) & (
+            jnp.abs(rows - state.ball_y) <= 1.0
+        )
+        # brick band: look up each pixel's brick cell in the alive mask
+        prow = jnp.clip(
+            ((rows - params.brick_top) // params.brick_h).astype(jnp.int32),
+            0, _N_ROWS - 1,
+        )
+        pcol = jnp.clip((cols // brick_w).astype(jnp.int32), 0, _N_COLS - 1)
+        in_band = (rows >= params.brick_top) & (
+            rows < params.brick_top + _N_ROWS * params.brick_h
+        )
+        brick_mask = in_band & (state.bricks[prow, pcol] > 0.5)
+        frame = (paddle_mask | ball_mask | brick_mask).astype(jnp.uint8) * 255
+        return frame[..., None]
+
+    def observation_space(self, params):
+        return Box(0, 255, (params.height, params.width, 1), jnp.uint8)
+
+    def action_space(self, params):
+        return Discrete(4)

@@ -184,3 +184,89 @@ def test_autoreset_exposes_final_obs():
             break
     else:
         raise AssertionError("never terminated")
+
+
+def test_breakout_obs_bricks_and_reward():
+    from actor_critic_algs_on_tensorflow_tpu.envs import BreakoutTPU
+
+    env = BreakoutTPU()
+    params = env.default_params()
+    state, obs = env.reset(jax.random.PRNGKey(0), params)
+    assert obs.shape == (84, 84, 1) and obs.dtype == jnp.uint8
+    assert int(state.lives) == 5
+    # full wall renders a solid brick band
+    band = np.asarray(obs)[params.brick_top: params.brick_top + 18]
+    assert band.sum() > 0
+
+    # force a brick hit: ball flies up INTO the top brick row
+    state = state.replace(
+        ball_x=jnp.float32(10.0),
+        ball_y=jnp.float32(params.brick_top + 4.0),
+        ball_vx=jnp.float32(0.0),
+        ball_vy=jnp.float32(-1.5),
+    )
+    ns, nobs, reward, done, _ = env.step(
+        jax.random.PRNGKey(1), state, jnp.int32(0), params
+    )
+    assert float(reward) == 7.0  # top-row Atari value
+    assert float(jnp.sum(ns.bricks)) == 71.0  # one of 72 destroyed
+    assert float(ns.ball_vy) > 0.0  # bounced
+    assert float(done) == 0.0
+
+
+def test_breakout_life_loss_and_termination():
+    from actor_critic_algs_on_tensorflow_tpu.envs import BreakoutTPU
+
+    env = BreakoutTPU()
+    params = env.default_params()
+    state, _ = env.reset(jax.random.PRNGKey(0), params)
+    # ball below the paddle heading down, paddle away -> life lost
+    state = state.replace(
+        ball_x=jnp.float32(10.0),
+        ball_y=jnp.float32(82.5),
+        ball_vx=jnp.float32(0.0),
+        ball_vy=jnp.float32(2.0),
+        paddle_x=jnp.float32(70.0),
+        lives=jnp.int32(1),
+    )
+    ns, _, reward, done, info = env.step(
+        jax.random.PRNGKey(1), state, jnp.int32(0), params
+    )
+    assert float(reward) == 0.0
+    assert int(ns.lives) == 0
+    assert float(done) == 1.0 and float(info["terminated"]) == 1.0
+
+
+def test_breakout_paddle_bounce_and_rollout():
+    from actor_critic_algs_on_tensorflow_tpu.envs import BreakoutTPU
+    from actor_critic_algs_on_tensorflow_tpu import envs as envs_lib
+
+    env = BreakoutTPU()
+    params = env.default_params()
+    state, _ = env.reset(jax.random.PRNGKey(0), params)
+    state = state.replace(
+        ball_x=jnp.float32(40.0),
+        ball_y=jnp.float32(80.5),
+        ball_vx=jnp.float32(0.0),
+        ball_vy=jnp.float32(2.0),
+        paddle_x=jnp.float32(40.0),
+    )
+    ns, _, _, _, _ = env.step(jax.random.PRNGKey(1), state, jnp.int32(0), params)
+    assert float(ns.ball_vy) < 0.0  # bounced off the paddle
+
+    # vectorized random rollout through the standard wrapper stack
+    venv, vparams = envs_lib.make("BreakoutTPU-v0", num_envs=8, frame_stack=4)
+    vstate, vobs = venv.reset(jax.random.PRNGKey(2), vparams)
+    assert vobs.shape == (8, 84, 84, 4)
+
+    def _step(carry, key):
+        vstate, obs = carry
+        actions = jax.random.randint(key, (8,), 0, 4)
+        vstate, obs, r, d, info = venv.step(key, vstate, actions, vparams)
+        return (vstate, obs), (r, d)
+
+    (_, _), (rews, dones) = jax.lax.scan(
+        _step, (vstate, vobs), jax.random.split(jax.random.PRNGKey(3), 200)
+    )
+    assert bool(jnp.all(jnp.isfinite(rews)))
+    assert float(jnp.max(rews)) >= 0.0
