@@ -42,6 +42,8 @@ class OnPolicyState:
 
     ``params``/``opt_state``/``key``/``step`` are replicated across the
     mesh; ``env_state``/``obs`` are sharded on their leading (env) axis.
+    ``extra`` carries replicated algorithm-specific state (e.g. PPO's
+    running observation-normalization statistics); ``None`` when unused.
     """
 
     params: Any
@@ -50,6 +52,7 @@ class OnPolicyState:
     obs: Any
     key: jax.Array
     step: jax.Array  # iteration counter; env steps = step * steps_per_iteration
+    extra: Any = None
 
 
 def state_specs(state: OnPolicyState) -> OnPolicyState:
@@ -61,6 +64,7 @@ def state_specs(state: OnPolicyState) -> OnPolicyState:
         obs=shard_batch_specs(state.obs),
         key=P(),
         step=P(),
+        extra=replicated_specs(state.extra),
     )
 
 

@@ -32,11 +32,17 @@ from actor_critic_algs_on_tensorflow_tpu.ops import (
     Categorical,
     DiagGaussian,
     TanhGaussian,
+    rms_normalize,
 )
 
 
-def _act_fn(algo: str, cfg, aspace, params, stochastic: bool):
-    """Policy action function matching the trainer's architecture."""
+def _act_fn(algo: str, cfg, aspace, params, stochastic: bool, norm=None):
+    """Policy action function matching the trainer's architecture.
+
+    ``norm`` preprocesses obs (e.g. the restored running-mean/std
+    normalizer a normalize_obs=True PPO policy was trained with).
+    """
+    norm = norm if norm is not None else (lambda o: o)
     if algo in ("a2c", "ppo", "impala"):
         if hasattr(aspace, "n"):
             model = DiscreteActorCritic(
@@ -47,7 +53,7 @@ def _act_fn(algo: str, cfg, aspace, params, stochastic: bool):
             )
 
             def act(obs, key):
-                logits, _ = model.apply(params, obs)
+                logits, _ = model.apply(params, norm(obs))
                 if stochastic:
                     return Categorical(logits).sample(key)
                 return jnp.argmax(logits, axis=-1)
@@ -59,7 +65,7 @@ def _act_fn(algo: str, cfg, aspace, params, stochastic: bool):
             )
 
             def act(obs, key):
-                mean, log_std, _ = model.apply(params, obs)
+                mean, log_std, _ = model.apply(params, norm(obs))
                 if stochastic:
                     return DiagGaussian(mean, log_std).sample(key)
                 return mean
@@ -140,8 +146,13 @@ def evaluate_checkpoint(
         num_envs=num_envs,
         frame_stack=getattr(cfg, "frame_stack", 0),
     )
+    norm = None
+    if getattr(cfg, "normalize_obs", False):
+        rms = state.extra
+        norm = lambda o: rms_normalize(o, rms)
     act = _act_fn(
-        algo, cfg, env.action_space(env_params), state.params, stochastic
+        algo, cfg, env.action_space(env_params), state.params, stochastic,
+        norm=norm,
     )
     mean_ret, per_env, frac = jax.jit(
         lambda key: common.evaluate(

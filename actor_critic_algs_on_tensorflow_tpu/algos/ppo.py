@@ -43,6 +43,9 @@ from actor_critic_algs_on_tensorflow_tpu.ops import (
     clipped_value_loss,
     gae_advantages,
     ppo_clip_loss,
+    rms_init,
+    rms_normalize,
+    rms_update,
     value_loss,
 )
 from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
@@ -74,6 +77,10 @@ class PPOConfig:
     num_epochs: int = 4
     num_minibatches: int = 4
     normalize_adv: bool = True
+    # Running mean/std observation normalization (vector obs only) —
+    # the VecNormalize-style statistics live in state.extra, frozen
+    # within an iteration so update-time log-probs match collection.
+    normalize_obs: bool = False
     time_limit_bootstrap: bool = True
     # Store only the newest frame per rollout step and rebuild stacks
     # during the update (exact; frame_stack-x smaller rollout buffer).
@@ -149,6 +156,14 @@ def make_ppo(cfg: PPOConfig) -> common.IterationFns:
     def init(key: jax.Array) -> common.OnPolicyState:
         k_env, k_model = jax.random.split(key)
         env_state, obs = genv.reset(k_env, env_params)
+        if cfg.normalize_obs:
+            if obs.ndim != 2:
+                raise ValueError(
+                    "normalize_obs supports vector observations only"
+                )
+            extra = rms_init(obs.shape[1:])
+        else:
+            extra = None
         params = model.init(k_model, obs[:1])
         state = common.OnPolicyState(
             params=params,
@@ -157,6 +172,7 @@ def make_ppo(cfg: PPOConfig) -> common.IterationFns:
             obs=obs,
             key=key,
             step=jnp.zeros((), jnp.int32),
+            extra=extra,
         )
         return put_by_specs(state, common.state_specs(state), mesh)
 
@@ -168,11 +184,29 @@ def make_ppo(cfg: PPOConfig) -> common.IterationFns:
                 "compact_frames requires time_limit_bootstrap=False "
                 "(final_obs would still store full stacks)"
             )
+        if cfg.normalize_obs:
+            raise ValueError(
+                "compact_frames stores single frames, which cannot fold "
+                "into full-stack normalize_obs statistics"
+            )
 
     def local_iteration(state: common.OnPolicyState):
         dev = jax.lax.axis_index(DATA_AXIS)
         it_key = jax.random.fold_in(jax.random.fold_in(state.key, state.step), dev)
         k_roll, k_perm = jax.random.split(it_key)
+
+        # Obs normalization uses the PRE-update statistics everywhere in
+        # this iteration (collection AND update, so the PPO ratio's
+        # old/new log-probs see identical inputs); this rollout folds
+        # into the stats at the end, taking effect next iteration.
+        if cfg.normalize_obs:
+            rms = state.extra
+            norm = lambda o: rms_normalize(o, rms)
+        else:
+            norm = lambda o: o
+
+        def rollout_policy(params, obs, key):
+            return policy_fn(params, norm(obs), key)
 
         if cfg.compact_frames:
             frame_c = state.obs.shape[-1] // cfg.frame_stack
@@ -181,16 +215,16 @@ def make_ppo(cfg: PPOConfig) -> common.IterationFns:
             store_obs_fn = None
         obs0 = state.obs
         env_state, obs, traj, ep_info = common.collect_rollout(
-            env, env_params, policy_fn,
+            env, env_params, rollout_policy,
             state.params, state.env_state, state.obs, k_roll,
             cfg.rollout_length,
             keep_final_obs=cfg.time_limit_bootstrap,
             store_obs_fn=store_obs_fn,
         )
-        _, last_value = dist_and_value(state.params, obs)
+        _, last_value = dist_and_value(state.params, norm(obs))
         if cfg.time_limit_bootstrap:
             _, truncation_values = dist_and_value(
-                state.params, ep_info["final_obs"]
+                state.params, norm(ep_info["final_obs"])
             )
         else:
             truncation_values = None
@@ -236,7 +270,7 @@ def make_ppo(cfg: PPOConfig) -> common.IterationFns:
                 adv = common.global_normalize_advantages(adv)
 
             def loss_fn(p):
-                dist, values = dist_and_value(p, mb["obs"])
+                dist, values = dist_and_value(p, norm(mb["obs"]))
                 stats = ppo_clip_loss(
                     dist.log_prob(mb["actions"]),
                     mb["old_log_probs"],
@@ -284,6 +318,11 @@ def make_ppo(cfg: PPOConfig) -> common.IterationFns:
         )
         metrics.update(common.episode_metrics(ep_info))
 
+        new_extra = (
+            rms_update(state.extra, traj.obs, axis_name=DATA_AXIS)
+            if cfg.normalize_obs
+            else state.extra
+        )
         new_state = common.OnPolicyState(
             params=params,
             opt_state=opt_state,
@@ -291,6 +330,7 @@ def make_ppo(cfg: PPOConfig) -> common.IterationFns:
             obs=obs,
             key=state.key,
             step=state.step + 1,
+            extra=new_extra,
         )
         return new_state, metrics
 

@@ -25,6 +25,12 @@ from actor_critic_algs_on_tensorflow_tpu.ops.noise import (  # noqa: F401
     ou_reset_where,
     ou_step,
 )
+from actor_critic_algs_on_tensorflow_tpu.ops.normalize import (  # noqa: F401
+    RunningMeanStd,
+    rms_init,
+    rms_normalize,
+    rms_update,
+)
 from actor_critic_algs_on_tensorflow_tpu.ops.ring_attention import (  # noqa: F401
     ring_attention,
 )

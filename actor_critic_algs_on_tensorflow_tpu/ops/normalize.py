@@ -1,0 +1,68 @@
+"""Running observation normalization (Welford-style, mesh-correct).
+
+Capability parity: reference-era PPO/DDPG MuJoCo training normalizes
+observations with a running mean/std (the classic VecNormalize
+wrapper); without it continuous-control PPO trains poorly on wide
+state scales. TPU-first: the statistics are a tiny replicated pytree
+carried in the train state and updated once per iteration from the
+whole rollout — batch moments are ``pmean``-merged across the mesh so
+data-parallel runs track the GLOBAL statistics (same discipline as
+``common.global_normalize_advantages``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class RunningMeanStd:
+    mean: jax.Array
+    var: jax.Array
+    count: jax.Array
+
+
+def rms_init(shape) -> RunningMeanStd:
+    return RunningMeanStd(
+        mean=jnp.zeros(shape, jnp.float32),
+        var=jnp.ones(shape, jnp.float32),
+        count=jnp.asarray(1e-4, jnp.float32),
+    )
+
+
+def rms_update(
+    rms: RunningMeanStd, batch: jax.Array, *, axis_name: str | None = None
+) -> RunningMeanStd:
+    """Fold a ``[N, ...feature]`` batch into the running statistics.
+
+    With ``axis_name`` the batch moments are pmean'd first, so every
+    device folds the same GLOBAL batch statistics (shards are equal
+    sized under shard_map, so the pmean of per-shard moments is exact).
+    """
+    batch = batch.reshape((-1,) + rms.mean.shape).astype(jnp.float32)
+    n = jnp.asarray(batch.shape[0], jnp.float32)
+    mean = jnp.mean(batch, axis=0)
+    var = jnp.var(batch, axis=0)
+    if axis_name is not None:
+        # Merge per-shard moments into global batch moments.
+        g_mean = jax.lax.pmean(mean, axis_name)
+        var = jax.lax.pmean(var + (mean - g_mean) ** 2, axis_name)
+        mean = g_mean
+        n = n * jax.lax.psum(1, axis_name)
+
+    delta = mean - rms.mean
+    tot = rms.count + n
+    new_mean = rms.mean + delta * n / tot
+    m_a = rms.var * rms.count
+    m_b = var * n
+    m2 = m_a + m_b + delta**2 * rms.count * n / tot
+    return RunningMeanStd(mean=new_mean, var=m2 / tot, count=tot)
+
+
+def rms_normalize(
+    x: jax.Array, rms: RunningMeanStd, *, clip: float = 10.0
+) -> jax.Array:
+    z = (x.astype(jnp.float32) - rms.mean) * jax.lax.rsqrt(rms.var + 1e-8)
+    return jnp.clip(z, -clip, clip)
