@@ -50,6 +50,31 @@ def donation_supported() -> bool:
     return "axon" not in version
 
 
+@functools.cache
+def host_callbacks_supported() -> bool:
+    """Whether the active backend can run jax host callbacks.
+
+    The experimental single-chip "axon" TPU plugin rejects unordered
+    callbacks with UNIMPLEMENTED ("axon_pjrt does not support host
+    send/recv callbacks") and — worse — HANGS forever on ordered ones,
+    so host-resident envs (``gym:``/``native:``) must fail fast there
+    instead of wedging training. Real TPU hosts and CPU are fine.
+
+    Override with ``ACT_TPU_HOST_CB=1`` (e.g. if a future plugin
+    version adds support).
+    """
+    forced = os.environ.get("ACT_TPU_HOST_CB")
+    if forced is not None:
+        return forced.strip().lower() not in ("0", "false", "no", "off", "")
+    try:
+        from jax.extend import backend as jex_backend
+
+        version = jex_backend.get_backend().platform_version
+    except Exception:
+        return True
+    return "axon" not in version
+
+
 def make_mesh(num_devices: int | None = None, axis_name: str = DATA_AXIS) -> Mesh:
     """1-D data-parallel mesh over the first ``num_devices`` devices."""
     devices = jax.devices()
