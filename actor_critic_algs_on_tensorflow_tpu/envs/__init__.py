@@ -25,6 +25,10 @@ from actor_critic_algs_on_tensorflow_tpu.envs.pong import (  # noqa: F401
     PongParams,
     PongTPU,
 )
+from actor_critic_algs_on_tensorflow_tpu.envs.reacher import (  # noqa: F401
+    ReacherParams,
+    ReacherTPU,
+)
 from actor_critic_algs_on_tensorflow_tpu.envs.wrappers import (  # noqa: F401
     AutoReset,
     EpisodeStats,
@@ -38,6 +42,7 @@ _REGISTRY = {
     "CartPole-v1": CartPole,
     "Pendulum-v1": Pendulum,
     "PongTPU-v0": PongTPU,
+    "ReacherTPU-v0": ReacherTPU,
 }
 
 # Host envs are stateful (the simulator lives host-side), so repeated

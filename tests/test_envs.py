@@ -270,3 +270,71 @@ def test_breakout_paddle_bounce_and_rollout():
     )
     assert bool(jnp.all(jnp.isfinite(rews)))
     assert float(jnp.max(rews)) >= 0.0
+
+
+def test_reacher_dynamics_and_reward():
+    from actor_critic_algs_on_tensorflow_tpu.envs import ReacherTPU
+    from actor_critic_algs_on_tensorflow_tpu.envs.reacher import _fingertip
+
+    env = ReacherTPU()
+    params = env.default_params()
+    state, obs = env.reset(jax.random.PRNGKey(0), params)
+    assert obs.shape == (10,)
+    # target is reachable
+    assert float(jnp.linalg.norm(state.target)) <= params.target_radius + 1e-6
+    # obs tail is fingertip-target vector
+    np.testing.assert_allclose(
+        np.asarray(obs[-2:]),
+        np.asarray(_fingertip(state.theta, params) - state.target),
+        rtol=1e-5,
+    )
+
+    # zero torque from rest: arm stays put, reward = -distance
+    state = state.replace(theta_dot=jnp.zeros(2))
+    ns, _, reward, done, info = env.step(
+        jax.random.PRNGKey(1), state, jnp.zeros(2), params
+    )
+    dist = float(jnp.linalg.norm(_fingertip(ns.theta, params) - ns.target))
+    np.testing.assert_allclose(float(reward), -dist, rtol=1e-5)
+    assert float(done) == 0.0
+
+    # torque accelerates the joints; ctrl cost reduces reward
+    ns2, _, r2, _, _ = env.step(
+        jax.random.PRNGKey(1), state, jnp.ones(2), params
+    )
+    assert float(jnp.abs(ns2.theta_dot).sum()) > 0.0
+    dist2 = float(
+        jnp.linalg.norm(_fingertip(ns2.theta, params) - ns2.target)
+    )
+    np.testing.assert_allclose(
+        float(r2), -dist2 - params.ctrl_cost * 2.0, rtol=1e-5
+    )
+
+    # 50-step truncation
+    state50 = state.replace(t=jnp.int32(49))
+    _, _, _, done50, info50 = env.step(
+        jax.random.PRNGKey(1), state50, jnp.zeros(2), params
+    )
+    assert float(done50) == 1.0 and float(info50["truncated"]) == 1.0
+
+
+def test_reacher_vectorized_rollout():
+    from actor_critic_algs_on_tensorflow_tpu import envs as envs_lib
+
+    venv, vparams = envs_lib.make("ReacherTPU-v0", num_envs=8)
+    vstate, vobs = venv.reset(jax.random.PRNGKey(0), vparams)
+    assert vobs.shape == (8, 10)
+
+    def _step(carry, key):
+        vstate, obs = carry
+        actions = jax.random.uniform(key, (8, 2), minval=-1.0, maxval=1.0)
+        vstate, obs, r, d, info = venv.step(key, vstate, actions, vparams)
+        return (vstate, obs), (r, d)
+
+    (_, _), (rews, dones) = jax.lax.scan(
+        _step, (vstate, vobs), jax.random.split(jax.random.PRNGKey(1), 120)
+    )
+    assert bool(jnp.all(jnp.isfinite(rews)))
+    assert bool(jnp.all(rews <= 0.0))
+    # two truncations per env in 120 steps of 50-step episodes
+    assert float(dones.sum(0).min()) >= 2.0
