@@ -29,41 +29,23 @@ import sys
 from typing import Any, Tuple
 
 
-def _coerce(value: str, target_type) -> Any:
-    """Parse a CLI string into a config field's type."""
-    import typing
-
-    origin = typing.get_origin(target_type)
-    if origin in (tuple, Tuple):
-        inner = typing.get_args(target_type)
-        elt = inner[0] if inner else str
-        if value.strip() == "":
-            return ()
-        return tuple(_coerce(v.strip(), elt) for v in value.split(","))
-    if target_type is bool or str(target_type) == "bool":
-        return value.lower() in ("1", "true", "yes", "on")
-    if target_type is int:
-        return int(value)
-    if target_type is float:
-        return float(value)
-    return value
-
-
 def apply_overrides(cfg, overrides: list[str]):
-    """Apply ``key=value`` strings to a frozen config dataclass."""
-    import typing
+    """Apply ``key=value`` strings to a frozen config dataclass.
 
-    hints = typing.get_type_hints(type(cfg))
-    updates = {}
-    for item in overrides:
-        if "=" not in item:
-            raise SystemExit(f"--set expects key=value, got {item!r}")
-        key, value = item.split("=", 1)
-        if key not in hints:
-            known = ", ".join(sorted(hints))
-            raise SystemExit(f"unknown config field {key!r}; known: {known}")
-        updates[key] = _coerce(value, hints[key])
-    return dataclasses.replace(cfg, **updates)
+    Thin CLI shim over ``utils.config.apply_overrides`` (value-typed
+    coercion, dotted paths for nested configs) that converts errors to
+    argparse-style exits.
+    """
+    from actor_critic_algs_on_tensorflow_tpu.utils.config import (
+        apply_overrides as _apply,
+    )
+
+    try:
+        return _apply(cfg, tuple(overrides))
+    except KeyError as e:
+        raise SystemExit(f"unknown config field: {e.args[0]}")
+    except ValueError as e:
+        raise SystemExit(f"--set error: {e}")
 
 
 # The TPU-tuned large-batch Atari schedule shared by the image-env

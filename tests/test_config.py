@@ -1,0 +1,59 @@
+"""utils.config: value-typed coercion, nested paths, flattening."""
+
+import dataclasses
+
+import pytest
+
+from actor_critic_algs_on_tensorflow_tpu.utils.config import (
+    apply_overrides,
+    asdict_flat,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Inner:
+    n: int = 4
+    rate: float = 0.5
+
+
+@dataclasses.dataclass(frozen=True)
+class Outer:
+    name: str = "x"
+    flag: bool = True
+    sizes: tuple = (64, 64)
+    maybe: int | None = None
+    inner: Inner = dataclasses.field(default_factory=Inner)
+
+
+def test_coercion_matrix():
+    cfg = apply_overrides(
+        Outer(),
+        ("name=hello", "flag=false", "sizes=8,16", "maybe=3"),
+    )
+    assert cfg.name == "hello"
+    assert cfg.flag is False
+    assert cfg.sizes == (8, 16)
+    assert cfg.maybe == 3
+
+
+def test_nested_dotted_path():
+    cfg = apply_overrides(Outer(), ("inner.n=9", "inner.rate=0.25"))
+    assert cfg.inner.n == 9 and cfg.inner.rate == 0.25
+    # outer untouched
+    assert cfg.sizes == (64, 64)
+
+
+def test_unknown_field_and_bad_value():
+    with pytest.raises(KeyError, match="no field"):
+        apply_overrides(Outer(), ("nope=1",))
+    with pytest.raises(ValueError, match="bool"):
+        apply_overrides(Outer(), ("flag=maybe",))
+    with pytest.raises(ValueError, match="nested config"):
+        apply_overrides(Outer(), ("inner=1",))
+
+
+def test_asdict_flat():
+    flat = asdict_flat(Outer())
+    assert flat["inner.n"] == 4
+    assert flat["flag"] is True
+    assert "inner" not in flat
