@@ -36,6 +36,7 @@ from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
     make_mesh,
     put_by_specs,
 )
+from actor_critic_algs_on_tensorflow_tpu.utils import prng
 
 
 @dataclasses.dataclass(frozen=True)
@@ -122,7 +123,7 @@ def make_a2c(cfg: A2CConfig) -> common.IterationFns:
 
     def local_iteration(state: common.OnPolicyState):
         dev = jax.lax.axis_index(DATA_AXIS)
-        it_key = jax.random.fold_in(jax.random.fold_in(state.key, state.step), dev)
+        it_key = prng.fold(state.key, state.step, dev)
 
         env_state, obs, traj, ep_info = common.collect_rollout(
             env, env_params, policy_fn,

@@ -54,6 +54,7 @@ from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
     make_mesh,
     put_by_specs,
 )
+from actor_critic_algs_on_tensorflow_tpu.utils import prng
 
 
 @dataclasses.dataclass(frozen=True)
@@ -192,7 +193,7 @@ def make_ppo(cfg: PPOConfig) -> common.IterationFns:
 
     def local_iteration(state: common.OnPolicyState):
         dev = jax.lax.axis_index(DATA_AXIS)
-        it_key = jax.random.fold_in(jax.random.fold_in(state.key, state.step), dev)
+        it_key = prng.fold(state.key, state.step, dev)
         k_roll, k_perm = jax.random.split(it_key)
 
         # Obs normalization uses the PRE-update statistics everywhere in

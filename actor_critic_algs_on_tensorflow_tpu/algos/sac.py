@@ -24,6 +24,7 @@ from flax import struct
 
 from actor_critic_algs_on_tensorflow_tpu import envs as envs_lib
 from actor_critic_algs_on_tensorflow_tpu.algos import offpolicy
+from actor_critic_algs_on_tensorflow_tpu.utils import prng
 from actor_critic_algs_on_tensorflow_tpu.algos.common import episode_metrics
 from actor_critic_algs_on_tensorflow_tpu.data.replay import ReplayBuffer
 from actor_critic_algs_on_tensorflow_tpu.models import (
@@ -144,7 +145,7 @@ def make_sac(cfg: SACConfig) -> offpolicy.OffPolicyFns:
 
     def local_iteration(state: offpolicy.OffPolicyState):
         dev = jax.lax.axis_index(DATA_AXIS)
-        it_key = jax.random.fold_in(jax.random.fold_in(state.key, state.step), dev)
+        it_key = prng.fold(state.key, state.step, dev)
         k_roll, k_upd = jax.random.split(it_key)
         replay = jax.tree_util.tree_map(lambda x: x[0], state.replay)
 
