@@ -81,6 +81,42 @@ def test_ppo_normalize_obs_trains_and_tracks():
     assert bool(jnp.all(jnp.abs(state.extra.mean) < 10.0))
 
 
+def test_eval_restores_normalizer(tmp_path):
+    """evaluate_checkpoint must apply the trained running statistics."""
+    from actor_critic_algs_on_tensorflow_tpu.algos.evaluation import (
+        evaluate_checkpoint,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.algos.ppo import (
+        PPOConfig,
+        make_ppo,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
+        Checkpointer,
+    )
+
+    cfg = PPOConfig(
+        env="Pendulum-v1",
+        num_envs=16,
+        rollout_length=16,
+        total_env_steps=16 * 16 * 2,
+        normalize_obs=True,
+        num_devices=1,
+    )
+    fns = make_ppo(cfg)
+    state = fns.init(jax.random.PRNGKey(0))
+    for _ in range(2):
+        state, _ = fns.iteration(state)
+    ck = Checkpointer(str(tmp_path / "ck"))
+    ck.save(2, state)
+    ck.wait()
+    ck.close()
+    mean_ret, per_env, frac = evaluate_checkpoint(
+        "ppo", cfg, str(tmp_path / "ck"), num_envs=4, max_steps=32
+    )
+    assert np.isfinite(mean_ret)
+    assert per_env.shape == (4,)
+
+
 def test_ppo_normalize_obs_rejects_images():
     import pytest
 
