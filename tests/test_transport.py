@@ -43,6 +43,33 @@ def test_pack_roundtrip_over_socketpair():
     b.close()
 
 
+def test_pack_roundtrip_fuzz():
+    """Randomized shapes/dtypes survive the wire format exactly."""
+    rng = np.random.default_rng(7)
+    dtypes = [np.float32, np.float64, np.int32, np.int64, np.uint8,
+              np.bool_, np.float16]
+    a, b = socket.socketpair()
+    for trial in range(30):
+        n_arrays = int(rng.integers(0, 6))
+        arrays = []
+        for _ in range(n_arrays):
+            ndim = int(rng.integers(0, 5))
+            shape = tuple(int(rng.integers(0, 5)) for _ in range(ndim))
+            dt = dtypes[int(rng.integers(0, len(dtypes)))]
+            arrays.append((rng.random(shape) * 100).astype(dt))
+        tag = int(rng.integers(0, 2**63 - 1))
+        kind = int(rng.integers(1, 6))
+        send_msg(a, kind, tag, arrays)
+        got_kind, got_tag, got = recv_msg(b)
+        assert (got_kind, got_tag) == (kind, tag)
+        assert len(got) == len(arrays)
+        for x, y in zip(arrays, got):
+            assert x.dtype == y.dtype and x.shape == y.shape
+            np.testing.assert_array_equal(x, y)
+    a.close()
+    b.close()
+
+
 def test_bad_magic_rejected():
     a, b = socket.socketpair()
     a.sendall(b"XXXX" + b"\x00" * 13)
