@@ -1,0 +1,28 @@
+"""Guardrail for the driver entry points: the jittable forward step
+and the multi-chip dry run must keep compiling and executing on the
+virtual mesh exactly as the driver invokes them."""
+
+import jax
+import pytest
+
+import __graft_entry__ as graft
+
+
+def test_entry_compiles_single_device():
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    logits, value = out
+    assert logits.shape[0] == args[1].shape[0]
+    assert value.shape[0] == args[1].shape[0]
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_8():
+    graft.dryrun_multichip(8)
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_odd():
+    # No even split: the 2-D data x time phase is skipped but the DP
+    # PPO step must still run.
+    graft.dryrun_multichip(1)
