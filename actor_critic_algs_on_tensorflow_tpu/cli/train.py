@@ -198,6 +198,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="max env steps per eval episode")
     p.add_argument("--stochastic", action="store_true",
                    help="sample the policy during --eval (default: greedy)")
+    p.add_argument("--platform", default=None, metavar="NAME",
+                   help="jax platform to run on (e.g. cpu, tpu). Applied "
+                        "via jax.config before first backend use, so it "
+                        "works even where the environment pre-selects a "
+                        "platform and JAX_PLATFORMS comes too late; "
+                        "host-resident gym:/native: envs need cpu or a "
+                        "standard TPU host runtime")
     p.add_argument("--actor-processes", action="store_true",
                    help="impala: run actors as separate processes "
                         "streaming over the TCP transport (the "
@@ -239,6 +246,10 @@ def make_config(args) -> Tuple[str, Any]:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
     algo, cfg = make_config(args)
     print(f"[train] algo={algo} config={cfg}", flush=True)
 

@@ -17,6 +17,7 @@ from typing import Dict
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
 
 from actor_critic_algs_on_tensorflow_tpu.envs.core import Box, Discrete, JaxEnv
@@ -24,9 +25,9 @@ from actor_critic_algs_on_tensorflow_tpu.envs.core import Box, Discrete, JaxEnv
 _N_ROWS = 6
 _N_COLS = 12
 # Atari Breakout scoring: top two rows 7, middle two 4, bottom two 1.
-_ROW_VALUES = jnp.asarray([7.0, 7.0, 4.0, 4.0, 1.0, 1.0], jnp.float32)
+_ROW_VALUES = np.asarray([7.0, 7.0, 4.0, 4.0, 1.0, 1.0], np.float32)
 # NOOP, FIRE, RIGHT, LEFT -> paddle direction.
-_ACTION_DIRS = jnp.asarray([0.0, 0.0, 1.0, -1.0], jnp.float32)
+_ACTION_DIRS = np.asarray([0.0, 0.0, 1.0, -1.0], np.float32)
 
 
 @struct.dataclass
@@ -100,7 +101,7 @@ class BreakoutTPU(JaxEnv[BreakoutState, BreakoutParams]):
         brick_w = params.width / _N_COLS
 
         # --- paddle -----------------------------------------------------
-        dx = _ACTION_DIRS[jnp.asarray(action, jnp.int32)] * params.paddle_speed
+        dx = jnp.asarray(_ACTION_DIRS)[jnp.asarray(action, jnp.int32)] * params.paddle_speed
         paddle_x = jnp.clip(state.paddle_x + dx, ph, w - 1.0 - ph)
 
         # --- ball flight ------------------------------------------------
@@ -130,7 +131,7 @@ class BreakoutTPU(JaxEnv[BreakoutState, BreakoutParams]):
         bricks = state.bricks.at[row_c, col_c].set(
             jnp.where(hit_brick, 0.0, state.bricks[row_c, col_c])
         )
-        brick_reward = jnp.where(hit_brick, _ROW_VALUES[row_c], f32(0.0))
+        brick_reward = jnp.where(hit_brick, jnp.asarray(_ROW_VALUES)[row_c], f32(0.0))
         vy = jnp.where(hit_brick, -vy, vy)
 
         # wall cleared -> respawn (Atari's second wall, generalized)

@@ -25,6 +25,7 @@ from typing import Dict
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
 
 from actor_critic_algs_on_tensorflow_tpu.envs.core import Box, Discrete, JaxEnv
@@ -61,7 +62,7 @@ class PongState:
 
 # Atari Pong action set: NOOP, FIRE, RIGHT(=up), LEFT(=down), RIGHTFIRE,
 # LEFTFIRE -> paddle direction {0, 0, -1, +1, -1, +1}.
-_ACTION_DIRS = jnp.asarray([0.0, 0.0, -1.0, 1.0, -1.0, 1.0], jnp.float32)
+_ACTION_DIRS = np.asarray([0.0, 0.0, -1.0, 1.0, -1.0, 1.0], np.float32)
 
 
 class PongTPU(JaxEnv[PongState, PongParams]):
@@ -110,7 +111,7 @@ class PongTPU(JaxEnv[PongState, PongParams]):
         h, w = f32(params.height), f32(params.width)
 
         # --- paddles ---------------------------------------------------
-        dy = _ACTION_DIRS[jnp.asarray(action, jnp.int32)] * params.paddle_speed
+        dy = jnp.asarray(_ACTION_DIRS)[jnp.asarray(action, jnp.int32)] * params.paddle_speed
         agent_y = jnp.clip(state.agent_y + dy, ph, h - 1.0 - ph)
         # Opponent tracks the ball while it approaches, else recenters.
         approaching = state.ball_vx < 0.0

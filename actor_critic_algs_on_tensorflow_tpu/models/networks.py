@@ -13,6 +13,8 @@ from __future__ import annotations
 from typing import Any, Callable, Sequence
 
 import flax.linen as nn
+import math
+
 import jax
 import jax.numpy as jnp
 
@@ -23,7 +25,7 @@ from actor_critic_algs_on_tensorflow_tpu.ops.ring_attention import (
 Dtype = Any
 
 
-def _orthogonal(scale: float = jnp.sqrt(2.0)):
+def _orthogonal(scale: float = math.sqrt(2.0)):
     return nn.initializers.orthogonal(scale)
 
 

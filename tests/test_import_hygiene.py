@@ -1,0 +1,46 @@
+"""Importing the package must not initialize the jax backend.
+
+The training environment pre-selects a platform before user code runs
+(e.g. a sitecustomize that registers an experimental TPU plugin), so
+platform selection via ``jax.config.update("jax_platforms", ...)`` —
+which the CLI's ``--platform`` flag uses — only works while the backend
+is still uninitialized. Any module-level ``jnp.asarray(...)`` /
+``jnp.sqrt(...)`` constant eagerly creates a device buffer, locks the
+platform choice, and silently breaks ``--platform cpu`` for the
+host-resident MuJoCo envs (BASELINE.json:9-10).
+"""
+
+import os
+import subprocess
+import sys
+
+_PROBE = """
+import jax
+import actor_critic_algs_on_tensorflow_tpu
+import actor_critic_algs_on_tensorflow_tpu.cli.train
+from jax._src import xla_bridge
+assert not xla_bridge._backends, (
+    "package import initialized the jax backend: %r" % (xla_bridge._backends,)
+)
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu", jax.devices()
+print("LAZY_OK")
+"""
+
+
+def test_package_import_leaves_backend_uninitialized():
+    # A fresh interpreter WITHOUT the conftest's JAX_PLATFORMS=cpu
+    # os.environ mutation (which the child would otherwise inherit and
+    # trivially satisfy the cpu assertion): drop the variable so the
+    # child sees only the environment's own platform presets, the state
+    # in which --platform must still win.
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    out = subprocess.run(
+        [sys.executable, "-c", _PROBE],
+        capture_output=True,
+        text=True,
+        timeout=180,
+        env=env,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "LAZY_OK" in out.stdout, (out.stdout, out.stderr)
