@@ -23,6 +23,7 @@ from flax import struct
 from jax.sharding import Mesh, PartitionSpec as P
 
 from actor_critic_algs_on_tensorflow_tpu.data.rollout import Trajectory
+from actor_critic_algs_on_tensorflow_tpu.utils import profiling
 from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
     DATA_AXIS,
     device_count,
@@ -321,5 +322,5 @@ def run_loop(
             checkpointer.save(
                 steps_done0 + (it + 1) * fns.steps_per_iteration, state
             )
-    jax.block_until_ready(last_metrics)
+    profiling.sync(last_metrics)
     return state, history

@@ -4,8 +4,9 @@ Capability parity: the reference era's TensorBoard profiling and the
 env-steps/sec counters that define its headline metric (SURVEY.md §5
 "Tracing / profiling"; BASELINE.json:2). TPU-native mechanisms:
 ``jax.profiler`` traces (viewable in Perfetto/XProf) around training
-iterations, and a ``block_until_ready`` wall-clock harness that
-separates compile time from steady-state throughput.
+iterations, and a wall-clock harness that separates compile time from
+steady-state throughput. All timing windows end with ``sync`` (a real
+host fetch), NOT bare ``jax.block_until_ready`` — see ``sync``.
 """
 
 from __future__ import annotations
@@ -15,6 +16,45 @@ import time
 from typing import Callable, Dict
 
 import jax
+
+
+def sync(tree):
+    """Wait until every computation feeding ``tree`` has finished.
+
+    ``jax.block_until_ready`` is a no-op on some experimental PJRT
+    plugins (observed on the tunneled single-chip "axon" TPU backend:
+    it returns while the work is still in flight), which silently turns
+    timing windows into dispatch-rate measurements — a 25x phantom
+    speedup. A host fetch cannot be elided, so after blocking we
+    ``device_get`` a small array leaf per distinct device set;
+    per-device execution is in-order, so a fetch completing implies
+    everything enqueued before it on those devices finished. Leaves
+    that span all mesh devices (e.g. fused-iteration metrics, outputs
+    of the shard_map program itself) fence the whole mesh with the one
+    fetch; host numpy leaves are ignored.
+
+    Returns ``tree`` unchanged.
+    """
+    leaves = [x for x in jax.tree_util.tree_leaves(tree) if isinstance(x, jax.Array)]
+    if not leaves:
+        return tree
+    jax.block_until_ready(tree)
+    # One fetch per distinct device set: a leaf only fences the queues
+    # of the devices it lives on, and host numpy leaves fence nothing.
+    smallest_per_devices = {}
+    for x in leaves:
+        try:
+            key = frozenset(d.id for d in x.devices())
+        except Exception:
+            key = None
+        prev = smallest_per_devices.get(key)
+        if prev is None or x.size < prev.size:
+            smallest_per_devices[key] = x
+    for x in smallest_per_devices.values():
+        if x.size > 1024:
+            x = x.ravel()[:1]
+        jax.device_get(x)
+    return tree
 
 
 @contextlib.contextmanager
@@ -45,17 +85,17 @@ def time_iteration(
     """
     t0 = time.perf_counter()
     state, metrics = step_fn(state)
-    jax.block_until_ready(metrics)
+    sync(metrics)
     compile_s = time.perf_counter() - t0
 
     for _ in range(max(0, warmup - 1)):
         state, metrics = step_fn(state)
-    jax.block_until_ready(metrics)
+    sync(metrics)
 
     t0 = time.perf_counter()
     for _ in range(iters):
         state, metrics = step_fn(state)
-    jax.block_until_ready(metrics)
+    sync(metrics)
     dt = time.perf_counter() - t0
     return {
         "compile_s": compile_s,

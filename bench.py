@@ -62,14 +62,19 @@ def measure(num_envs: int, rollout: int, timed_iters: int) -> float:
     fns = make_ppo(cfg)
     state = fns.init(jax.random.PRNGKey(0))
 
-    # Warmup: compile + one full iteration.
+    from actor_critic_algs_on_tensorflow_tpu.utils.profiling import sync
+
+    # Warmup: compile + one full iteration. sync() is a real host
+    # fetch: on the axon tunnel backend jax.block_until_ready returns
+    # while work is still in flight, which would (a) leak compile time
+    # into the timed window and (b) time dispatch instead of compute.
     state, metrics = fns.iteration(state)
-    jax.block_until_ready(metrics)
+    sync(metrics)
 
     t0 = time.perf_counter()
     for _ in range(timed_iters):
         state, metrics = fns.iteration(state)
-    jax.block_until_ready(metrics)
+    sync(metrics)
     dt = time.perf_counter() - t0
 
     steps = timed_iters * fns.steps_per_iteration
@@ -78,7 +83,7 @@ def measure(num_envs: int, rollout: int, timed_iters: int) -> float:
 
 def main() -> int:
     rollout = int(os.environ.get("BENCH_ROLLOUT", 128))
-    timed_iters = int(os.environ.get("BENCH_ITERS", 5))
+    timed_iters = int(os.environ.get("BENCH_ITERS", 10))
 
     if len(sys.argv) > 1 and sys.argv[1] == "--measure":
         # Child mode: measure one config, print the raw number.

@@ -21,6 +21,8 @@ import time
 
 import jax
 
+from actor_critic_algs_on_tensorflow_tpu.utils.profiling import sync
+
 
 def measure(num_envs: int, rollout: int, iters: int) -> float:
     from actor_critic_algs_on_tensorflow_tpu.algos.a2c import (
@@ -41,7 +43,7 @@ def measure(num_envs: int, rollout: int, iters: int) -> float:
     fns = make_a2c(cfg)
     state = fns.init(jax.random.PRNGKey(0))
     state, metrics = fns.iteration(state)
-    jax.block_until_ready(metrics)
+    sync(metrics)
     # Best-of-R timed windows: the small A2C iteration is dispatch- and
     # tunnel-latency-bound, so a single window is hostage to transient
     # host/tunnel hiccups; the max over windows is the chip's capability.
@@ -51,7 +53,7 @@ def measure(num_envs: int, rollout: int, iters: int) -> float:
         t0 = time.perf_counter()
         for _ in range(iters):
             state, metrics = fns.iteration(state)
-        jax.block_until_ready(metrics)
+        sync(metrics)
         dt = time.perf_counter() - t0
         best = max(best, iters * fns.steps_per_iteration / dt)
     return best
