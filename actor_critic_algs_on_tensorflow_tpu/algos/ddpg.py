@@ -10,7 +10,8 @@ TPU-first design: one iteration fuses ``steps_per_iter`` vectorized env
 steps (acting with OU noise, scattering transitions into the per-device
 HBM replay ring) and ``updates_per_iter`` sampled critic/actor updates
 with ``lax.pmean`` gradient averaging into a single jitted
-``shard_map`` program over the ``data`` mesh axis.
+``shard_map`` program over the ``data`` mesh axis (shared scaffolding:
+``algos/offpolicy.py``).
 """
 
 from __future__ import annotations
@@ -23,11 +24,7 @@ import jax.numpy as jnp
 import optax
 from flax import struct
 
-from actor_critic_algs_on_tensorflow_tpu import envs as envs_lib
 from actor_critic_algs_on_tensorflow_tpu.algos import offpolicy
-from actor_critic_algs_on_tensorflow_tpu.utils import prng
-from actor_critic_algs_on_tensorflow_tpu.algos.common import episode_metrics
-from actor_critic_algs_on_tensorflow_tpu.data.replay import ReplayBuffer
 from actor_critic_algs_on_tensorflow_tpu.models import (
     DeterministicActor,
     QCritic,
@@ -38,11 +35,8 @@ from actor_critic_algs_on_tensorflow_tpu.ops import (
     ou_step,
     polyak_update,
 )
-from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
-    DATA_AXIS,
-    device_count,
-    make_mesh,
-)
+from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import DATA_AXIS
+from actor_critic_algs_on_tensorflow_tpu.utils import prng
 
 
 @dataclasses.dataclass(frozen=True)
@@ -78,34 +72,11 @@ class DDPGParams:
 
 def make_ddpg(cfg: DDPGConfig) -> offpolicy.OffPolicyFns:
     """Build jitted ``init`` and fused ``iteration`` for DDPG."""
-    mesh = make_mesh(cfg.num_devices or None)
-    n_dev = device_count(mesh)
-    if cfg.num_envs % n_dev:
-        raise ValueError(
-            f"num_envs={cfg.num_envs} not divisible by {n_dev} devices"
-        )
-    local_envs = cfg.num_envs // n_dev
-    env, env_params = envs_lib.make(cfg.env, num_envs=local_envs)
-    genv, _ = envs_lib.make(cfg.env, num_envs=cfg.num_envs)
-    aspace = env.action_space(env_params)
-    action_dim = aspace.shape[-1] if aspace.shape else 1
-    action_scale = float(aspace.high)
-
-    actor = DeterministicActor(action_dim, cfg.hidden_sizes)
+    s = offpolicy.setup_trainer(cfg)
+    actor = DeterministicActor(s.action_dim, cfg.hidden_sizes)
     critic = QCritic(cfg.hidden_sizes)
-
-    def _tx(lr):
-        if cfg.max_grad_norm:
-            return optax.chain(
-                optax.clip_by_global_norm(cfg.max_grad_norm), optax.adam(lr)
-            )
-        return optax.adam(lr)
-
-    actor_tx, critic_tx = _tx(cfg.actor_lr), _tx(cfg.critic_lr)
-    buf = ReplayBuffer(cfg.replay_capacity)
-
-    steps_per_iteration = cfg.num_envs * cfg.steps_per_iter
-    warmup_iters = cfg.warmup_env_steps // max(steps_per_iteration, 1)
+    actor_tx = offpolicy.make_adam(cfg.actor_lr, cfg.max_grad_norm)
+    critic_tx = offpolicy.make_adam(cfg.critic_lr, cfg.max_grad_norm)
 
     def act_fn(params, obs, noise, key, step):
         """Tanh actor + OU noise; uniform-random during warmup."""
@@ -116,48 +87,36 @@ def make_ddpg(cfg: DDPGConfig) -> offpolicy.OffPolicyFns:
         )
         a = jnp.clip(a + eps, -1.0, 1.0)
         rand = jax.random.uniform(k_rand, a.shape, a.dtype, -1.0, 1.0)
-        a = jnp.where(step < warmup_iters, rand, a)
-        return a * action_scale, noise
+        a = jnp.where(step < s.warmup_iters, rand, a)
+        return a * s.action_scale, noise
 
     def init(key: jax.Array) -> offpolicy.OffPolicyState:
         k_env, k_actor, k_critic, k_state = jax.random.split(key, 4)
-        env_state, obs = genv.reset(k_env, env_params)
-        a0 = jnp.zeros((1, action_dim))
+        env_state, obs = s.genv.reset(k_env, s.env_params)
         actor_params = actor.init(k_actor, obs[:1])
-        critic_params = critic.init(k_critic, obs[:1], a0)
+        critic_params = critic.init(
+            k_critic, obs[:1], jnp.zeros((1, s.action_dim))
+        )
         # Targets are COPIES: with donated state, aliasing online and
         # target leaves would donate the same buffer twice.
         copy = lambda t: jax.tree_util.tree_map(jnp.copy, t)
-        params = DDPGParams(
-            actor=actor_params,
-            critic=critic_params,
-            target_actor=copy(actor_params),
-            target_critic=copy(critic_params),
-        )
-        # Per-device replay shards: [n_dev, capacity, ...] leaves so the
-        # data axis shards row 0 and each device sees its own ring.
-        example = offpolicy.Transition(
-            obs=obs[0],
-            action=jnp.zeros((action_dim,)) ,
-            reward=jnp.zeros(()),
-            next_obs=obs[0],
-            terminated=jnp.zeros(()),
-        )
-        replay = jax.vmap(lambda _: buf.init(example))(jnp.arange(n_dev))
-        state = offpolicy.OffPolicyState(
-            params=params,
+        return offpolicy.assemble_state(
+            s,
+            params=DDPGParams(
+                actor=actor_params,
+                critic=critic_params,
+                target_actor=copy(actor_params),
+                target_critic=copy(critic_params),
+            ),
             opt_state={
                 "actor": actor_tx.init(actor_params),
                 "critic": critic_tx.init(critic_params),
             },
             env_state=env_state,
             obs=obs,
-            noise=ou_init((cfg.num_envs, action_dim)),
-            replay=replay,
+            noise=ou_init((cfg.num_envs, s.action_dim)),
             key=k_state,
-            step=jnp.zeros((), jnp.int32),
         )
-        return offpolicy.put_sharded(state, mesh)
 
     def local_iteration(state: offpolicy.OffPolicyState):
         dev = jax.lax.axis_index(DATA_AXIS)
@@ -167,7 +126,7 @@ def make_ddpg(cfg: DDPGConfig) -> offpolicy.OffPolicyFns:
         replay = jax.tree_util.tree_map(lambda x: x[0], state.replay)
 
         env_state, obs, noise, replay, ep_info = offpolicy.act_then_store(
-            env, env_params, buf, act_fn,
+            s.env, s.env_params, s.buf, act_fn,
             state.params,
             (state.env_state, state.obs, state.noise, replay),
             k_roll, cfg.steps_per_iter, state.step,
@@ -176,12 +135,14 @@ def make_ddpg(cfg: DDPGConfig) -> offpolicy.OffPolicyFns:
 
         def one_update(carry, key):
             params, opt_state = carry
-            batch = buf.sample(replay, key, cfg.batch_size)
+            batch = s.buf.sample(replay, key, cfg.batch_size)
 
             def critic_loss_fn(cp):
                 a_next = actor.apply(params.target_actor, batch.next_obs)
                 q_next = critic.apply(
-                    params.target_critic, batch.next_obs, a_next * action_scale
+                    params.target_critic,
+                    batch.next_obs,
+                    a_next * s.action_scale,
                 )
                 y = batch.reward + cfg.gamma * (1.0 - batch.terminated) * q_next
                 q = critic.apply(cp, batch.obs, batch.action)
@@ -194,7 +155,7 @@ def make_ddpg(cfg: DDPGConfig) -> offpolicy.OffPolicyFns:
             def actor_loss_fn(ap):
                 a = actor.apply(ap, batch.obs)
                 return -jnp.mean(
-                    critic.apply(params.critic, batch.obs, a * action_scale)
+                    critic.apply(params.critic, batch.obs, a * s.action_scale)
                 )
 
             a_loss, a_grads = jax.value_and_grad(actor_loss_fn)(params.actor)
@@ -220,55 +181,29 @@ def make_ddpg(cfg: DDPGConfig) -> offpolicy.OffPolicyFns:
             m = {"q_loss": q_loss, "actor_loss": a_loss, "q_mean": jnp.mean(q)}
             return (new_params, {"actor": a_opt, "critic": c_opt}), m
 
-        def run_updates(carry):
-            return jax.lax.scan(
-                one_update, carry, jax.random.split(k_upd, cfg.updates_per_iter)
-            )
-
-        def skip_updates(carry):
-            zeros = {
-                "q_loss": jnp.zeros((cfg.updates_per_iter,)),
-                "actor_loss": jnp.zeros((cfg.updates_per_iter,)),
-                "q_mean": jnp.zeros((cfg.updates_per_iter,)),
-            }
-            return carry, zeros
-
         # No updates until past warmup AND the buffer can fill a batch.
         ready = jnp.logical_and(
-            state.step >= warmup_iters, replay.size >= cfg.batch_size
+            state.step >= s.warmup_iters, replay.size >= cfg.batch_size
         )
-        (params, opt_state), m = jax.lax.cond(
-            ready, run_updates, skip_updates,
+        (params, opt_state), m = offpolicy.gated_updates(
+            one_update,
             (state.params, state.opt_state),
+            jax.random.split(k_upd, cfg.updates_per_iter),
+            ("q_loss", "actor_loss", "q_mean"),
+            cfg.updates_per_iter,
+            ready,
         )
 
-        metrics = jax.lax.pmean(
-            jax.tree_util.tree_map(jnp.mean, m), DATA_AXIS
-        )
-        metrics.update(episode_metrics(ep_info))
-        metrics["replay_size"] = jax.lax.pmean(
-            replay.size.astype(jnp.float32), DATA_AXIS
-        )
-
-        new_state = offpolicy.OffPolicyState(
+        return offpolicy.finalize_iteration(
+            state,
             params=params,
             opt_state=opt_state,
             env_state=env_state,
             obs=obs,
             noise=noise,
-            replay=jax.tree_util.tree_map(lambda x: x[None], replay),
-            key=state.key,
-            step=state.step + 1,
+            replay=replay,
+            update_metrics=m,
+            ep_info=ep_info,
         )
-        return new_state, metrics
 
-    example = jax.eval_shape(init, jax.random.PRNGKey(0))
-    iteration = offpolicy.build_off_policy_iteration(
-        local_iteration, example, mesh
-    )
-    return offpolicy.OffPolicyFns(
-        init=init,
-        iteration=iteration,
-        mesh=mesh,
-        steps_per_iteration=steps_per_iteration,
-    )
+    return offpolicy.build_fns(s, init, local_iteration)

@@ -7,7 +7,7 @@ checkpoint/resume row). TPU-native: the whole evaluation — env scan +
 policy forward — is one jitted program via ``common.evaluate``.
 
 Model reconstruction mirrors each trainer's construction in
-``make_a2c``/``make_ppo``/``make_ddpg``/``make_sac``/``make_impala``;
+``make_a2c``/``make_ppo``/``make_ddpg``/``make_td3``/``make_sac``/``make_impala``;
 if a trainer's architecture wiring changes, change ``_act_fn`` to
 match (the round-trip test in tests/test_cli.py catches drift).
 """
@@ -69,7 +69,7 @@ def _act_fn(algo: str, cfg, aspace, params, stochastic: bool, norm=None):
                 if stochastic:
                     return DiagGaussian(mean, log_std).sample(key)
                 return mean
-    elif algo == "ddpg":
+    elif algo in ("ddpg", "td3"):
         actor = DeterministicActor(aspace.shape[-1], cfg.hidden_sizes)
         scale = float(aspace.high)
 
@@ -102,6 +102,10 @@ def _make_init(algo: str, cfg):
         from actor_critic_algs_on_tensorflow_tpu.algos.ddpg import make_ddpg
 
         return make_ddpg(cfg).init
+    if algo == "td3":
+        from actor_critic_algs_on_tensorflow_tpu.algos.td3 import make_td3
+
+        return make_td3(cfg).init
     if algo == "sac":
         from actor_critic_algs_on_tensorflow_tpu.algos.sac import make_sac
 

@@ -110,6 +110,175 @@ def put_sharded(state: OffPolicyState, mesh: Mesh) -> OffPolicyState:
     return put_by_specs(state, state_specs(state), mesh)
 
 
+class TrainerSetup(NamedTuple):
+    """Shared scaffolding every off-policy trainer builds identically:
+    mesh/env construction, action-space geometry, replay buffer, and
+    the warmup accounting (DDPG/TD3/SAC differ only in networks and
+    update math)."""
+
+    mesh: Mesh
+    n_dev: int
+    env: Any
+    env_params: Any
+    genv: Any
+    action_dim: int
+    action_scale: float
+    buf: ReplayBuffer
+    steps_per_iteration: int
+    warmup_iters: int
+
+
+def setup_trainer(cfg) -> TrainerSetup:
+    """Build the ``TrainerSetup`` from the common config fields
+    (``num_envs``/``num_devices``/``env``/``replay_capacity``/
+    ``steps_per_iter``/``warmup_env_steps``)."""
+    from actor_critic_algs_on_tensorflow_tpu import envs as envs_lib
+    from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
+        device_count,
+        make_mesh,
+    )
+
+    mesh = make_mesh(cfg.num_devices or None)
+    n_dev = device_count(mesh)
+    if cfg.num_envs % n_dev:
+        raise ValueError(
+            f"num_envs={cfg.num_envs} not divisible by {n_dev} devices"
+        )
+    env, env_params = envs_lib.make(cfg.env, num_envs=cfg.num_envs // n_dev)
+    genv, _ = envs_lib.make(cfg.env, num_envs=cfg.num_envs)
+    aspace = env.action_space(env_params)
+    steps_per_iteration = cfg.num_envs * cfg.steps_per_iter
+    return TrainerSetup(
+        mesh=mesh,
+        n_dev=n_dev,
+        env=env,
+        env_params=env_params,
+        genv=genv,
+        action_dim=aspace.shape[-1] if aspace.shape else 1,
+        action_scale=float(aspace.high),
+        buf=ReplayBuffer(cfg.replay_capacity),
+        steps_per_iteration=steps_per_iteration,
+        warmup_iters=cfg.warmup_env_steps // max(steps_per_iteration, 1),
+    )
+
+
+def make_adam(lr: float, max_grad_norm: float = 0.0):
+    """Adam with optional global-norm clipping (the trainers' shared
+    optimizer shape)."""
+    import optax
+
+    if max_grad_norm:
+        return optax.chain(
+            optax.clip_by_global_norm(max_grad_norm), optax.adam(lr)
+        )
+    return optax.adam(lr)
+
+
+def assemble_state(
+    s: TrainerSetup,
+    *,
+    params,
+    opt_state,
+    env_state,
+    obs,
+    noise,
+    key: jax.Array,
+) -> OffPolicyState:
+    """Per-device replay shards ([n_dev, capacity, ...] leaves so the
+    data axis shards row 0) + the mesh-placed ``OffPolicyState``."""
+    example = Transition(
+        obs=obs[0],
+        action=jnp.zeros((s.action_dim,)),
+        reward=jnp.zeros(()),
+        next_obs=obs[0],
+        terminated=jnp.zeros(()),
+    )
+    replay = jax.vmap(lambda _: s.buf.init(example))(jnp.arange(s.n_dev))
+    state = OffPolicyState(
+        params=params,
+        opt_state=opt_state,
+        env_state=env_state,
+        obs=obs,
+        noise=noise,
+        replay=replay,
+        key=key,
+        step=jnp.zeros((), jnp.int32),
+    )
+    return put_sharded(state, s.mesh)
+
+
+def gated_updates(
+    one_update: Callable,
+    carry,
+    xs,
+    metric_keys,
+    updates_per_iter: int,
+    ready: jax.Array,
+):
+    """Scan ``one_update`` over ``xs`` iff ``ready`` (past warmup and a
+    full batch in replay); otherwise pass the carry through with zeroed
+    per-update metrics (shapes must match the scan's stacked outputs)."""
+
+    def run(c):
+        return jax.lax.scan(one_update, c, xs)
+
+    def skip(c):
+        return c, {k: jnp.zeros((updates_per_iter,)) for k in metric_keys}
+
+    return jax.lax.cond(ready, run, skip, carry)
+
+
+def finalize_iteration(
+    state: OffPolicyState,
+    *,
+    params,
+    opt_state,
+    env_state,
+    obs,
+    noise,
+    replay,
+    update_metrics,
+    ep_info,
+):
+    """pmean'd scalar metrics + episode stats + the rebuilt state (the
+    tail every off-policy ``local_iteration`` shares)."""
+    from actor_critic_algs_on_tensorflow_tpu.algos.common import (
+        episode_metrics,
+    )
+
+    metrics = jax.lax.pmean(
+        jax.tree_util.tree_map(jnp.mean, update_metrics), DATA_AXIS
+    )
+    metrics.update(episode_metrics(ep_info))
+    metrics["replay_size"] = jax.lax.pmean(
+        replay.size.astype(jnp.float32), DATA_AXIS
+    )
+    new_state = OffPolicyState(
+        params=params,
+        opt_state=opt_state,
+        env_state=env_state,
+        obs=obs,
+        noise=noise,
+        replay=jax.tree_util.tree_map(lambda x: x[None], replay),
+        key=state.key,
+        step=state.step + 1,
+    )
+    return new_state, metrics
+
+
+def build_fns(
+    s: TrainerSetup, init: Callable, local_iteration: Callable
+) -> OffPolicyFns:
+    """eval_shape the init, compile the fused iteration, pack the API."""
+    example = jax.eval_shape(init, jax.random.PRNGKey(0))
+    return OffPolicyFns(
+        init=init,
+        iteration=build_off_policy_iteration(local_iteration, example, s.mesh),
+        mesh=s.mesh,
+        steps_per_iteration=s.steps_per_iteration,
+    )
+
+
 def act_then_store(
     env,
     env_params,

@@ -9,7 +9,7 @@ Humanoid-class tasks (BASELINE.json:10; SURVEY.md §2.1 "SAC trainer",
 TPU-first design mirrors ``algos.ddpg``: one jitted ``shard_map``
 program fuses env stepping into the per-device HBM replay ring with the
 sampled twin-Q / actor / alpha updates; gradients ``lax.pmean``-averaged
-over the ``data`` axis.
+over the ``data`` axis (shared scaffolding: ``algos/offpolicy.py``).
 """
 
 from __future__ import annotations
@@ -22,21 +22,14 @@ import jax.numpy as jnp
 import optax
 from flax import struct
 
-from actor_critic_algs_on_tensorflow_tpu import envs as envs_lib
 from actor_critic_algs_on_tensorflow_tpu.algos import offpolicy
-from actor_critic_algs_on_tensorflow_tpu.utils import prng
-from actor_critic_algs_on_tensorflow_tpu.algos.common import episode_metrics
-from actor_critic_algs_on_tensorflow_tpu.data.replay import ReplayBuffer
 from actor_critic_algs_on_tensorflow_tpu.models import (
     SquashedGaussianActor,
     TwinQCritic,
 )
 from actor_critic_algs_on_tensorflow_tpu.ops import TanhGaussian, polyak_update
-from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
-    DATA_AXIS,
-    device_count,
-    make_mesh,
-)
+from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import DATA_AXIS
+from actor_critic_algs_on_tensorflow_tpu.utils import prng
 
 
 @dataclasses.dataclass(frozen=True)
@@ -72,29 +65,14 @@ class SACParams:
 
 def make_sac(cfg: SACConfig) -> offpolicy.OffPolicyFns:
     """Build jitted ``init`` and fused ``iteration`` for SAC."""
-    mesh = make_mesh(cfg.num_devices or None)
-    n_dev = device_count(mesh)
-    if cfg.num_envs % n_dev:
-        raise ValueError(
-            f"num_envs={cfg.num_envs} not divisible by {n_dev} devices"
-        )
-    local_envs = cfg.num_envs // n_dev
-    env, env_params = envs_lib.make(cfg.env, num_envs=local_envs)
-    genv, _ = envs_lib.make(cfg.env, num_envs=cfg.num_envs)
-    aspace = env.action_space(env_params)
-    action_dim = aspace.shape[-1] if aspace.shape else 1
-    action_scale = float(aspace.high)
-    target_entropy = -float(action_dim) * cfg.target_entropy_scale
+    s = offpolicy.setup_trainer(cfg)
+    target_entropy = -float(s.action_dim) * cfg.target_entropy_scale
 
-    actor = SquashedGaussianActor(action_dim, cfg.hidden_sizes)
+    actor = SquashedGaussianActor(s.action_dim, cfg.hidden_sizes)
     critic = TwinQCritic(cfg.hidden_sizes)
-    actor_tx = optax.adam(cfg.actor_lr)
-    critic_tx = optax.adam(cfg.critic_lr)
-    alpha_tx = optax.adam(cfg.alpha_lr)
-    buf = ReplayBuffer(cfg.replay_capacity)
-
-    steps_per_iteration = cfg.num_envs * cfg.steps_per_iter
-    warmup_iters = cfg.warmup_env_steps // max(steps_per_iteration, 1)
+    actor_tx = offpolicy.make_adam(cfg.actor_lr)
+    critic_tx = offpolicy.make_adam(cfg.critic_lr)
+    alpha_tx = offpolicy.make_adam(cfg.alpha_lr)
 
     def act_fn(params, obs, noise, key, step):
         """Stochastic squashed-Gaussian acting; uniform during warmup."""
@@ -102,33 +80,26 @@ def make_sac(cfg: SACConfig) -> offpolicy.OffPolicyFns:
         mean, log_std = actor.apply(params.actor, obs)
         a = TanhGaussian(mean, log_std).sample(k_sample)
         rand = jax.random.uniform(k_rand, a.shape, a.dtype, -1.0, 1.0)
-        a = jnp.where(step < warmup_iters, rand, a)
-        return a * action_scale, noise
+        a = jnp.where(step < s.warmup_iters, rand, a)
+        return a * s.action_scale, noise
 
     def init(key: jax.Array) -> offpolicy.OffPolicyState:
         k_env, k_actor, k_critic, k_state = jax.random.split(key, 4)
-        env_state, obs = genv.reset(k_env, env_params)
-        a0 = jnp.zeros((1, action_dim))
+        env_state, obs = s.genv.reset(k_env, s.env_params)
         actor_params = actor.init(k_actor, obs[:1])
-        critic_params = critic.init(k_critic, obs[:1], a0)
+        critic_params = critic.init(
+            k_critic, obs[:1], jnp.zeros((1, s.action_dim))
+        )
         log_alpha = jnp.log(jnp.asarray(cfg.init_alpha, jnp.float32))
-        params = SACParams(
-            actor=actor_params,
-            critic=critic_params,
-            # Copy: donated state must not alias online/target buffers.
-            target_critic=jax.tree_util.tree_map(jnp.copy, critic_params),
-            log_alpha=log_alpha,
-        )
-        example = offpolicy.Transition(
-            obs=obs[0],
-            action=jnp.zeros((action_dim,)),
-            reward=jnp.zeros(()),
-            next_obs=obs[0],
-            terminated=jnp.zeros(()),
-        )
-        replay = jax.vmap(lambda _: buf.init(example))(jnp.arange(n_dev))
-        state = offpolicy.OffPolicyState(
-            params=params,
+        return offpolicy.assemble_state(
+            s,
+            params=SACParams(
+                actor=actor_params,
+                critic=critic_params,
+                # Copy: donated state must not alias online/target buffers.
+                target_critic=jax.tree_util.tree_map(jnp.copy, critic_params),
+                log_alpha=log_alpha,
+            ),
             opt_state={
                 "actor": actor_tx.init(actor_params),
                 "critic": critic_tx.init(critic_params),
@@ -137,11 +108,8 @@ def make_sac(cfg: SACConfig) -> offpolicy.OffPolicyFns:
             env_state=env_state,
             obs=obs,
             noise=jnp.zeros((cfg.num_envs,)),  # SAC needs no noise carry
-            replay=replay,
             key=k_state,
-            step=jnp.zeros((), jnp.int32),
         )
-        return offpolicy.put_sharded(state, mesh)
 
     def local_iteration(state: offpolicy.OffPolicyState):
         dev = jax.lax.axis_index(DATA_AXIS)
@@ -150,7 +118,7 @@ def make_sac(cfg: SACConfig) -> offpolicy.OffPolicyFns:
         replay = jax.tree_util.tree_map(lambda x: x[0], state.replay)
 
         env_state, obs, noise, replay, ep_info = offpolicy.act_then_store(
-            env, env_params, buf, act_fn,
+            s.env, s.env_params, s.buf, act_fn,
             state.params,
             (state.env_state, state.obs, state.noise, replay),
             k_roll, cfg.steps_per_iter, state.step,
@@ -159,7 +127,7 @@ def make_sac(cfg: SACConfig) -> offpolicy.OffPolicyFns:
         def one_update(carry, key):
             params, opt_state = carry
             k_batch, k_next, k_pi = jax.random.split(key, 3)
-            batch = buf.sample(replay, k_batch, cfg.batch_size)
+            batch = s.buf.sample(replay, k_batch, cfg.batch_size)
             alpha = jnp.exp(params.log_alpha)
 
             def critic_loss_fn(cp):
@@ -168,7 +136,9 @@ def make_sac(cfg: SACConfig) -> offpolicy.OffPolicyFns:
                     mean, log_std
                 ).sample_and_log_prob(k_next)
                 q1t, q2t = critic.apply(
-                    params.target_critic, batch.next_obs, a_next * action_scale
+                    params.target_critic,
+                    batch.next_obs,
+                    a_next * s.action_scale,
                 )
                 v_next = jnp.minimum(q1t, q2t) - alpha * logp_next
                 y = batch.reward + cfg.gamma * (1.0 - batch.terminated) * v_next
@@ -187,7 +157,7 @@ def make_sac(cfg: SACConfig) -> offpolicy.OffPolicyFns:
                 mean, log_std = actor.apply(ap, batch.obs)
                 a, logp = TanhGaussian(mean, log_std).sample_and_log_prob(k_pi)
                 q1, q2 = critic.apply(
-                    params.critic, batch.obs, a * action_scale
+                    params.critic, batch.obs, a * s.action_scale
                 )
                 q = jnp.minimum(q1, q2)
                 return jnp.mean(alpha * logp - q), jnp.mean(logp)
@@ -236,56 +206,29 @@ def make_sac(cfg: SACConfig) -> offpolicy.OffPolicyFns:
             new_opt = {"actor": a_opt, "critic": c_opt, "alpha": al_opt}
             return (new_params, new_opt), m
 
-        def run_updates(carry):
-            return jax.lax.scan(
-                one_update, carry, jax.random.split(k_upd, cfg.updates_per_iter)
-            )
-
-        def skip_updates(carry):
-            zeros = jax.tree_util.tree_map(
-                lambda _: jnp.zeros((cfg.updates_per_iter,)),
-                {
-                    "q_loss": 0, "actor_loss": 0, "alpha_loss": 0,
-                    "alpha": 0, "entropy": 0, "q_mean": 0,
-                },
-            )
-            return carry, zeros
-
         ready = jnp.logical_and(
-            state.step >= warmup_iters, replay.size >= cfg.batch_size
+            state.step >= s.warmup_iters, replay.size >= cfg.batch_size
         )
-        (params, opt_state), m = jax.lax.cond(
-            ready, run_updates, skip_updates,
+        (params, opt_state), m = offpolicy.gated_updates(
+            one_update,
             (state.params, state.opt_state),
+            jax.random.split(k_upd, cfg.updates_per_iter),
+            ("q_loss", "actor_loss", "alpha_loss", "alpha", "entropy",
+             "q_mean"),
+            cfg.updates_per_iter,
+            ready,
         )
 
-        metrics = jax.lax.pmean(
-            jax.tree_util.tree_map(jnp.mean, m), DATA_AXIS
-        )
-        metrics.update(episode_metrics(ep_info))
-        metrics["replay_size"] = jax.lax.pmean(
-            replay.size.astype(jnp.float32), DATA_AXIS
-        )
-
-        new_state = offpolicy.OffPolicyState(
+        return offpolicy.finalize_iteration(
+            state,
             params=params,
             opt_state=opt_state,
             env_state=env_state,
             obs=obs,
             noise=noise,
-            replay=jax.tree_util.tree_map(lambda x: x[None], replay),
-            key=state.key,
-            step=state.step + 1,
+            replay=replay,
+            update_metrics=m,
+            ep_info=ep_info,
         )
-        return new_state, metrics
 
-    example = jax.eval_shape(init, jax.random.PRNGKey(0))
-    iteration = offpolicy.build_off_policy_iteration(
-        local_iteration, example, mesh
-    )
-    return offpolicy.OffPolicyFns(
-        init=init,
-        iteration=iteration,
-        mesh=mesh,
-        steps_per_iteration=steps_per_iteration,
-    )
+    return offpolicy.build_fns(s, init, local_iteration)

@@ -1,0 +1,261 @@
+"""TD3: twin delayed deep deterministic policy gradient.
+
+Family completion for the reference's continuous-control pair
+(BASELINE.json:9-10 span DDPG and SAC; TD3 — Fujimoto et al. 2018 —
+is DDPG plus the three fixes SAC's twin-Q also builds on): (1) twin
+critics with a min-target to curb Q overestimation, (2) target-policy
+smoothing (clipped Gaussian noise on the target action), and
+(3) delayed policy/target updates every ``policy_delay`` critic steps.
+
+Runs on the same fused off-policy substrate as DDPG/SAC
+(``algos/offpolicy.py``): env steps scatter into the per-device HBM
+replay ring and sampled updates ``lax.pmean`` their gradients, all in
+one jitted ``shard_map`` iteration. Exploration is the paper's
+Gaussian noise (no OU process).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from actor_critic_algs_on_tensorflow_tpu.algos import offpolicy
+from actor_critic_algs_on_tensorflow_tpu.models import (
+    DeterministicActor,
+    TwinQCritic,
+)
+from actor_critic_algs_on_tensorflow_tpu.ops import polyak_update
+from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import DATA_AXIS
+from actor_critic_algs_on_tensorflow_tpu.utils import prng
+
+
+@dataclasses.dataclass(frozen=True)
+class TD3Config:
+    env: str = "Pendulum-v1"
+    num_envs: int = 16              # global, across all devices
+    steps_per_iter: int = 8         # env steps per env per iteration
+    updates_per_iter: int = 8       # gradient updates per iteration
+    total_env_steps: int = 200_000
+    replay_capacity: int = 100_000  # per device
+    batch_size: int = 256           # per device
+    warmup_env_steps: int = 1_000   # uniform-random acting, global steps
+    hidden_sizes: Tuple[int, ...] = (256, 256)
+    actor_lr: float = 1e-3
+    critic_lr: float = 1e-3
+    gamma: float = 0.99
+    tau: float = 0.005
+    explore_sigma: float = 0.1      # exploration noise std (action scale 1)
+    target_sigma: float = 0.2      # target-policy smoothing noise std
+    target_clip: float = 0.5        # smoothing noise clip
+    policy_delay: int = 2           # critic updates per actor/target update
+    max_grad_norm: float = 0.0      # 0 = no clipping
+    seed: int = 0
+    num_devices: int = 0
+
+
+@struct.dataclass
+class TD3Params:
+    actor: any
+    critic: any
+    target_actor: any
+    target_critic: any
+
+
+def make_td3(cfg: TD3Config) -> offpolicy.OffPolicyFns:
+    """Build jitted ``init`` and fused ``iteration`` for TD3."""
+    s = offpolicy.setup_trainer(cfg)
+    actor = DeterministicActor(s.action_dim, cfg.hidden_sizes)
+    critic = TwinQCritic(cfg.hidden_sizes)
+    actor_tx = offpolicy.make_adam(cfg.actor_lr, cfg.max_grad_norm)
+    critic_tx = offpolicy.make_adam(cfg.critic_lr, cfg.max_grad_norm)
+
+    def act_fn(params, obs, noise, key, step):
+        """Tanh actor + Gaussian noise; uniform-random during warmup.
+
+        ``noise`` is an unused placeholder (TD3 noise is i.i.d. per
+        step, unlike DDPG's OU carry); kept for the shared
+        ``act_then_store`` signature.
+        """
+        k_eps, k_rand = jax.random.split(key)
+        a = actor.apply(params.actor, obs)
+        eps = cfg.explore_sigma * jax.random.normal(k_eps, a.shape, a.dtype)
+        a = jnp.clip(a + eps, -1.0, 1.0)
+        rand = jax.random.uniform(k_rand, a.shape, a.dtype, -1.0, 1.0)
+        a = jnp.where(step < s.warmup_iters, rand, a)
+        return a * s.action_scale, noise
+
+    def init(key: jax.Array) -> offpolicy.OffPolicyState:
+        k_env, k_actor, k_critic, k_state = jax.random.split(key, 4)
+        env_state, obs = s.genv.reset(k_env, s.env_params)
+        actor_params = actor.init(k_actor, obs[:1])
+        critic_params = critic.init(
+            k_critic, obs[:1], jnp.zeros((1, s.action_dim))
+        )
+        # Targets are COPIES: with donated state, aliasing online and
+        # target leaves would donate the same buffer twice.
+        copy = lambda t: jax.tree_util.tree_map(jnp.copy, t)
+        return offpolicy.assemble_state(
+            s,
+            params=TD3Params(
+                actor=actor_params,
+                critic=critic_params,
+                target_actor=copy(actor_params),
+                target_critic=copy(critic_params),
+            ),
+            opt_state={
+                "actor": actor_tx.init(actor_params),
+                "critic": critic_tx.init(critic_params),
+            },
+            env_state=env_state,
+            obs=obs,
+            noise=jnp.zeros(()),
+            key=k_state,
+        )
+
+    def local_iteration(state: offpolicy.OffPolicyState):
+        dev = jax.lax.axis_index(DATA_AXIS)
+        it_key = prng.fold(state.key, state.step, dev)
+        k_roll, k_upd = jax.random.split(it_key)
+        replay = jax.tree_util.tree_map(lambda x: x[0], state.replay)
+
+        env_state, obs, noise, replay, ep_info = offpolicy.act_then_store(
+            s.env, s.env_params, s.buf, act_fn,
+            state.params,
+            (state.env_state, state.obs, state.noise, replay),
+            k_roll, cfg.steps_per_iter, state.step,
+        )
+
+        def one_update(carry, xs):
+            params, opt_state = carry
+            upd_idx, key = xs
+            k_batch, k_smooth = jax.random.split(key)
+            batch = s.buf.sample(replay, k_batch, cfg.batch_size)
+
+            def critic_loss_fn(cp):
+                # Target-policy smoothing: clipped noise on the target
+                # action before the twin-min backup (TD3 eq. 14-15).
+                a_next = actor.apply(params.target_actor, batch.next_obs)
+                eps = jnp.clip(
+                    cfg.target_sigma
+                    * jax.random.normal(k_smooth, a_next.shape, a_next.dtype),
+                    -cfg.target_clip,
+                    cfg.target_clip,
+                )
+                a_next = jnp.clip(a_next + eps, -1.0, 1.0)
+                q1t, q2t = critic.apply(
+                    params.target_critic,
+                    batch.next_obs,
+                    a_next * s.action_scale,
+                )
+                q_next = jnp.minimum(q1t, q2t)
+                y = batch.reward + cfg.gamma * (1.0 - batch.terminated) * q_next
+                y = jax.lax.stop_gradient(y)
+                q1, q2 = critic.apply(cp, batch.obs, batch.action)
+                loss = jnp.mean((q1 - y) ** 2) + jnp.mean((q2 - y) ** 2)
+                return loss, q1
+
+            (q_loss, q1), q_grads = jax.value_and_grad(
+                critic_loss_fn, has_aux=True
+            )(params.critic)
+            q_grads = jax.lax.pmean(q_grads, DATA_AXIS)
+            q_up, c_opt = critic_tx.update(
+                q_grads, opt_state["critic"], params.critic
+            )
+            new_critic = optax.apply_updates(params.critic, q_up)
+
+            # Delayed policy + target updates, every policy_delay
+            # critic steps. The actor forward/backward and its pmean
+            # run only in the taken branch: the predicate is the same
+            # on every device (upd_idx is replicated), so the
+            # collective inside the branch is uniform across the mesh.
+            def do_actor(_):
+                def actor_loss_fn(ap):
+                    a = actor.apply(ap, batch.obs)
+                    q1_pi, _ = critic.apply(
+                        params.critic, batch.obs, a * s.action_scale
+                    )
+                    return -jnp.mean(q1_pi)
+
+                a_loss, a_grads = jax.value_and_grad(actor_loss_fn)(
+                    params.actor
+                )
+                a_grads = jax.lax.pmean(a_grads, DATA_AXIS)
+                a_up, a_opt = actor_tx.update(
+                    a_grads, opt_state["actor"], params.actor
+                )
+                new_actor = optax.apply_updates(params.actor, a_up)
+                return (
+                    new_actor,
+                    a_opt,
+                    polyak_update(params.target_actor, new_actor, cfg.tau),
+                    polyak_update(params.target_critic, new_critic, cfg.tau),
+                    a_loss,
+                    jnp.ones(()),
+                )
+
+            def skip_actor(_):
+                return (
+                    params.actor,
+                    opt_state["actor"],
+                    params.target_actor,
+                    params.target_critic,
+                    jnp.zeros(()),
+                    jnp.zeros(()),
+                )
+
+            new_actor, a_opt, t_actor, t_critic, a_loss, did = jax.lax.cond(
+                upd_idx % cfg.policy_delay == 0, do_actor, skip_actor, None
+            )
+            new_params = TD3Params(
+                actor=new_actor,
+                critic=new_critic,
+                target_actor=t_actor,
+                target_critic=t_critic,
+            )
+            m = {
+                "q_loss": q_loss,
+                "actor_loss": a_loss,
+                "actor_updates": did,
+                "q_mean": jnp.mean(q1),
+            }
+            return (new_params, {"actor": a_opt, "critic": c_opt}), m
+
+        # Continue the global update counter across iterations so the
+        # delay phase does not reset every iteration.
+        base = (state.step - s.warmup_iters) * cfg.updates_per_iter
+        idxs = base + jnp.arange(cfg.updates_per_iter)
+        ready = jnp.logical_and(
+            state.step >= s.warmup_iters, replay.size >= cfg.batch_size
+        )
+        (params, opt_state), m = offpolicy.gated_updates(
+            one_update,
+            (state.params, state.opt_state),
+            (idxs, jax.random.split(k_upd, cfg.updates_per_iter)),
+            ("q_loss", "actor_loss", "actor_updates", "q_mean"),
+            cfg.updates_per_iter,
+            ready,
+        )
+        # actor_loss is only produced on delay steps; report the mean
+        # over the updates that actually ran (0 when none did).
+        did = m.pop("actor_updates")
+        masked_mean = jnp.sum(m["actor_loss"]) / jnp.maximum(jnp.sum(did), 1.0)
+        m["actor_loss"] = jnp.full_like(m["actor_loss"], masked_mean)
+
+        return offpolicy.finalize_iteration(
+            state,
+            params=params,
+            opt_state=opt_state,
+            env_state=env_state,
+            obs=obs,
+            noise=noise,
+            replay=replay,
+            update_metrics=m,
+            ep_info=ep_info,
+        )
+
+    return offpolicy.build_fns(s, init, local_iteration)

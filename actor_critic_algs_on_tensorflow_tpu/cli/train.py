@@ -87,6 +87,16 @@ PRESETS = {
             "total_env_steps": 1_000_000,
         },
     ),
+    # DDPG successor: twin delayed DDPG on the same MuJoCo task
+    "td3-halfcheetah": (
+        "td3",
+        {
+            "env": "gym:HalfCheetah-v4",
+            "num_envs": 8,
+            "num_devices": 1,
+            "total_env_steps": 1_000_000,
+        },
+    ),
     # 4. SAC on Humanoid: twin-Q + learned alpha (BASELINE.json:10)
     "sac-humanoid": (
         "sac",
@@ -167,7 +177,7 @@ def build_parser() -> argparse.ArgumentParser:
         description="TPU-native actor-critic training entrypoints",
     )
     p.add_argument("--preset", choices=sorted(PRESETS), help="named baseline config")
-    p.add_argument("--algo", choices=["a2c", "ppo", "ddpg", "sac", "impala"])
+    p.add_argument("--algo", choices=["a2c", "ppo", "ddpg", "td3", "sac", "impala"])
     p.add_argument("--env", help="env id (pure-JAX name or gym:<id>)")
     p.add_argument("--total-steps", type=int, help="total env steps")
     p.add_argument("--seed", type=int, default=None)
@@ -218,11 +228,13 @@ def make_config(args) -> Tuple[str, Any]:
     from actor_critic_algs_on_tensorflow_tpu.algos.impala import ImpalaConfig
     from actor_critic_algs_on_tensorflow_tpu.algos.ppo import PPOConfig
     from actor_critic_algs_on_tensorflow_tpu.algos.sac import SACConfig
+    from actor_critic_algs_on_tensorflow_tpu.algos.td3 import TD3Config
 
     classes = {
         "a2c": A2CConfig,
         "ppo": PPOConfig,
         "ddpg": DDPGConfig,
+        "td3": TD3Config,
         "sac": SACConfig,
         "impala": ImpalaConfig,
     }
@@ -376,6 +388,10 @@ def _run(args, algo, cfg, writer) -> int:
         from actor_critic_algs_on_tensorflow_tpu.algos.ddpg import make_ddpg
 
         fns = make_ddpg(cfg)
+    elif algo == "td3":
+        from actor_critic_algs_on_tensorflow_tpu.algos.td3 import make_td3
+
+        fns = make_td3(cfg)
     else:
         from actor_critic_algs_on_tensorflow_tpu.algos.sac import make_sac
 

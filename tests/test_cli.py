@@ -7,9 +7,11 @@ import pytest
 from actor_critic_algs_on_tensorflow_tpu.cli import train as cli
 
 
-def test_presets_cover_the_five_baselines():
+def test_presets_cover_all_algos():
     algos = {algo for algo, _ in cli.PRESETS.values()}
-    assert algos == {"a2c", "ppo", "ddpg", "sac", "impala"}
+    # The five baseline algos (BASELINE.json:7-11) must all have a
+    # preset; beyond-parity additions (td3) ride along.
+    assert algos == {"a2c", "ppo", "ddpg", "td3", "sac", "impala"}
 
 
 def test_make_config_preset_and_overrides():
@@ -153,3 +155,21 @@ def test_evaluate_checkpoint_sac(tmp_path):
 
     assert np.isfinite(mean_ret)
     assert per_env.shape == (4,)
+
+
+def test_cli_td3_train_then_eval(tmp_path, capsys):
+    """TD3 through the full CLI surface: train, checkpoint, eval."""
+    common = [
+        "--algo", "td3", "--env", "Pendulum-v1",
+        "--set", "num_envs=8", "--set", "num_devices=1",
+        "--set", "replay_capacity=2048", "--set", "warmup_env_steps=128",
+        "--checkpoint-dir", str(tmp_path / "ck"),
+    ]
+    assert cli.main(
+        common + ["--total-steps", "512", "--log-interval", "100"]
+    ) == 0
+    assert cli.main(
+        common + ["--eval", "--eval-envs", "4", "--eval-steps", "32"]
+    ) == 0
+    out = capsys.readouterr().out
+    assert "[eval] avg_return=" in out
