@@ -12,7 +12,8 @@ designed TPU-first rather than ported:
   ICI ``jax.sharding.Mesh`` (the NCCL/MirroredStrategy analog),
 - rollout/replay buffers live in TPU HBM as preallocated pytrees,
 - environments run either fully on-device (pure-JAX envs, Anakin-style)
-  or on host, bridged with double-buffered pipelining (Sebulba-style).
+  or on host, bridged with ordered ``io_callback`` (process-parallel
+  vector envs; the IMPALA actor threads are the overlapped topology).
 """
 
 __version__ = "0.1.0"

@@ -23,6 +23,11 @@ from flax import struct
 from jax.sharding import Mesh, PartitionSpec as P
 
 from actor_critic_algs_on_tensorflow_tpu.data.rollout import Trajectory
+from actor_critic_algs_on_tensorflow_tpu.models import (
+    DiscreteActorCritic,
+    GaussianActorCritic,
+)
+from actor_critic_algs_on_tensorflow_tpu.ops import Categorical, DiagGaussian
 from actor_critic_algs_on_tensorflow_tpu.utils import profiling
 from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
     DATA_AXIS,
@@ -89,6 +94,40 @@ def build_shard_map_iteration(
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
 
+def make_policy_head(action_space, *, torso, hidden_sizes, compute_dtype):
+    """(model, dist_and_value) for a discrete (Categorical) or
+    continuous (diagonal-Gaussian) action space — the policy-head
+    dispatch shared by the on-policy and IMPALA trainers.
+
+    ``torso`` applies to the discrete head; the continuous head is the
+    MLP ``GaussianActorCritic`` (matching the reference's MuJoCo-scale
+    policies, BASELINE.json:9-10).
+    """
+    discrete = hasattr(action_space, "n")
+    if discrete:
+        model = DiscreteActorCritic(
+            num_actions=action_space.n,
+            torso=torso,
+            hidden_sizes=hidden_sizes,
+            dtype=jnp.dtype(compute_dtype),
+        )
+    else:
+        model = GaussianActorCritic(
+            action_dim=action_space.shape[-1],
+            hidden_sizes=hidden_sizes,
+            dtype=jnp.dtype(compute_dtype),
+        )
+
+    def dist_and_value(params, obs):
+        if discrete:
+            logits, value = model.apply(params, obs)
+            return Categorical(logits), value
+        mean, log_std, value = model.apply(params, obs)
+        return DiagGaussian(mean, log_std), value
+
+    return model, dist_and_value
+
+
 def collect_rollout(
     env,
     env_params,
@@ -148,7 +187,9 @@ def collect_rollout(
 
 
 def global_normalize_advantages(
-    adv: jax.Array, axis_name: str | None = DATA_AXIS, eps: float = 1e-8
+    adv: jax.Array,
+    axis_name: str | Tuple[str, ...] | None = DATA_AXIS,
+    eps: float = 1e-8,
 ):
     """Whiten advantages with GLOBAL (cross-device) statistics.
 

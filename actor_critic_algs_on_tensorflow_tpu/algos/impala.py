@@ -45,9 +45,7 @@ from actor_critic_algs_on_tensorflow_tpu.algos import common
 from actor_critic_algs_on_tensorflow_tpu.distributed.queue import (
     TrajectoryQueue,
 )
-from actor_critic_algs_on_tensorflow_tpu.models import DiscreteActorCritic
 from actor_critic_algs_on_tensorflow_tpu.ops import (
-    Categorical,
     entropy_loss,
     sp_vtrace,
     value_loss,
@@ -85,6 +83,10 @@ class ImpalaConfig:
     c_bar: float = 1.0
     vf_coef: float = 0.5
     ent_coef: float = 0.01
+    # Standardize V-trace pg advantages over the global batch (pmean'd
+    # mesh-wide). Essential for reward scales like Pendulum's (~-16 per
+    # step) where raw advantages dwarf the entropy/value terms.
+    normalize_advantages: bool = False
     max_grad_norm: float = 40.0
     queue_size: int = 16
     publish_interval: int = 1       # learner steps between publications
@@ -245,11 +247,14 @@ def make_impala(cfg: ImpalaConfig):
         cfg.env, num_envs=cfg.envs_per_actor, frame_stack=cfg.frame_stack
     )
     action_space = env.action_space(env_params)
-    model = DiscreteActorCritic(
-        num_actions=action_space.n,
+    # Discrete (Categorical) or continuous (diagonal Gaussian) — the
+    # latter lets the async actor-learner topology serve MuJoCo-class
+    # tasks, overlapping host env stepping with learner updates.
+    model, dist_and_value = common.make_policy_head(
+        action_space,
         torso=cfg.torso,
         hidden_sizes=cfg.hidden_sizes,
-        dtype=jnp.dtype(cfg.compute_dtype),
+        compute_dtype=cfg.compute_dtype,
     )
 
     steps_per_batch = (
@@ -268,8 +273,7 @@ def make_impala(cfg: ImpalaConfig):
     # ---- actor program ------------------------------------------------
 
     def policy_fn(params, obs, key):
-        logits, value = model.apply(params, obs)
-        dist = Categorical(logits)
+        dist, value = dist_and_value(params, obs)
         action = dist.sample(key)
         return action, dist.log_prob(action), value
 
@@ -334,9 +338,8 @@ def make_impala(cfg: ImpalaConfig):
         ``cfg.time_shards > 1``, with V-trace sequence-parallel)."""
 
         def loss_fn(params):
-            logits, values = model.apply(params, batch.obs)
-            _, last_value = model.apply(params, batch.last_obs)
-            dist = Categorical(logits)
+            dist, values = dist_and_value(params, batch.obs)
+            _, last_value = dist_and_value(params, batch.last_obs)
             target_log_probs = dist.log_prob(batch.actions)
             if cfg.correction == "none":
                 # A3C: no importance weighting — with rho = c = 1 the
@@ -369,9 +372,12 @@ def make_impala(cfg: ImpalaConfig):
                     use_pallas=cfg.use_pallas_scan,
                     **vtrace_kw,
                 )
-            pg = -jnp.mean(
-                target_log_probs * jax.lax.stop_gradient(vt.pg_advantages)
-            )
+            adv = jax.lax.stop_gradient(vt.pg_advantages)
+            if cfg.normalize_advantages:
+                adv = common.global_normalize_advantages(
+                    adv, axis_name=mesh_axes
+                )
+            pg = -jnp.mean(target_log_probs * adv)
             vf = value_loss(values, jax.lax.stop_gradient(vt.vs))
             ent = dist.entropy().mean()
             total = pg + cfg.vf_coef * vf + cfg.ent_coef * entropy_loss(ent)

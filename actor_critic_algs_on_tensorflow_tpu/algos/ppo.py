@@ -33,13 +33,7 @@ from actor_critic_algs_on_tensorflow_tpu.data.rollout import (
     minibatch_iter_indices,
     take_minibatch,
 )
-from actor_critic_algs_on_tensorflow_tpu.models import (
-    DiscreteActorCritic,
-    GaussianActorCritic,
-)
 from actor_critic_algs_on_tensorflow_tpu.ops import (
-    Categorical,
-    DiagGaussian,
     clipped_value_loss,
     gae_advantages,
     ppo_clip_loss,
@@ -115,27 +109,12 @@ def make_ppo(cfg: PPOConfig) -> common.IterationFns:
         cfg.env, num_envs=cfg.num_envs, frame_stack=cfg.frame_stack
     )
     action_space = env.action_space(env_params)
-    discrete = hasattr(action_space, "n")
-    if discrete:
-        model = DiscreteActorCritic(
-            num_actions=action_space.n,
-            torso=cfg.torso,
-            hidden_sizes=cfg.hidden_sizes,
-            dtype=jnp.dtype(cfg.compute_dtype),
-        )
-    else:
-        model = GaussianActorCritic(
-            action_dim=action_space.shape[-1],
-            hidden_sizes=cfg.hidden_sizes,
-            dtype=jnp.dtype(cfg.compute_dtype),
-        )
-
-    def dist_and_value(params, obs):
-        if discrete:
-            logits, value = model.apply(params, obs)
-            return Categorical(logits), value
-        mean, log_std, value = model.apply(params, obs)
-        return DiagGaussian(mean, log_std), value
+    model, dist_and_value = common.make_policy_head(
+        action_space,
+        torso=cfg.torso,
+        hidden_sizes=cfg.hidden_sizes,
+        compute_dtype=cfg.compute_dtype,
+    )
 
     num_iters = max(1, cfg.total_env_steps // (cfg.num_envs * cfg.rollout_length))
     if cfg.lr_decay:

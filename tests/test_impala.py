@@ -204,3 +204,78 @@ def test_time_shards_validation():
         impala.make_impala(_cfg(num_devices=8, time_shards=4, rollout_length=6))
     with pytest.raises(ValueError, match="not divisible by time_shards"):
         impala.make_impala(_cfg(num_devices=6, time_shards=4))
+
+
+def test_impala_continuous_actions_learner_step():
+    """Continuous (diagonal-Gaussian) IMPALA: the same async topology
+    serves MuJoCo-class control tasks."""
+    cfg = impala.ImpalaConfig(
+        env="Pendulum-v1",
+        num_actors=2,
+        envs_per_actor=4,
+        rollout_length=8,
+        batch_trajectories=2,
+        total_env_steps=2 * 4 * 8 * 2,
+        num_devices=1,
+    )
+    init, learner_step, make_actor_programs, _ = impala.make_impala(cfg)
+    state = init(jax.random.PRNGKey(0))
+    rollout, env_reset = make_actor_programs(0)
+    env_state, obs = env_reset(jax.random.PRNGKey(1))
+    env_state, obs, traj, _ = rollout(
+        state.params, env_state, obs, jax.random.PRNGKey(2)
+    )
+    assert traj.actions.ndim == 3 and traj.actions.shape[-1] == 1
+    assert str(traj.actions.dtype) == "float32"
+    batch = impala.stack_trajectories([traj, traj])
+    before = np.asarray(jax.tree_util.tree_leaves(state.params)[0])
+    state, metrics = learner_step(state, batch)
+    assert np.isfinite(float(metrics["loss"])), metrics
+    after = np.asarray(jax.tree_util.tree_leaves(state.params)[0])
+    assert not np.allclose(before, after)
+
+
+def test_impala_continuous_end_to_end():
+    """run_impala with Gaussian policy on Pendulum: finite losses,
+    episodes complete, params move."""
+    cfg = impala.ImpalaConfig(
+        env="Pendulum-v1",
+        num_actors=2,
+        envs_per_actor=4,
+        rollout_length=16,
+        batch_trajectories=2,
+        total_env_steps=6_000,
+        num_devices=1,
+        queue_size=4,
+    )
+    state, history = impala.run_impala(cfg)
+    assert history, "no metrics logged"
+    last = history[-1][1]
+    assert np.isfinite(last["loss"]), last
+
+
+def test_impala_normalize_advantages():
+    """normalize_advantages standardizes the pg term: the loss stays
+    finite and the policy still updates under a 100x reward scale that
+    would otherwise dwarf entropy/value terms."""
+    base = dict(
+        env="CartPole-v1",
+        num_actors=1,
+        envs_per_actor=4,
+        rollout_length=8,
+        batch_trajectories=1,
+        total_env_steps=64,
+        num_devices=1,
+    )
+    cfg = impala.ImpalaConfig(**base, normalize_advantages=True)
+    init, learner_step, make_actor_programs, _ = impala.make_impala(cfg)
+    state = init(jax.random.PRNGKey(0))
+    rollout, env_reset = make_actor_programs(0)
+    env_state, obs = env_reset(jax.random.PRNGKey(1))
+    _, _, traj, _ = rollout(state.params, env_state, obs, jax.random.PRNGKey(2))
+    big = traj.replace(rewards=traj.rewards * 100.0)
+    before = np.asarray(jax.tree_util.tree_leaves(state.params)[0])
+    state, metrics = learner_step(state, impala.stack_trajectories([big]))
+    assert np.isfinite(float(metrics["loss"])), metrics
+    after = np.asarray(jax.tree_util.tree_leaves(state.params)[0])
+    assert not np.allclose(before, after)
