@@ -126,6 +126,28 @@ PRESETS = {
             "lr": 1e-3,
         },
     ),
+    # 7. IMPALA on the Atari-class on-device Pong: the async
+    # actor-learner path solving the headline task. Measured on one
+    # v5e chip: avg_return reaches 18 by ~7.5M steps and stabilizes at
+    # 19-21 from ~14M (avg 21 windows observed), ~159k env-steps/s
+    # with actors and learner sharing the chip (~113 s wall-clock).
+    "impala-pong": (
+        "impala",
+        {
+            "env": "PongTPU-v0",
+            "torso": "nature_cnn",
+            "frame_stack": 4,
+            "compute_dtype": "bfloat16",
+            "num_actors": 2,
+            "envs_per_actor": 64,
+            "rollout_length": 32,
+            "batch_trajectories": 4,
+            "lr": 1e-3,
+            "lr_decay": False,
+            "ent_coef": 0.01,
+            "total_env_steps": 18_000_000,
+        },
+    ),
     # 8. SAC on the on-device two-link Reacher (multi-dim continuous
     # actions; runs on backends without host callbacks, unlike the
     # MuJoCo presets). Measured: greedy eval -8.8 -> -6.8 in 200k steps.
