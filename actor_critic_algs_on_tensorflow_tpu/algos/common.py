@@ -338,6 +338,7 @@ def run_loop(
     history = []
     t0 = time.perf_counter()
     last_metrics = None
+    last_log_it, last_log_t = 0, t0
     for it in range(num_iters):
         state, metrics = fns.iteration(state)
         last_metrics = metrics
@@ -346,8 +347,22 @@ def run_loop(
         if (it + 1) % log_interval_iters == 0 or it == num_iters - 1:
             m = device_get_metrics(metrics)
             env_steps = steps_done0 + (it + 1) * fns.steps_per_iteration
-            dt = time.perf_counter() - t0
-            m["steps_per_sec"] = ((it + 1) * fns.steps_per_iteration) / dt
+            # Windowed rate (since the previous log) so steady-state
+            # throughput is not diluted by compile/warmup time. A
+            # short tail window (final iteration not on the interval)
+            # would be noise, so it falls back to the cumulative rate.
+            now = time.perf_counter()
+            window = it + 1 - last_log_it
+            if window >= log_interval_iters:
+                m["steps_per_sec"] = (
+                    window * fns.steps_per_iteration
+                    / max(now - last_log_t, 1e-9)
+                )
+            else:
+                m["steps_per_sec"] = (
+                    (it + 1) * fns.steps_per_iteration / max(now - t0, 1e-9)
+                )
+            last_log_it, last_log_t = it + 1, now
             history.append((env_steps, m))
             if summary_writer is not None:
                 summary_writer.add_scalars(m, env_steps)

@@ -494,6 +494,7 @@ def _learner_loop(
         return state, []
     history: List[Tuple[int, Dict[str, float]]] = []
     t0 = time.perf_counter()
+    last_log_i, last_log_t = 0, t0
     for i in range(num_learner_steps):
         it = iters_done0 + i
         trajs, eps = [], []
@@ -527,9 +528,18 @@ def _learner_loop(
             n_ep = float(jnp.sum(done))
             if n_ep > 0:
                 m["avg_return"] = float(jnp.sum(rets * done) / n_ep)
-            m["steps_per_sec"] = (
-                (i + 1) * steps_per_batch / (time.perf_counter() - t0)
-            )
+            now = time.perf_counter()
+            window = i + 1 - last_log_i
+            if window >= log_interval:
+                m["steps_per_sec"] = (
+                    window * steps_per_batch / max(now - last_log_t, 1e-9)
+                )
+            else:
+                # Short tail window: cumulative rate, not one-step noise.
+                m["steps_per_sec"] = (
+                    (i + 1) * steps_per_batch / max(now - t0, 1e-9)
+                )
+            last_log_i, last_log_t = i + 1, now
             m.update(q.metrics())
             m.update(extra_metrics())
             history.append((env_steps, m))
