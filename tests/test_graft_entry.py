@@ -26,3 +26,39 @@ def test_dryrun_multichip_odd():
     # No even split: the 2-D data x time phase is skipped but the DP
     # PPO step must still run.
     graft.dryrun_multichip(1)
+
+
+def test_dryrun_dispatches_to_subprocess_when_short_on_devices(monkeypatch):
+    # Driver scenario: ambient backend exposes fewer devices than
+    # requested -> the virtual-mesh subprocess leg must be taken.
+    calls = []
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: [object()])
+    monkeypatch.setattr(
+        graft, "_dryrun_in_virtual_subprocess", lambda n: calls.append(n)
+    )
+    graft.dryrun_multichip(8)
+    assert calls == [8]
+
+
+def test_dryrun_dispatches_to_subprocess_on_backend_boot_failure(monkeypatch):
+    # A failed TPU-plugin boot must not go red: the CPU subprocess can
+    # still prove the multi-chip path.
+    calls = []
+
+    def boom(*a, **k):
+        raise RuntimeError("Backend 'axon' is not in the list of known backends")
+
+    monkeypatch.setattr(jax, "devices", boom)
+    monkeypatch.setattr(
+        graft, "_dryrun_in_virtual_subprocess", lambda n: calls.append(n)
+    )
+    graft.dryrun_multichip(8)
+    assert calls == [8]
+
+
+@pytest.mark.slow
+def test_dryrun_subprocess_leg_end_to_end():
+    # Exercise the real subprocess + --virtual-dryrun __main__ protocol
+    # (the conftest mesh has 8 devices, so any n <= 8 would run
+    # in-process; call the subprocess leg directly with a small n).
+    graft._dryrun_in_virtual_subprocess(2)
