@@ -241,10 +241,8 @@ def make_ppo(cfg: PPOConfig) -> common.IterationFns:
             def minibatch_obs(idx):
                 return jnp.take(obs_flat, idx, axis=0)
 
-        def minibatch_step(carry, idx):
+        def minibatch_update(carry, mb):
             params, opt_state = carry
-            mb = take_minibatch(batch, idx)
-            mb["obs"] = minibatch_obs(idx)
             adv = mb["advantages"]
             if cfg.normalize_adv:
                 adv = common.global_normalize_advantages(adv)
@@ -284,7 +282,25 @@ def make_ppo(cfg: PPOConfig) -> common.IterationFns:
             }
             return (params, opt_state), m
 
+        def minibatch_step(carry, idx):
+            mb = take_minibatch(batch, idx)
+            mb["obs"] = minibatch_obs(idx)
+            return minibatch_update(carry, mb)
+
         def epoch_step(carry, k):
+            if cfg.num_minibatches == 1:
+                # Whole-batch epoch: the gradient is permutation-
+                # invariant, so skip the shuffle AND the full-buffer
+                # random gather (a pure HBM-bandwidth tax at this
+                # scale; the obs buffer alone is ~3.7 GB at 1024
+                # envs x 128 steps).
+                mb = dict(batch)
+                if cfg.compact_frames:
+                    mb["obs"] = minibatch_obs(jnp.arange(local_batch))
+                else:
+                    mb["obs"] = obs_flat
+                carry, m = minibatch_update(carry, mb)
+                return carry, jax.tree_util.tree_map(lambda x: x[None], m)
             idx = minibatch_iter_indices(k, local_batch, cfg.num_minibatches)
             return jax.lax.scan(minibatch_step, carry, idx)
 

@@ -72,11 +72,23 @@ PRESETS = {
     "a2c-cartpole": ("a2c", {"env": "CartPole-v1", "total_env_steps": 500_000}),
     # 2. PPO on Atari-class Pong: Nature-CNN over stacked 84x84 frames
     # (BASELINE.json:8). TPU-tuned large-batch config: 1024 on-device
-    # envs, bf16 torso — measured on one v5e chip to reach avg_return
-    # >= 19/21 in 45-50 s (~12M env steps) at ~258k steps/s. The
+    # envs, bf16 torso, whole-batch epochs (num_minibatches=1 skips
+    # the 3.7 GB shuffle-gather per epoch — +43% steps/s over the
+    # 4-minibatch schedule) with lr raised to 8e-3 to compensate for
+    # the 4x fewer optimizer updates. Measured on one v5e chip:
+    # ~370k env-steps/s, avg_return >= 19 by 20-24M steps and ~20
+    # at the full 25M budget in ~67 s wall-clock (seeds 0/1/2). The
     # classic 8-env schedule needs ~100x more gradient updates per env
     # step and learns far slower at this batch size.
-    "ppo-pong": ("ppo", {"env": "PongTPU-v0", **_PPO_ATARI_SCHEDULE}),
+    "ppo-pong": (
+        "ppo",
+        {
+            "env": "PongTPU-v0",
+            **_PPO_ATARI_SCHEDULE,
+            "num_minibatches": 1,
+            "lr": 8e-3,
+        },
+    ),
     # 3. DDPG on MuJoCo HalfCheetah: OU-noise explore (BASELINE.json:9)
     "ddpg-halfcheetah": (
         "ddpg",

@@ -54,6 +54,56 @@ class MLPTorso(nn.Module):
         return x
 
 
+class _FoldedConv(nn.Module):
+    """VALID strided conv computed via space-to-depth folding.
+
+    A stride-``s`` conv on TPU tiles poorly when ``s > 1`` (the 84x84
+    stride-4/stride-2 Nature-CNN layers reach ~18% MXU utilization;
+    the conv backward is the dominant cost of the PPO update). Folding
+    ``s x s`` spatial blocks into channels turns it into an exactly
+    equivalent stride-1 conv with ``s*s*C`` input channels — larger
+    contractions, regular windows, MXU-friendly forward AND backward.
+
+    The kernel parameter keeps the canonical ``[kh, kw, C, F]`` shape
+    (identical init, param tree, and checkpoints as ``nn.Conv``; pass
+    ``name='Conv_i'`` to keep the flax scope identical); the fold is a
+    pure reshape/transpose inside the call, so gradients flow through
+    it and the module computes the same function bit-for-algebra as the
+    strided ``nn.Conv`` it replaces.
+    """
+
+    features: int
+    kernel: int
+    stride: int
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        B, H, W, C = x.shape
+        s, k, F = self.stride, self.kernel, self.features
+        assert H % s == 0 and W % s == 0 and k % s == 0, (x.shape, k, s)
+        kernel = self.param(
+            "kernel", _orthogonal(), (k, k, C, F), jnp.float32
+        )
+        bias = self.param("bias", nn.initializers.zeros, (F,), jnp.float32)
+
+        # x[b, P*s+ih, Q*s+iw, c] -> x2[b, P, Q, (ih, iw, c)]
+        x2 = x.reshape(B, H // s, s, W // s, s, C)
+        x2 = x2.transpose(0, 1, 3, 2, 4, 5).reshape(B, H // s, W // s, s * s * C)
+        # K[bh*s+ih, bw*s+iw, c, f] -> K2[bh, bw, (ih, iw, c), f]
+        k2 = kernel.reshape(k // s, s, k // s, s, C, F)
+        k2 = k2.transpose(0, 2, 1, 3, 4, 5).reshape(k // s, k // s, s * s * C, F)
+
+        y = jax.lax.conv_general_dilated(
+            x2.astype(self.dtype),
+            k2.astype(self.dtype),
+            window_strides=(1, 1),
+            padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        return y + bias.astype(self.dtype)
+
+
 class NatureCNN(nn.Module):
     """Nature-DQN convolutional encoder for 84x84 stacked frames.
 
@@ -61,10 +111,17 @@ class NatureCNN(nn.Module):
     ReLU throughout (Mnih et al. 2015). Input ``[..., 84, 84, C]`` in
     [0, 1] or uint8 (uint8 is scaled on-device so the host->HBM transfer
     stays 1 byte/pixel).
+
+    ``space_to_depth=True`` computes the strided layers via
+    ``_FoldedConv`` (exact same function and param tree, MXU-friendly
+    tiling); it requires the spatial dims at each strided layer to be
+    divisible by the stride (true for 84x84) and falls back to
+    ``nn.Conv`` per-layer otherwise.
     """
 
     hidden_size: int = 512
     dtype: Dtype = jnp.float32
+    space_to_depth: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -74,15 +131,31 @@ class NatureCNN(nn.Module):
             x = x.astype(self.dtype)
         batch_shape = x.shape[:-3]
         x = x.reshape((-1,) + x.shape[-3:])
-        for features, kernel, stride in ((32, 8, 4), (64, 4, 2), (64, 3, 1)):
-            x = nn.Conv(
-                features,
-                (kernel, kernel),
-                strides=(stride, stride),
-                padding="VALID",
-                kernel_init=_orthogonal(),
-                dtype=self.dtype,
-            )(x)
+        for i, (features, kernel, stride) in enumerate(
+            ((32, 8, 4), (64, 4, 2), (64, 3, 1))
+        ):
+            foldable = (
+                self.space_to_depth
+                and stride > 1
+                and kernel % stride == 0
+                and x.shape[-3] % stride == 0
+                and x.shape[-2] % stride == 0
+            )
+            if foldable:
+                x = _FoldedConv(
+                    features, kernel, stride, dtype=self.dtype,
+                    name=f"Conv_{i}",
+                )(x)
+            else:
+                x = nn.Conv(
+                    features,
+                    (kernel, kernel),
+                    strides=(stride, stride),
+                    padding="VALID",
+                    kernel_init=_orthogonal(),
+                    dtype=self.dtype,
+                    name=f"Conv_{i}",
+                )(x)
             x = nn.relu(x)
         x = x.reshape(x.shape[0], -1)
         x = nn.Dense(self.hidden_size, kernel_init=_orthogonal(), dtype=self.dtype)(x)
@@ -227,6 +300,12 @@ class DiscreteActorCritic(nn.Module):
     def __call__(self, obs):
         if self.torso == "nature_cnn":
             z = NatureCNN(dtype=self.dtype)(obs)
+        elif self.torso == "nature_cnn_s2d":
+            # Space-to-depth folded convs: same function and param tree
+            # as nature_cnn (checkpoints interchangeable); measured
+            # slower end-to-end on v5e (PERF.md ledger) but kept
+            # selectable for other backends/shapes.
+            z = NatureCNN(dtype=self.dtype, space_to_depth=True)(obs)
         elif self.torso == "frame_transformer":
             z = FrameTransformerEncoder(dtype=self.dtype)(obs)
         else:

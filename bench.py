@@ -50,11 +50,13 @@ def measure(num_envs: int, rollout: int, timed_iters: int) -> float:
         total_env_steps=10**9,
         frame_stack=4,
         torso="nature_cnn",
-        # The SHIPPED ppo-pong schedule (cli/train.py
-        # _PPO_ATARI_SCHEDULE): 2 update epochs, validated to reach
-        # Pong avg_return >= 19 in 45-50 s on this config.
+        # The SHIPPED ppo-pong schedule (cli/train.py PRESETS): 2
+        # whole-batch update epochs (num_minibatches=1 skips the
+        # shuffle gather; lr raised to 8e-3 to match), validated on 3
+        # seeds to reach Pong avg_return >= 19 within the 25M-step
+        # budget (~20 at the full budget in ~67 s on one v5e chip).
         num_epochs=int(os.environ.get("BENCH_EPOCHS", 2)),
-        num_minibatches=4,
+        num_minibatches=int(os.environ.get("BENCH_MINIBATCHES", 1)),
         time_limit_bootstrap=False,
         compute_dtype="bfloat16",
         num_devices=n_dev,

@@ -1,0 +1,21 @@
+"""Capture a device trace of the headline PPO iteration."""
+import time, sys
+import jax
+from actor_critic_algs_on_tensorflow_tpu.algos.ppo import PPOConfig, make_ppo
+from actor_critic_algs_on_tensorflow_tpu.utils.profiling import sync
+
+cfg = PPOConfig(
+    env="PongTPU-v0", num_envs=1024, rollout_length=128,
+    total_env_steps=10**9, frame_stack=4, torso="nature_cnn",
+    num_epochs=2, num_minibatches=4, time_limit_bootstrap=False,
+    compute_dtype="bfloat16", num_devices=1,
+)
+fns = make_ppo(cfg)
+state = fns.init(jax.random.PRNGKey(0))
+state, m = fns.iteration(state); sync(m)
+state, m = fns.iteration(state); sync(m)
+with jax.profiler.trace(sys.argv[1] if len(sys.argv) > 1 else "/tmp/ppo_trace"):
+    for _ in range(3):
+        state, m = fns.iteration(state)
+    sync(m)
+print("trace done")

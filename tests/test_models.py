@@ -72,3 +72,28 @@ def test_sac_actor_bounds():
     assert np.all(np.asarray(log_std) >= -20.0) and np.all(
         np.asarray(log_std) <= 2.0
     )
+
+
+def test_nature_cnn_space_to_depth_equivalent():
+    # _FoldedConv keeps the canonical kernel shapes: identical param
+    # tree and init, same function to float tolerance (fwd and grads).
+    import jax.tree_util as jtu
+    from actor_critic_algs_on_tensorflow_tpu.models.networks import NatureCNN
+
+    ref = NatureCNN(space_to_depth=False)
+    s2d = NatureCNN(space_to_depth=True)
+    x = jax.random.uniform(jax.random.PRNGKey(1), (4, 84, 84, 4))
+    p_ref = ref.init(jax.random.PRNGKey(0), x)
+    p_s2d = s2d.init(jax.random.PRNGKey(0), x)
+    assert jtu.tree_structure(p_ref) == jtu.tree_structure(p_s2d)
+    for a, b in zip(jtu.tree_leaves(p_ref), jtu.tree_leaves(p_s2d)):
+        np.testing.assert_allclose(a, b)
+
+    y_ref = ref.apply(p_ref, x)
+    y_s2d = s2d.apply(p_ref, x)
+    np.testing.assert_allclose(y_ref, y_s2d, atol=1e-4)
+
+    g_ref = jax.grad(lambda p: ref.apply(p, x).sum())(p_ref)
+    g_s2d = jax.grad(lambda p: s2d.apply(p, x).sum())(p_ref)
+    for a, b in zip(jtu.tree_leaves(g_ref), jtu.tree_leaves(g_s2d)):
+        np.testing.assert_allclose(a, b, atol=5e-3, rtol=1e-4)

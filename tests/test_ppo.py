@@ -135,3 +135,24 @@ def test_ppo_bfloat16_compute():
     assert all(x.dtype == jnp.float32 for x in leaves)
     state, metrics = fns.iteration(state)
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_ppo_whole_batch_epoch_on_policy_alignment():
+    # num_minibatches=1 takes the gather-free whole-batch path. With a
+    # single epoch the one update is exactly on-policy: recomputed
+    # log-probs must equal the rollout's stored log-probs, so ratio==1,
+    # clip_fraction==0, approx_kl~~0. Any misalignment between obs_flat
+    # and the flattened batch fields (the invariant the gather used to
+    # enforce by construction) breaks this immediately.
+    cfg = ppo.PPOConfig(
+        num_envs=8, rollout_length=16, num_epochs=1, num_minibatches=1
+    )
+    fns = ppo.make_ppo(cfg)
+    state = fns.init(jax.random.PRNGKey(0))
+    before = _params_l2(state.params)  # read BEFORE donation
+    state1, m1 = fns.iteration(state)
+    vals = {k: float(v) for k, v in m1.items()}
+    assert np.isfinite(list(vals.values())).all(), vals
+    assert vals["clip_fraction"] == 0.0, vals
+    assert abs(vals["approx_kl"]) < 1e-5, vals
+    assert _params_l2(state1.params) != before
