@@ -77,6 +77,7 @@ def make_a2c(cfg: A2CConfig) -> common.IterationFns:
     local_envs = cfg.num_envs // n_dev
     # One env instance at per-device width (used inside shard_map), one
     # at global width (used for init/reset on the host).
+    common.check_host_env_topology(cfg.env, n_dev)
     env, env_params = envs_lib.make(
         cfg.env, num_envs=local_envs, frame_stack=cfg.frame_stack
     )

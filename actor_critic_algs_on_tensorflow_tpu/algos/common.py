@@ -94,6 +94,30 @@ def build_shard_map_iteration(
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
 
+def check_host_env_topology(env_name: str, n_dev: int) -> None:
+    """Host-resident envs (``gym:``/``native:``) live in THIS process;
+    a multi-device ``shard_map`` would have every device's program call
+    back into one shared simulator pool with interleaved ordering.
+    Fail fast with the supported alternatives instead of deadlocking
+    or silently corrupting episode streams.
+
+    The supported MuJoCo/Gym-at-scale topology is IMPALA with actor
+    processes (``run_impala_distributed`` / ``--actor-processes``):
+    each actor process owns a private host env pool and streams
+    trajectories to the learner over the TCP transport, which is also
+    how the reference scales beyond one host (BASELINE.json:11).
+    """
+    if n_dev > 1 and env_name.startswith(("gym:", "native:")):
+        raise ValueError(
+            f"host-resident env {env_name!r} cannot shard across "
+            f"{n_dev} devices from one process: the simulator pool is "
+            "host-side state shared by all devices. Use num_devices=1 "
+            "(vectorize via num_envs), or scale host envs with IMPALA "
+            "actor processes (--actor-processes), each owning its own "
+            "env pool (see README: 'Host envs at scale')."
+        )
+
+
 def make_policy_head(action_space, *, torso, hidden_sizes, compute_dtype):
     """(model, dist_and_value) for a discrete (Categorical) or
     continuous (diagonal-Gaussian) action space — the policy-head
@@ -112,6 +136,16 @@ def make_policy_head(action_space, *, torso, hidden_sizes, compute_dtype):
             dtype=jnp.dtype(compute_dtype),
         )
     else:
+        if torso not in (None, "mlp"):
+            # The continuous head is MLP-only (the reference's
+            # MuJoCo-scale policies); silently ignoring a configured
+            # CNN/transformer torso would train a different model
+            # than the user asked for.
+            raise ValueError(
+                f"torso={torso!r} is not supported for continuous "
+                "action spaces; GaussianActorCritic is MLP-only "
+                "(use torso='mlp' or a discrete-action env)"
+            )
         model = GaussianActorCritic(
             action_dim=action_space.shape[-1],
             hidden_sizes=hidden_sizes,
@@ -344,23 +378,34 @@ def run_loop(
         last_metrics = metrics
         if serialize:
             jax.block_until_ready(metrics)
+        if it == 0:
+            # Iteration 0 pays compilation (a host-side cost incurred
+            # at dispatch); restart the rate clock after it so no
+            # window — including the first — is diluted by compile.
+            t1 = time.perf_counter()
+            last_log_it, last_log_t = 1, t1
         if (it + 1) % log_interval_iters == 0 or it == num_iters - 1:
             m = device_get_metrics(metrics)
             env_steps = steps_done0 + (it + 1) * fns.steps_per_iteration
-            # Windowed rate (since the previous log) so steady-state
-            # throughput is not diluted by compile/warmup time. A
-            # short tail window (final iteration not on the interval)
-            # would be noise, so it falls back to the cumulative rate.
+            # Windowed rate (since the previous log). A short tail
+            # window (final iteration not on the interval) would be
+            # noise, so it falls back to the cumulative post-compile
+            # rate; logging iteration 0 itself has no compile-free
+            # window yet and reports the raw first-iteration rate.
             now = time.perf_counter()
             window = it + 1 - last_log_it
-            if window >= log_interval_iters:
+            if window >= max(log_interval_iters - 1, 1):
                 m["steps_per_sec"] = (
                     window * fns.steps_per_iteration
                     / max(now - last_log_t, 1e-9)
                 )
+            elif it >= 1:
+                m["steps_per_sec"] = (
+                    it * fns.steps_per_iteration / max(now - t1, 1e-9)
+                )
             else:
                 m["steps_per_sec"] = (
-                    (it + 1) * fns.steps_per_iteration / max(now - t0, 1e-9)
+                    fns.steps_per_iteration / max(now - t0, 1e-9)
                 )
             last_log_it, last_log_t = it + 1, now
             history.append((env_steps, m))

@@ -189,8 +189,6 @@ def make_ddpg(cfg: DDPGConfig) -> offpolicy.OffPolicyFns:
             one_update,
             (state.params, state.opt_state),
             jax.random.split(k_upd, cfg.updates_per_iter),
-            ("q_loss", "actor_loss", "q_mean"),
-            cfg.updates_per_iter,
             ready,
         )
 

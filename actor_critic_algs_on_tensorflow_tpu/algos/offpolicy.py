@@ -144,6 +144,11 @@ def setup_trainer(cfg) -> TrainerSetup:
         raise ValueError(
             f"num_envs={cfg.num_envs} not divisible by {n_dev} devices"
         )
+    from actor_critic_algs_on_tensorflow_tpu.algos.common import (
+        check_host_env_topology,
+    )
+
+    check_host_env_topology(cfg.env, n_dev)
     env, env_params = envs_lib.make(cfg.env, num_envs=cfg.num_envs // n_dev)
     genv, _ = envs_lib.make(cfg.env, num_envs=cfg.num_envs)
     aspace = env.action_space(env_params)
@@ -211,19 +216,22 @@ def gated_updates(
     one_update: Callable,
     carry,
     xs,
-    metric_keys,
-    updates_per_iter: int,
     ready: jax.Array,
 ):
     """Scan ``one_update`` over ``xs`` iff ``ready`` (past warmup and a
     full batch in replay); otherwise pass the carry through with zeroed
-    per-update metrics (shapes must match the scan's stacked outputs)."""
+    per-update metrics. The zero pytree is derived from the scanned
+    branch via ``eval_shape`` so both ``lax.cond`` branches agree on
+    shape AND dtype whatever metrics a trainer emits."""
 
     def run(c):
         return jax.lax.scan(one_update, c, xs)
 
     def skip(c):
-        return c, {k: jnp.zeros((updates_per_iter,)) for k in metric_keys}
+        metrics_shape = jax.eval_shape(run, c)[1]
+        return c, jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), metrics_shape
+        )
 
     return jax.lax.cond(ready, run, skip, carry)
 

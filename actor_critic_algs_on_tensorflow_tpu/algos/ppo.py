@@ -102,6 +102,7 @@ def make_ppo(cfg: PPOConfig) -> common.IterationFns:
             f"local batch {local_batch} not divisible by "
             f"{cfg.num_minibatches} minibatches"
         )
+    common.check_host_env_topology(cfg.env, n_dev)
     env, env_params = envs_lib.make(
         cfg.env, num_envs=local_envs, frame_stack=cfg.frame_stack
     )

@@ -213,9 +213,6 @@ def make_sac(cfg: SACConfig) -> offpolicy.OffPolicyFns:
             one_update,
             (state.params, state.opt_state),
             jax.random.split(k_upd, cfg.updates_per_iter),
-            ("q_loss", "actor_loss", "alpha_loss", "alpha", "entropy",
-             "q_mean"),
-            cfg.updates_per_iter,
             ready,
         )
 

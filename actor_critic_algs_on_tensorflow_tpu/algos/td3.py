@@ -110,6 +110,11 @@ def make_td3(cfg: TD3Config) -> offpolicy.OffPolicyFns:
             opt_state={
                 "actor": actor_tx.init(actor_params),
                 "critic": critic_tx.init(critic_params),
+                # Count of updates actually EXECUTED (the policy-delay
+                # phase): iteration-derived counters drift whenever an
+                # iteration is skipped because the replay buffer has
+                # not filled yet (ready also gates on replay.size).
+                "updates_done": jnp.zeros((), jnp.int32),
             },
             env_state=env_state,
             obs=obs,
@@ -130,9 +135,9 @@ def make_td3(cfg: TD3Config) -> offpolicy.OffPolicyFns:
             k_roll, cfg.steps_per_iter, state.step,
         )
 
-        def one_update(carry, xs):
+        def one_update(carry, key):
             params, opt_state = carry
-            upd_idx, key = xs
+            upd_idx = opt_state["updates_done"]
             k_batch, k_smooth = jax.random.split(key)
             batch = s.buf.sample(replay, k_batch, cfg.batch_size)
 
@@ -223,21 +228,20 @@ def make_td3(cfg: TD3Config) -> offpolicy.OffPolicyFns:
                 "actor_updates": did,
                 "q_mean": jnp.mean(q1),
             }
-            return (new_params, {"actor": a_opt, "critic": c_opt}), m
+            new_opt = {
+                "actor": a_opt,
+                "critic": c_opt,
+                "updates_done": upd_idx + 1,
+            }
+            return (new_params, new_opt), m
 
-        # Continue the global update counter across iterations so the
-        # delay phase does not reset every iteration.
-        base = (state.step - s.warmup_iters) * cfg.updates_per_iter
-        idxs = base + jnp.arange(cfg.updates_per_iter)
         ready = jnp.logical_and(
             state.step >= s.warmup_iters, replay.size >= cfg.batch_size
         )
         (params, opt_state), m = offpolicy.gated_updates(
             one_update,
             (state.params, state.opt_state),
-            (idxs, jax.random.split(k_upd, cfg.updates_per_iter)),
-            ("q_loss", "actor_loss", "actor_updates", "q_mean"),
-            cfg.updates_per_iter,
+            jax.random.split(k_upd, cfg.updates_per_iter),
             ready,
         )
         # actor_loss is only produced on delay steps; report the mean

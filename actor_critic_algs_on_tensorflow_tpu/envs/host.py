@@ -45,6 +45,65 @@ class HostEnvState:
     t: jax.Array  # int32 step counter
 
 
+def _atari_ctor(env_id: str):
+    """Constructor for real-ALE Atari ids (``ALE/Pong-v5``,
+    ``PongNoFrameskip-v4``), or None for non-Atari ids.
+
+    Parity target: the reference's PPO Atari workload runs real
+    ``PongNoFrameskip-v4`` (BASELINE.json:8). This image has no
+    ``ale_py`` wheel and no network, so the shipped Atari presets use
+    the on-device clones — but the host bridge serves real ALE
+    wherever ``ale_py`` exists: standard DeepMind preprocessing
+    (frame-skip 4 with max-pooling, grayscale, 84x84, SCALED to
+    [0, 1] — the bridge's obs contract is float32, and NatureCNN
+    only rescales uint8 inputs) + 4-frame stacking, emitted
+    channels-last so the Nature-CNN torso consumes the same [0, 1]
+    84x84x4 layout as the on-device envs (at 4 bytes/pixel over the
+    host->HBM hop, the float32 bridge contract).
+    """
+    if not (env_id.startswith("ALE/") or "NoFrameskip" in env_id):
+        return None
+
+    def ctor():
+        import gymnasium as gym
+        import numpy as np
+
+        try:
+            import ale_py
+
+            gym.register_envs(ale_py)
+        except ImportError as exc:
+            raise RuntimeError(
+                f"env {env_id!r} needs the Arcade Learning Environment "
+                "(pip install ale-py), which is not available in this "
+                "image. The on-device Atari-class envs (PongTPU-v0, "
+                "BreakoutTPU-v0) cover the same workloads without a "
+                "host dependency."
+            ) from exc
+
+        env = gym.make(env_id, frameskip=1)
+        env = gym.wrappers.AtariPreprocessing(
+            env, frame_skip=4, grayscale_obs=True, screen_size=84,
+            scale_obs=True,
+        )
+        env = gym.wrappers.FrameStackObservation(env, 4)
+
+        class _ChannelsLast(gym.ObservationWrapper):
+            def __init__(self, inner):
+                super().__init__(inner)
+                shp = inner.observation_space.shape  # [4, 84, 84]
+                self.observation_space = gym.spaces.Box(
+                    0.0, 1.0, (shp[1], shp[2], shp[0]), np.float32
+                )
+
+            def observation(self, obs):
+                return np.moveaxis(np.asarray(obs, np.float32), 0, -1)
+
+        return _ChannelsLast(env)
+
+    return ctor
+
+
 class HostGymEnv(JaxEnv):
     """A gymnasium vector env exposed through the functional JaxEnv API.
 
@@ -76,8 +135,11 @@ class HostGymEnv(JaxEnv):
         kwargs = dict(autoreset_mode=gym.vector.AutoresetMode.SAME_STEP)
         if backend == "async":
             kwargs["daemon"] = True
+        make_one = _atari_ctor(env_id) or (
+            lambda: gym.make(env_id, **env_kwargs)
+        )
         self._env = ctor(
-            [lambda: gym.make(env_id, **env_kwargs) for _ in range(num_envs)],
+            [make_one for _ in range(num_envs)],
             **kwargs,
         )
         self._single_obs_space = self._env.single_observation_space

@@ -39,9 +39,18 @@ def initialize(
 
 
 def is_initialized() -> bool:
+    # jax >= 0.4.34 exposes this directly; fall back to inspecting the
+    # runtime state object for older versions. A live client means this
+    # process joined a cluster; a live service means it already HOSTS
+    # the coordinator — either way another
+    # ``jax.distributed.initialize`` would raise "should only be called
+    # once", so both count as initialized.
+    if hasattr(jax.distributed, "is_initialized"):
+        return bool(jax.distributed.is_initialized())
     try:
-        state = jax.distributed.global_state
-        return state.client is not None
+        from jax._src.distributed import global_state
+
+        return global_state.client is not None or global_state.service is not None
     except Exception:
         return False
 

@@ -7,7 +7,7 @@ from actor_critic_algs_on_tensorflow_tpu.utils.profiling import sync
 cfg = PPOConfig(
     env="PongTPU-v0", num_envs=1024, rollout_length=128,
     total_env_steps=10**9, frame_stack=4, torso="nature_cnn",
-    num_epochs=2, num_minibatches=4, time_limit_bootstrap=False,
+    num_epochs=2, num_minibatches=1, time_limit_bootstrap=False,
     compute_dtype="bfloat16", num_devices=1,
 )
 fns = make_ppo(cfg)

@@ -19,5 +19,13 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 
+# The suite is compile-bound (every mesh test pays XLA compilation on
+# 8 virtual devices); a persistent compilation cache makes warm runs
+# fast. Keyed by JAX/XLA version, so upgrades invalidate cleanly.
+_cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
 assert len(jax.devices()) == 8, jax.devices()
 

@@ -1,6 +1,7 @@
 """Checkpoint/resume: loss-curve-continuous restart (SURVEY.md §5)."""
 
 import jax
+import pytest
 import numpy as np
 
 from actor_critic_algs_on_tensorflow_tpu.algos import a2c, common
@@ -52,6 +53,7 @@ def test_latest_step_and_missing(tmp_path):
         ckpt.close()
 
 
+@pytest.mark.slow
 def test_off_policy_checkpoint_includes_replay(tmp_path):
     """DDPG resume restores the replay ring contents and cursor."""
     import numpy as np

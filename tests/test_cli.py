@@ -47,6 +47,7 @@ def test_cli_end_to_end_a2c(capsys):
     assert "steps_per_sec" in out and "done" in out
 
 
+@pytest.mark.slow
 def test_cli_checkpoint_resume_roundtrip(tmp_path, capsys):
     common = [
         "--algo", "a2c", "--env", "CartPole-v1",
@@ -99,6 +100,7 @@ def test_cli_eval_requires_checkpoint_dir():
         cli.main(["--algo", "a2c", "--eval"])
 
 
+@pytest.mark.slow
 def test_cli_impala_checkpoint_resume_eval(tmp_path, capsys):
     common = [
         "--preset", "impala-cartpole",
@@ -127,6 +129,7 @@ def test_cli_impala_checkpoint_resume_eval(tmp_path, capsys):
     assert "[eval] avg_return=" in out
 
 
+@pytest.mark.slow
 def test_evaluate_checkpoint_sac(tmp_path):
     """Off-policy eval path: params.actor routing + tanh squash."""
     from actor_critic_algs_on_tensorflow_tpu.algos.evaluation import (
@@ -157,6 +160,7 @@ def test_evaluate_checkpoint_sac(tmp_path):
     assert per_env.shape == (4,)
 
 
+@pytest.mark.slow
 def test_cli_td3_train_then_eval(tmp_path, capsys):
     """TD3 through the full CLI surface: train, checkpoint, eval."""
     common = [

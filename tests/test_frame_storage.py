@@ -3,6 +3,7 @@ FrameStack wrapper would have produced, and compact-mode PPO must be
 numerically identical to full-storage PPO."""
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -83,6 +84,7 @@ def test_reconstruction_no_resets_is_pure_shift():
     )
 
 
+@pytest.mark.slow
 def test_ppo_compact_frames_exactly_matches_full_storage():
     """One full PPO iteration on PongTPU: compact storage must produce
     bit-identical params/metrics (same seed, same permutations)."""

@@ -159,6 +159,7 @@ def test_impala_learns_cartpole():
     assert mean_ret >= 150.0, mean_ret
 
 
+@pytest.mark.slow
 def test_time_sharded_learner_matches_1d():
     """time_shards=4 learner (2-D data x time mesh, sequence-parallel
     V-trace) must produce the same update as the 1-D learner."""
@@ -235,6 +236,7 @@ def test_impala_continuous_actions_learner_step():
     assert not np.allclose(before, after)
 
 
+@pytest.mark.slow
 def test_impala_continuous_end_to_end():
     """run_impala with Gaussian policy on Pendulum: finite losses,
     episodes complete, params move."""

@@ -3,6 +3,7 @@ equivalence, and the PPO normalize_obs path end to end."""
 
 import jax
 import jax.numpy as jnp
+import pytest
 import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
@@ -52,6 +53,7 @@ def test_rms_sharded_update_equals_global():
     np.testing.assert_allclose(float(got.count), float(ref.count))
 
 
+@pytest.mark.slow
 def test_ppo_normalize_obs_trains_and_tracks():
     from actor_critic_algs_on_tensorflow_tpu.algos.ppo import (
         PPOConfig,
@@ -81,6 +83,7 @@ def test_ppo_normalize_obs_trains_and_tracks():
     assert bool(jnp.all(jnp.abs(state.extra.mean) < 10.0))
 
 
+@pytest.mark.slow
 def test_eval_restores_normalizer(tmp_path):
     """evaluate_checkpoint must apply the trained running statistics."""
     from actor_critic_algs_on_tensorflow_tpu.algos.evaluation import (

@@ -2,6 +2,7 @@
 discretized Ornstein-Uhlenbeck process must match sigma^2/(2*theta)."""
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -12,6 +13,7 @@ from actor_critic_algs_on_tensorflow_tpu.ops import (
 )
 
 
+@pytest.mark.slow
 def test_ou_stationary_variance():
     theta, sigma, dt = 0.15, 0.2, 1e-2
     n = 4096

@@ -102,6 +102,7 @@ def test_ppo_solves_cartpole():
     assert mean_ret >= 195.0, mean_ret
 
 
+@pytest.mark.slow
 def test_ppo_continuous_pendulum_smoke():
     """Continuous-control PPO path (DiagGaussian policy)."""
     import numpy as np

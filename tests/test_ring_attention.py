@@ -3,6 +3,7 @@ token axis is sharded over the 8-device mesh, and the transformer
 torso built on it must run and differentiate."""
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 from jax import shard_map
@@ -81,6 +82,7 @@ def test_ring_two_device_matches_dense():
     )
 
 
+@pytest.mark.slow
 def test_transformer_torso_forward_and_grad():
     torso = TransformerTorso(d_model=32, num_heads=2, num_layers=2)
     tokens = jax.random.normal(jax.random.PRNGKey(3), (4, 6, 16))
@@ -98,6 +100,7 @@ def test_transformer_torso_forward_and_grad():
     assert all(bool(jnp.all(jnp.isfinite(g))) for g in leaves)
 
 
+@pytest.mark.slow
 def test_frame_transformer_policy():
     model = DiscreteActorCritic(num_actions=6, torso="frame_transformer")
     obs = jnp.zeros((3, 84, 84, 4), jnp.uint8)
@@ -108,6 +111,7 @@ def test_frame_transformer_policy():
     assert bool(jnp.all(jnp.isfinite(logits)))
 
 
+@pytest.mark.slow
 def test_torso_sharded_equals_unsharded():
     """The SAME torso params give identical outputs when the token axis
     is sharded over the mesh (positions offset per shard)."""

@@ -40,6 +40,7 @@ def test_sac_iteration_smoke():
     assert int(state.step) == 3
 
 
+@pytest.mark.slow
 def test_sac_alpha_adapts():
     fns = sac.make_sac(_cfg(warmup_env_steps=0, updates_per_iter=4))
     state = fns.init(jax.random.PRNGKey(0))
