@@ -326,6 +326,55 @@ def build_data_parallel_iteration(
     )
 
 
+
+class RateClock:
+    """Windowed env-steps/sec accounting shared by the training loops.
+
+    Excludes the compiling first iteration from every window (compile
+    is a host-side dispatch cost); short tail windows fall back to the
+    cumulative post-compile rate."""
+
+    def __init__(self, steps_per_iteration: int, log_interval_iters: int):
+        self.spi = steps_per_iteration
+        self.interval = log_interval_iters
+        now = time.perf_counter()
+        self.t0 = now
+        self.t1 = now
+        self.last_it, self.last_t = 0, now
+
+    def first_iteration_done(self) -> None:
+        self.t1 = time.perf_counter()
+        self.last_it, self.last_t = 1, self.t1
+
+    def rate(self, it: int) -> float:
+        """steps/sec at 0-based iteration ``it`` (just completed)."""
+        now = time.perf_counter()
+        window = it + 1 - self.last_it
+        if window >= max(self.interval - 1, 1):
+            r = window * self.spi / max(now - self.last_t, 1e-9)
+        elif it >= 1:
+            r = it * self.spi / max(now - self.t1, 1e-9)
+        else:
+            r = self.spi / max(now - self.t0, 1e-9)
+        self.last_it, self.last_t = it + 1, now
+        return r
+
+
+def emit_log(env_steps, m, history, summary_writer, log_fn) -> None:
+    """Append to history and fan out to the writer/printer."""
+    from actor_critic_algs_on_tensorflow_tpu.utils.metrics import (
+        format_metrics,
+    )
+
+    history.append((env_steps, m))
+    if summary_writer is not None:
+        summary_writer.add_scalars(m, env_steps)
+    if log_fn is not None:
+        log_fn(env_steps, m)
+    else:
+        print(format_metrics(env_steps, m), flush=True)
+
+
 def run_loop(
     fns: IterationFns,
     *,
@@ -370,51 +419,20 @@ def run_loop(
     if num_iters <= 0:
         return state, []
     history = []
-    t0 = time.perf_counter()
+    clock = RateClock(fns.steps_per_iteration, log_interval_iters)
     last_metrics = None
-    last_log_it, last_log_t = 0, t0
     for it in range(num_iters):
         state, metrics = fns.iteration(state)
         last_metrics = metrics
         if serialize:
             jax.block_until_ready(metrics)
         if it == 0:
-            # Iteration 0 pays compilation (a host-side cost incurred
-            # at dispatch); restart the rate clock after it so no
-            # window — including the first — is diluted by compile.
-            t1 = time.perf_counter()
-            last_log_it, last_log_t = 1, t1
+            clock.first_iteration_done()
         if (it + 1) % log_interval_iters == 0 or it == num_iters - 1:
             m = device_get_metrics(metrics)
             env_steps = steps_done0 + (it + 1) * fns.steps_per_iteration
-            # Windowed rate (since the previous log). A short tail
-            # window (final iteration not on the interval) would be
-            # noise, so it falls back to the cumulative post-compile
-            # rate; logging iteration 0 itself has no compile-free
-            # window yet and reports the raw first-iteration rate.
-            now = time.perf_counter()
-            window = it + 1 - last_log_it
-            if window >= max(log_interval_iters - 1, 1):
-                m["steps_per_sec"] = (
-                    window * fns.steps_per_iteration
-                    / max(now - last_log_t, 1e-9)
-                )
-            elif it >= 1:
-                m["steps_per_sec"] = (
-                    it * fns.steps_per_iteration / max(now - t1, 1e-9)
-                )
-            else:
-                m["steps_per_sec"] = (
-                    fns.steps_per_iteration / max(now - t0, 1e-9)
-                )
-            last_log_it, last_log_t = it + 1, now
-            history.append((env_steps, m))
-            if summary_writer is not None:
-                summary_writer.add_scalars(m, env_steps)
-            if log_fn is not None:
-                log_fn(env_steps, m)
-            else:
-                print(format_metrics(env_steps, m), flush=True)
+            m["steps_per_sec"] = clock.rate(it)
+            emit_log(env_steps, m, history, summary_writer, log_fn)
         if (
             checkpointer is not None
             and checkpoint_interval_iters

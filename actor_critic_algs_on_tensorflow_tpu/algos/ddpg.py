@@ -17,6 +17,7 @@ with ``lax.pmean`` gradient averaging into a single jitted
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Tuple
 
 import jax
@@ -78,10 +79,10 @@ def make_ddpg(cfg: DDPGConfig) -> offpolicy.OffPolicyFns:
     actor_tx = offpolicy.make_adam(cfg.actor_lr, cfg.max_grad_norm)
     critic_tx = offpolicy.make_adam(cfg.critic_lr, cfg.max_grad_norm)
 
-    def act_fn(params, obs, noise, key, step):
+    def act_with(actor_params, obs, noise, key, step):
         """Tanh actor + OU noise; uniform-random during warmup."""
         k_ou, k_rand = jax.random.split(key)
-        a = actor.apply(params.actor, obs)
+        a = actor.apply(actor_params, obs)
         noise, eps = ou_step(
             noise, k_ou, theta=cfg.ou_theta, sigma=cfg.ou_sigma, dt=cfg.ou_dt
         )
@@ -90,33 +91,91 @@ def make_ddpg(cfg: DDPGConfig) -> offpolicy.OffPolicyFns:
         a = jnp.where(step < s.warmup_iters, rand, a)
         return a * s.action_scale, noise
 
-    def init(key: jax.Array) -> offpolicy.OffPolicyState:
-        k_env, k_actor, k_critic, k_state = jax.random.split(key, 4)
-        env_state, obs = s.genv.reset(k_env, s.env_params)
-        actor_params = actor.init(k_actor, obs[:1])
+    def act_fn(params, obs, noise, key, step):
+        return act_with(params.actor, obs, noise, key, step)
+
+    def init_params(key: jax.Array, obs_example):
+        k_actor, k_critic = jax.random.split(key)
+        actor_params = actor.init(k_actor, obs_example)
         critic_params = critic.init(
-            k_critic, obs[:1], jnp.zeros((1, s.action_dim))
+            k_critic, obs_example, jnp.zeros((1, s.action_dim))
         )
         # Targets are COPIES: with donated state, aliasing online and
         # target leaves would donate the same buffer twice.
         copy = lambda t: jax.tree_util.tree_map(jnp.copy, t)
+        params = DDPGParams(
+            actor=actor_params,
+            critic=critic_params,
+            target_actor=copy(actor_params),
+            target_critic=copy(critic_params),
+        )
+        opt_state = {
+            "actor": actor_tx.init(actor_params),
+            "critic": critic_tx.init(critic_params),
+        }
+        return params, opt_state
+
+    def init(key: jax.Array) -> offpolicy.OffPolicyState:
+        k_env, k_params, k_state = jax.random.split(key, 3)
+        env_state, obs = s.genv.reset(k_env, s.env_params)
+        params, opt_state = init_params(k_params, obs[:1])
         return offpolicy.assemble_state(
             s,
-            params=DDPGParams(
-                actor=actor_params,
-                critic=critic_params,
-                target_actor=copy(actor_params),
-                target_critic=copy(critic_params),
-            ),
-            opt_state={
-                "actor": actor_tx.init(actor_params),
-                "critic": critic_tx.init(critic_params),
-            },
+            params=params,
+            opt_state=opt_state,
             env_state=env_state,
             obs=obs,
             noise=ou_init((cfg.num_envs, s.action_dim)),
             key=k_state,
         )
+
+    def one_update(replay, carry, key):
+        params, opt_state = carry
+        batch = s.buf.sample(replay, key, cfg.batch_size)
+
+        def critic_loss_fn(cp):
+            a_next = actor.apply(params.target_actor, batch.next_obs)
+            q_next = critic.apply(
+                params.target_critic,
+                batch.next_obs,
+                a_next * s.action_scale,
+            )
+            y = batch.reward + cfg.gamma * (1.0 - batch.terminated) * q_next
+            q = critic.apply(cp, batch.obs, batch.action)
+            return jnp.mean((q - jax.lax.stop_gradient(y)) ** 2), q
+
+        (q_loss, q), q_grads = jax.value_and_grad(
+            critic_loss_fn, has_aux=True
+        )(params.critic)
+
+        def actor_loss_fn(ap):
+            a = actor.apply(ap, batch.obs)
+            return -jnp.mean(
+                critic.apply(params.critic, batch.obs, a * s.action_scale)
+            )
+
+        a_loss, a_grads = jax.value_and_grad(actor_loss_fn)(params.actor)
+
+        q_grads = jax.lax.pmean(q_grads, DATA_AXIS)
+        a_grads = jax.lax.pmean(a_grads, DATA_AXIS)
+        q_up, c_opt = critic_tx.update(
+            q_grads, opt_state["critic"], params.critic
+        )
+        a_up, a_opt = actor_tx.update(
+            a_grads, opt_state["actor"], params.actor
+        )
+        new_params = DDPGParams(
+            actor=optax.apply_updates(params.actor, a_up),
+            critic=optax.apply_updates(params.critic, q_up),
+            target_actor=polyak_update(
+                params.target_actor, params.actor, cfg.tau
+            ),
+            target_critic=polyak_update(
+                params.target_critic, params.critic, cfg.tau
+            ),
+        )
+        m = {"q_loss": q_loss, "actor_loss": a_loss, "q_mean": jnp.mean(q)}
+        return (new_params, {"actor": a_opt, "critic": c_opt}), m
 
     def local_iteration(state: offpolicy.OffPolicyState):
         dev = jax.lax.axis_index(DATA_AXIS)
@@ -133,60 +192,12 @@ def make_ddpg(cfg: DDPGConfig) -> offpolicy.OffPolicyFns:
             noise_reset_fn=ou_reset_where,
         )
 
-        def one_update(carry, key):
-            params, opt_state = carry
-            batch = s.buf.sample(replay, key, cfg.batch_size)
-
-            def critic_loss_fn(cp):
-                a_next = actor.apply(params.target_actor, batch.next_obs)
-                q_next = critic.apply(
-                    params.target_critic,
-                    batch.next_obs,
-                    a_next * s.action_scale,
-                )
-                y = batch.reward + cfg.gamma * (1.0 - batch.terminated) * q_next
-                q = critic.apply(cp, batch.obs, batch.action)
-                return jnp.mean((q - jax.lax.stop_gradient(y)) ** 2), q
-
-            (q_loss, q), q_grads = jax.value_and_grad(
-                critic_loss_fn, has_aux=True
-            )(params.critic)
-
-            def actor_loss_fn(ap):
-                a = actor.apply(ap, batch.obs)
-                return -jnp.mean(
-                    critic.apply(params.critic, batch.obs, a * s.action_scale)
-                )
-
-            a_loss, a_grads = jax.value_and_grad(actor_loss_fn)(params.actor)
-
-            q_grads = jax.lax.pmean(q_grads, DATA_AXIS)
-            a_grads = jax.lax.pmean(a_grads, DATA_AXIS)
-            q_up, c_opt = critic_tx.update(
-                q_grads, opt_state["critic"], params.critic
-            )
-            a_up, a_opt = actor_tx.update(
-                a_grads, opt_state["actor"], params.actor
-            )
-            new_params = DDPGParams(
-                actor=optax.apply_updates(params.actor, a_up),
-                critic=optax.apply_updates(params.critic, q_up),
-                target_actor=polyak_update(
-                    params.target_actor, params.actor, cfg.tau
-                ),
-                target_critic=polyak_update(
-                    params.target_critic, params.critic, cfg.tau
-                ),
-            )
-            m = {"q_loss": q_loss, "actor_loss": a_loss, "q_mean": jnp.mean(q)}
-            return (new_params, {"actor": a_opt, "critic": c_opt}), m
-
         # No updates until past warmup AND the buffer can fill a batch.
         ready = jnp.logical_and(
             state.step >= s.warmup_iters, replay.size >= cfg.batch_size
         )
         (params, opt_state), m = offpolicy.gated_updates(
-            one_update,
+            functools.partial(one_update, replay),
             (state.params, state.opt_state),
             jax.random.split(k_upd, cfg.updates_per_iter),
             ready,
@@ -204,4 +215,15 @@ def make_ddpg(cfg: DDPGConfig) -> offpolicy.OffPolicyFns:
             ep_info=ep_info,
         )
 
-    return offpolicy.build_fns(s, init, local_iteration)
+    parts = offpolicy.TrainerParts(
+        cfg=cfg,
+        setup=s,
+        act_fn=act_fn,
+        one_update=one_update,
+        init_params=init_params,
+        noise_init=lambda n: ou_init((n, s.action_dim)),
+        noise_reset=ou_reset_where,
+        acting_slice=lambda params: params.actor,
+        act_with=act_with,
+    )
+    return offpolicy.build_fns(s, init, local_iteration, parts=parts)

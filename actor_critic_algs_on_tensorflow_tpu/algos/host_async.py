@@ -1,0 +1,337 @@
+"""Async host-env loop for off-policy trainers (DDPG/TD3/SAC).
+
+Capability parity: the reference steps real Gym/MuJoCo envs from its
+Python loop while the accelerator runs the updates (BASELINE.json:9-10,
+SURVEY.md §3.2). The fused ``shard_map`` iteration in
+``algos.offpolicy`` instead pulls env stepping INSIDE the jitted
+program via ``io_callback`` — the right design where the backend
+supports host callbacks, but it pins the whole program (MuJoCo physics
+AND gradient updates) to one platform, and some TPU runtimes (the
+single-chip axon plugin) support no host callbacks at all.
+
+This loop is the TPU-first decomposition of the same trainer:
+
+  host CPU:   env stepping + acting (a CPU-jitted copy of ``act_fn``
+              on a <=1-iteration-stale param snapshot — off-policy
+              algorithms are indifferent to that lag by construction)
+  accelerator: replay ingest + the update block (the trainer's OWN
+              ``one_update`` scanned ``updates_per_iter`` times, the
+              exact math the fused path runs)
+
+synchronized once per iteration: stage the host transitions, dispatch
+ingest+updates (async), step the next iteration's envs while the
+accelerator crunches, then refresh the acting snapshot. Update
+dispatch overlaps env physics — on a 1-core host with a tunneled TPU
+this roughly doubles MuJoCo training throughput over the all-on-CPU
+fused path, and it is the only TPU-accelerated path for host envs on
+callback-less backends.
+
+Uses ``TrainerParts`` (``algos.offpolicy``) — the trainer's composable
+acting/update/init pieces — so DDPG, TD3, and SAC all run through this
+loop unchanged. Checkpoints use the same ``OffPolicyState`` structure
+as the fused path (mutual resume works; the host simulator state
+itself is not checkpointable and re-seeds on resume, as in the fused
+host-env mode).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from actor_critic_algs_on_tensorflow_tpu.algos import offpolicy
+from actor_critic_algs_on_tensorflow_tpu.envs.host import HostEnvState
+from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import DATA_AXIS
+
+
+def host_async_supported(cfg) -> bool:
+    """This loop serves host-resident envs on a single-device config."""
+    return str(cfg.env).startswith(("gym:", "native:")) and (
+        cfg.num_devices in (0, 1)
+    )
+
+
+def _build_update(parts, accel) -> Any:
+    """jit(shard_map) of ``updates_per_iter`` x ``one_update`` over a
+    1-device mesh on the accelerator (``one_update`` contains
+    ``lax.pmean`` over the data axis, so it needs the mesh ctx)."""
+    cfg = parts.cfg
+
+    def body(params, opt_state, replay, keys):
+        (params, opt_state), m = jax.lax.scan(
+            functools.partial(parts.one_update, replay),
+            (params, opt_state),
+            keys,
+        )
+        # TD3-style delayed metrics: actor_loss is only produced on
+        # delay steps, so average it over the updates that RAN (same
+        # masking the fused path applies) instead of diluting with
+        # skip-step zeros.
+        did = m.pop("actor_updates", None)
+        out = jax.tree_util.tree_map(jnp.mean, m)
+        if did is not None:
+            out["actor_loss"] = jnp.sum(m["actor_loss"]) / jnp.maximum(
+                jnp.sum(did), 1.0
+            )
+            out["actor_updates"] = jnp.mean(did)
+        return params, opt_state, out
+
+    mesh = Mesh(np.asarray([accel]), (DATA_AXIS,))
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(), P(), P(), P()),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
+    )
+
+
+def _build_ingest(parts) -> Any:
+    from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
+        donation_supported,
+    )
+
+    def ingest(replay, staged):
+        """``staged``: a Transition pytree of [T, B, ...] leaves,
+        flattened to ONE ring scatter (insertion order within the batch
+        does not matter for uniform replay, and a single scatter beats
+        a scan of T scatters by the scan's length)."""
+        flat = jax.tree_util.tree_map(
+            lambda x: x.reshape((-1,) + x.shape[2:]), staged
+        )
+        return parts.setup.buf.add_batch(replay, flat)
+
+    donate = (0,) if donation_supported() else ()
+    return jax.jit(ingest, donate_argnums=donate)
+
+
+def run_host_async(
+    fns: offpolicy.OffPolicyFns,
+    *,
+    total_env_steps: int,
+    seed: int = 0,
+    log_interval_iters: int = 20,
+    log_fn=None,
+    summary_writer=None,
+    checkpointer=None,
+    checkpoint_interval_iters: int = 0,
+    initial_state: offpolicy.OffPolicyState | None = None,
+    snapshot_interval: int = 0,
+) -> Tuple[offpolicy.OffPolicyState, list]:
+    """Train with host-side env stepping and accelerator-side updates.
+
+    Mirrors ``common.run_loop``'s interface/logging; returns
+    ``(final OffPolicyState, history)``.
+    """
+    from actor_critic_algs_on_tensorflow_tpu.algos.common import (
+        RateClock,
+        emit_log,
+    )
+
+    parts = fns.parts
+    cfg, s = parts.cfg, parts.setup
+    if not host_async_supported(cfg):
+        raise ValueError(
+            f"host_async serves gym:/native: envs on one device; got "
+            f"env={cfg.env!r} num_devices={cfg.num_devices}"
+        )
+    env = s.genv  # global-width host env pool; stepped DIRECTLY below
+    accel = jax.devices()[0]
+    cpu = jax.devices("cpu")[0]
+
+    update = _build_update(parts, accel)
+    ingest = _build_ingest(parts)
+
+    key = jax.random.PRNGKey(seed)
+    k_params, k_loop = jax.random.split(key)
+    # EVERYTHING the host loop touches per step must live on the CPU
+    # device: with a tunneled accelerator as the default backend, a
+    # single stray fold_in/asarray dispatches over the tunnel per env
+    # step and throttles the whole loop.
+    k_loop = jax.device_put(k_loop, cpu)
+
+    steps_per_iteration = s.steps_per_iteration
+    num_iters = max(1, total_env_steps // steps_per_iteration)
+    iters_done0 = int(initial_state.step) if initial_state is not None else 0
+    num_iters -= iters_done0
+    if iters_done0 == 0:
+        num_iters = max(1, num_iters)
+    if num_iters <= 0:
+        return initial_state, []
+
+    # The host simulator is not checkpointable; (re)seed it either way.
+    obs = env._host_reset(seed + iters_done0)
+
+    if initial_state is None:
+        with jax.default_device(accel):
+            params, opt_state = jax.jit(parts.init_params)(
+                k_params, jnp.asarray(obs[:1])
+            )
+        example = offpolicy.Transition(
+            obs=jnp.asarray(obs[0]),
+            action=jnp.zeros((s.action_dim,)),
+            reward=jnp.zeros(()),
+            next_obs=jnp.asarray(obs[0]),
+            terminated=jnp.zeros(()),
+        )
+        replay = jax.device_put(s.buf.init(example), accel)
+        inserted = 0
+    else:
+        params = jax.device_put(initial_state.params, accel)
+        opt_state = jax.device_put(initial_state.opt_state, accel)
+        replay = jax.device_put(
+            jax.tree_util.tree_map(lambda x: x[0], initial_state.replay),
+            accel,
+        )
+        inserted = int(replay.size)
+
+    noise = jax.device_put(parts.noise_init(cfg.num_envs), cpu)
+    # The acting snapshot transfers ONLY the pieces acting reads
+    # (actor + warmup scalars), refreshed every ``snapshot_interval``
+    # iterations: on a tunneled accelerator the device->host hop is
+    # the scarce resource (measured ~1.3 MB/s through the relay), and
+    # off-policy acting tolerates a bounded-staleness policy by
+    # construction. interval=0 adapts: keep transfer wait under ~1/3
+    # of the env-stepping time, capped at 16 iterations.
+    acting_params = jax.device_put(parts.acting_slice(params), cpu)
+    act = jax.jit(parts.act_with)
+
+    history = []
+    clock = RateClock(steps_per_iteration, log_interval_iters)
+    staged = None
+    snap_interval_eff = max(0, snapshot_interval) or 1
+
+    def flush_staged():
+        # Ingest any not-yet-dispatched transitions so a packed state's
+        # replay ring agrees with its step counter.
+        nonlocal staged, replay, inserted
+        if staged is not None:
+            replay = ingest(replay, jax.device_put(staged, accel))
+            inserted += steps_per_iteration
+            staged = None
+    m_dev: Dict[str, jax.Array] = {}
+    ep_returns: list = []
+
+    for it_off in range(num_iters):
+        it = iters_done0 + it_off
+        it_key = jax.random.fold_in(k_loop, it)
+
+        # 1. Dispatch accelerator work for the PREVIOUS iteration's
+        #    transitions (runs while this iteration steps envs).
+        if staged is not None:
+            staged_dev = jax.device_put(staged, accel)
+            replay = ingest(replay, staged_dev)
+            inserted += steps_per_iteration
+        size = min(inserted, s.buf.capacity)
+        if it >= s.warmup_iters and size >= cfg.batch_size:
+            upd_keys = jax.device_put(
+                jax.random.split(
+                    jax.random.fold_in(it_key, 1), cfg.updates_per_iter
+                ),
+                accel,
+            )
+            params, opt_state, m_dev = update(
+                params, opt_state, replay, upd_keys
+            )
+
+        # 2. Step envs on the host with the bounded-stale snapshot.
+        env_t0 = time.perf_counter()
+        step_scalar = jax.device_put(np.int32(it), cpu)
+        k_steps = jax.random.fold_in(it_key, 2)  # cpu (it_key is cpu)
+        tr_obs, tr_act, tr_rew, tr_next, tr_term = [], [], [], [], []
+        for t in range(cfg.steps_per_iter):
+            k_t = jax.random.fold_in(k_steps, t)
+            obs_cpu = jax.device_put(obs, cpu)
+            a, noise = act(acting_params, obs_cpu, noise, k_t, step_scalar)
+            a_np = np.asarray(a)
+            (next_obs, reward, done, term, trunc, final_obs,
+             ep_ret, ep_len) = env._host_step(a_np)
+            tr_obs.append(obs)
+            tr_act.append(a_np)
+            tr_rew.append(reward)
+            tr_next.append(final_obs)
+            tr_term.append(term)
+            if parts.noise_reset is not None and done.any():
+                noise = parts.noise_reset(
+                    noise, jax.device_put(done, cpu)
+                )
+            for i in np.nonzero(done > 0.5)[0]:
+                ep_returns.append(float(ep_ret[i]))
+            obs = next_obs
+        staged = offpolicy.Transition(
+            obs=np.stack(tr_obs),
+            action=np.stack(tr_act),
+            reward=np.stack(tr_rew),
+            next_obs=np.stack(tr_next),
+            terminated=np.stack(tr_term),
+        )
+
+        # 3. Refresh the acting snapshot (the transfer is enqueued
+        #    behind the update, so its completion implies the update
+        #    finished — the loop's only accelerator sync point).
+        env_dt = time.perf_counter() - env_t0
+        if snap_interval_eff <= 1 or (it_off % snap_interval_eff) == 0:
+            xfer_t0 = time.perf_counter()
+            acting_params = jax.device_put(parts.acting_slice(params), cpu)
+            jax.block_until_ready(acting_params)
+            xfer_dt = time.perf_counter() - xfer_t0
+            if snapshot_interval == 0 and env_dt > 0:
+                snap_interval_eff = int(
+                    np.clip(np.ceil(xfer_dt / (env_dt / 3.0)), 1, 16)
+                )
+
+        if it_off == 0:
+            clock.first_iteration_done()
+
+        if (it_off + 1) % log_interval_iters == 0 or it_off == num_iters - 1:
+            m = {k: float(v) for k, v in m_dev.items()}
+            window_eps = ep_returns[-100:]
+            m["episodes"] = float(len(ep_returns))
+            m["avg_return"] = (
+                float(np.mean(window_eps)) if window_eps else 0.0
+            )
+            m["replay_size"] = float(size)
+            env_steps = (it + 1) * steps_per_iteration
+            m["steps_per_sec"] = clock.rate(it_off)
+            emit_log(env_steps, m, history, summary_writer, log_fn)
+
+        if (
+            checkpointer is not None
+            and checkpoint_interval_iters
+            and (it_off + 1) % checkpoint_interval_iters == 0
+        ):
+            flush_staged()
+            state = _pack_state(
+                params, opt_state, obs, noise, replay, key, it + 1
+            )
+            checkpointer.save((it + 1) * steps_per_iteration, state)
+
+    flush_staged()
+    state = _pack_state(
+        params, opt_state, obs, noise, replay, key, iters_done0 + num_iters
+    )
+    return state, history
+
+
+def _pack_state(
+    params, opt_state, obs, noise, replay, key, step
+) -> offpolicy.OffPolicyState:
+    """Fused-path-compatible ``OffPolicyState`` (checkpoint format)."""
+    return offpolicy.OffPolicyState(
+        params=params,
+        opt_state=opt_state,
+        env_state=HostEnvState(t=jnp.asarray(step, jnp.int32)),
+        obs=jnp.asarray(obs),
+        noise=noise,
+        replay=jax.tree_util.tree_map(lambda x: x[None], replay),
+        key=key,
+        step=jnp.asarray(step, jnp.int32),
+    )

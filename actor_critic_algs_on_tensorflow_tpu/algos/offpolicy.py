@@ -77,6 +77,29 @@ def state_specs(state: OffPolicyState) -> OffPolicyState:
     )
 
 
+class TrainerParts(NamedTuple):
+    """The trainer's composable pieces, for loops OTHER than the fused
+    shard_map iteration (e.g. the async host-env loop in
+    ``algos.host_async``, where acting runs on the host CPU and only
+    the update block runs on the accelerator).
+
+    ``one_update(replay, (params, opt_state), key)`` is the SAME update
+    math the fused path scans; ``act_fn(params, obs, noise, key, step)``
+    the same acting; ``init_params(key, obs_example)`` builds
+    (params, opt_state) without touching an environment.
+    """
+
+    cfg: Any
+    setup: "TrainerSetup"
+    act_fn: Callable
+    one_update: Callable
+    init_params: Callable
+    noise_init: Callable        # (num_envs,) -> noise pytree
+    noise_reset: Callable | None  # (noise, done) -> noise
+    acting_slice: Callable      # params -> the subtree acting reads
+    act_with: Callable          # (acting_slice, obs, noise, key, step)
+
+
 class OffPolicyFns(NamedTuple):
     """A compiled off-policy training program."""
 
@@ -86,6 +109,7 @@ class OffPolicyFns(NamedTuple):
     ]
     mesh: Mesh
     steps_per_iteration: int  # global env steps per iteration
+    parts: Any = None         # TrainerParts (for non-fused loops)
 
 
 def build_off_policy_iteration(
@@ -275,7 +299,7 @@ def finalize_iteration(
 
 
 def build_fns(
-    s: TrainerSetup, init: Callable, local_iteration: Callable
+    s: TrainerSetup, init: Callable, local_iteration: Callable, parts=None
 ) -> OffPolicyFns:
     """eval_shape the init, compile the fused iteration, pack the API."""
     example = jax.eval_shape(init, jax.random.PRNGKey(0))
@@ -284,6 +308,7 @@ def build_fns(
         iteration=build_off_policy_iteration(local_iteration, example, s.mesh),
         mesh=s.mesh,
         steps_per_iteration=s.steps_per_iteration,
+        parts=parts,
     )
 
 

@@ -15,6 +15,7 @@ over the ``data`` axis (shared scaffolding: ``algos/offpolicy.py``).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Tuple
 
 import jax
@@ -74,42 +75,134 @@ def make_sac(cfg: SACConfig) -> offpolicy.OffPolicyFns:
     critic_tx = offpolicy.make_adam(cfg.critic_lr)
     alpha_tx = offpolicy.make_adam(cfg.alpha_lr)
 
-    def act_fn(params, obs, noise, key, step):
+    def act_with(actor_params, obs, noise, key, step):
         """Stochastic squashed-Gaussian acting; uniform during warmup."""
         k_sample, k_rand = jax.random.split(key)
-        mean, log_std = actor.apply(params.actor, obs)
+        mean, log_std = actor.apply(actor_params, obs)
         a = TanhGaussian(mean, log_std).sample(k_sample)
         rand = jax.random.uniform(k_rand, a.shape, a.dtype, -1.0, 1.0)
         a = jnp.where(step < s.warmup_iters, rand, a)
         return a * s.action_scale, noise
 
-    def init(key: jax.Array) -> offpolicy.OffPolicyState:
-        k_env, k_actor, k_critic, k_state = jax.random.split(key, 4)
-        env_state, obs = s.genv.reset(k_env, s.env_params)
-        actor_params = actor.init(k_actor, obs[:1])
+    def act_fn(params, obs, noise, key, step):
+        return act_with(params.actor, obs, noise, key, step)
+
+    def init_params(key: jax.Array, obs_example):
+        k_actor, k_critic = jax.random.split(key)
+        actor_params = actor.init(k_actor, obs_example)
         critic_params = critic.init(
-            k_critic, obs[:1], jnp.zeros((1, s.action_dim))
+            k_critic, obs_example, jnp.zeros((1, s.action_dim))
         )
         log_alpha = jnp.log(jnp.asarray(cfg.init_alpha, jnp.float32))
+        params = SACParams(
+            actor=actor_params,
+            critic=critic_params,
+            # Copy: donated state must not alias online/target buffers.
+            target_critic=jax.tree_util.tree_map(jnp.copy, critic_params),
+            log_alpha=log_alpha,
+        )
+        opt_state = {
+            "actor": actor_tx.init(actor_params),
+            "critic": critic_tx.init(critic_params),
+            "alpha": alpha_tx.init(log_alpha),
+        }
+        return params, opt_state
+
+    def init(key: jax.Array) -> offpolicy.OffPolicyState:
+        k_env, k_params, k_state = jax.random.split(key, 3)
+        env_state, obs = s.genv.reset(k_env, s.env_params)
+        params, opt_state = init_params(k_params, obs[:1])
         return offpolicy.assemble_state(
             s,
-            params=SACParams(
-                actor=actor_params,
-                critic=critic_params,
-                # Copy: donated state must not alias online/target buffers.
-                target_critic=jax.tree_util.tree_map(jnp.copy, critic_params),
-                log_alpha=log_alpha,
-            ),
-            opt_state={
-                "actor": actor_tx.init(actor_params),
-                "critic": critic_tx.init(critic_params),
-                "alpha": alpha_tx.init(log_alpha),
-            },
+            params=params,
+            opt_state=opt_state,
             env_state=env_state,
             obs=obs,
             noise=jnp.zeros((cfg.num_envs,)),  # SAC needs no noise carry
             key=k_state,
         )
+
+    def one_update(replay, carry, key):
+        params, opt_state = carry
+        k_batch, k_next, k_pi = jax.random.split(key, 3)
+        batch = s.buf.sample(replay, k_batch, cfg.batch_size)
+        alpha = jnp.exp(params.log_alpha)
+
+        def critic_loss_fn(cp):
+            mean, log_std = actor.apply(params.actor, batch.next_obs)
+            a_next, logp_next = TanhGaussian(
+                mean, log_std
+            ).sample_and_log_prob(k_next)
+            q1t, q2t = critic.apply(
+                params.target_critic,
+                batch.next_obs,
+                a_next * s.action_scale,
+            )
+            v_next = jnp.minimum(q1t, q2t) - alpha * logp_next
+            y = batch.reward + cfg.gamma * (1.0 - batch.terminated) * v_next
+            y = jax.lax.stop_gradient(y)
+            q1, q2 = critic.apply(cp, batch.obs, batch.action)
+            return (
+                jnp.mean((q1 - y) ** 2) + jnp.mean((q2 - y) ** 2),
+                0.5 * (jnp.mean(q1) + jnp.mean(q2)),
+            )
+
+        (q_loss, q_mean), q_grads = jax.value_and_grad(
+            critic_loss_fn, has_aux=True
+        )(params.critic)
+
+        def actor_loss_fn(ap):
+            mean, log_std = actor.apply(ap, batch.obs)
+            a, logp = TanhGaussian(mean, log_std).sample_and_log_prob(k_pi)
+            q1, q2 = critic.apply(
+                params.critic, batch.obs, a * s.action_scale
+            )
+            q = jnp.minimum(q1, q2)
+            return jnp.mean(alpha * logp - q), jnp.mean(logp)
+
+        (a_loss, logp_mean), a_grads = jax.value_and_grad(
+            actor_loss_fn, has_aux=True
+        )(params.actor)
+
+        def alpha_loss_fn(la):
+            # Gradient flows through la only; entropy gap detached.
+            gap = jax.lax.stop_gradient(logp_mean + target_entropy)
+            return -jnp.exp(la) * gap
+
+        al_loss, al_grad = jax.value_and_grad(alpha_loss_fn)(
+            params.log_alpha
+        )
+
+        q_grads = jax.lax.pmean(q_grads, DATA_AXIS)
+        a_grads = jax.lax.pmean(a_grads, DATA_AXIS)
+        al_grad = jax.lax.pmean(al_grad, DATA_AXIS)
+        q_up, c_opt = critic_tx.update(
+            q_grads, opt_state["critic"], params.critic
+        )
+        a_up, a_opt = actor_tx.update(
+            a_grads, opt_state["actor"], params.actor
+        )
+        al_up, al_opt = alpha_tx.update(
+            al_grad, opt_state["alpha"], params.log_alpha
+        )
+        new_params = SACParams(
+            actor=optax.apply_updates(params.actor, a_up),
+            critic=optax.apply_updates(params.critic, q_up),
+            target_critic=polyak_update(
+                params.target_critic, params.critic, cfg.tau
+            ),
+            log_alpha=optax.apply_updates(params.log_alpha, al_up),
+        )
+        m = {
+            "q_loss": q_loss,
+            "actor_loss": a_loss,
+            "alpha_loss": al_loss,
+            "alpha": alpha,
+            "entropy": -logp_mean,
+            "q_mean": q_mean,
+        }
+        new_opt = {"actor": a_opt, "critic": c_opt, "alpha": al_opt}
+        return (new_params, new_opt), m
 
     def local_iteration(state: offpolicy.OffPolicyState):
         dev = jax.lax.axis_index(DATA_AXIS)
@@ -124,93 +217,11 @@ def make_sac(cfg: SACConfig) -> offpolicy.OffPolicyFns:
             k_roll, cfg.steps_per_iter, state.step,
         )
 
-        def one_update(carry, key):
-            params, opt_state = carry
-            k_batch, k_next, k_pi = jax.random.split(key, 3)
-            batch = s.buf.sample(replay, k_batch, cfg.batch_size)
-            alpha = jnp.exp(params.log_alpha)
-
-            def critic_loss_fn(cp):
-                mean, log_std = actor.apply(params.actor, batch.next_obs)
-                a_next, logp_next = TanhGaussian(
-                    mean, log_std
-                ).sample_and_log_prob(k_next)
-                q1t, q2t = critic.apply(
-                    params.target_critic,
-                    batch.next_obs,
-                    a_next * s.action_scale,
-                )
-                v_next = jnp.minimum(q1t, q2t) - alpha * logp_next
-                y = batch.reward + cfg.gamma * (1.0 - batch.terminated) * v_next
-                y = jax.lax.stop_gradient(y)
-                q1, q2 = critic.apply(cp, batch.obs, batch.action)
-                return (
-                    jnp.mean((q1 - y) ** 2) + jnp.mean((q2 - y) ** 2),
-                    0.5 * (jnp.mean(q1) + jnp.mean(q2)),
-                )
-
-            (q_loss, q_mean), q_grads = jax.value_and_grad(
-                critic_loss_fn, has_aux=True
-            )(params.critic)
-
-            def actor_loss_fn(ap):
-                mean, log_std = actor.apply(ap, batch.obs)
-                a, logp = TanhGaussian(mean, log_std).sample_and_log_prob(k_pi)
-                q1, q2 = critic.apply(
-                    params.critic, batch.obs, a * s.action_scale
-                )
-                q = jnp.minimum(q1, q2)
-                return jnp.mean(alpha * logp - q), jnp.mean(logp)
-
-            (a_loss, logp_mean), a_grads = jax.value_and_grad(
-                actor_loss_fn, has_aux=True
-            )(params.actor)
-
-            def alpha_loss_fn(la):
-                # Gradient flows through la only; entropy gap detached.
-                gap = jax.lax.stop_gradient(logp_mean + target_entropy)
-                return -jnp.exp(la) * gap
-
-            al_loss, al_grad = jax.value_and_grad(alpha_loss_fn)(
-                params.log_alpha
-            )
-
-            q_grads = jax.lax.pmean(q_grads, DATA_AXIS)
-            a_grads = jax.lax.pmean(a_grads, DATA_AXIS)
-            al_grad = jax.lax.pmean(al_grad, DATA_AXIS)
-            q_up, c_opt = critic_tx.update(
-                q_grads, opt_state["critic"], params.critic
-            )
-            a_up, a_opt = actor_tx.update(
-                a_grads, opt_state["actor"], params.actor
-            )
-            al_up, al_opt = alpha_tx.update(
-                al_grad, opt_state["alpha"], params.log_alpha
-            )
-            new_params = SACParams(
-                actor=optax.apply_updates(params.actor, a_up),
-                critic=optax.apply_updates(params.critic, q_up),
-                target_critic=polyak_update(
-                    params.target_critic, params.critic, cfg.tau
-                ),
-                log_alpha=optax.apply_updates(params.log_alpha, al_up),
-            )
-            m = {
-                "q_loss": q_loss,
-                "actor_loss": a_loss,
-                "alpha_loss": al_loss,
-                "alpha": alpha,
-                "entropy": -logp_mean,
-                "q_mean": q_mean,
-            }
-            new_opt = {"actor": a_opt, "critic": c_opt, "alpha": al_opt}
-            return (new_params, new_opt), m
-
         ready = jnp.logical_and(
             state.step >= s.warmup_iters, replay.size >= cfg.batch_size
         )
         (params, opt_state), m = offpolicy.gated_updates(
-            one_update,
+            functools.partial(one_update, replay),
             (state.params, state.opt_state),
             jax.random.split(k_upd, cfg.updates_per_iter),
             ready,
@@ -228,4 +239,15 @@ def make_sac(cfg: SACConfig) -> offpolicy.OffPolicyFns:
             ep_info=ep_info,
         )
 
-    return offpolicy.build_fns(s, init, local_iteration)
+    parts = offpolicy.TrainerParts(
+        cfg=cfg,
+        setup=s,
+        act_fn=act_fn,
+        one_update=one_update,
+        init_params=init_params,
+        noise_init=lambda n: jnp.zeros((n,)),
+        noise_reset=None,
+        acting_slice=lambda params: params.actor,
+        act_with=act_with,
+    )
+    return offpolicy.build_fns(s, init, local_iteration, parts=parts)

@@ -17,6 +17,7 @@ Gaussian noise (no OU process).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Tuple
 
 import jax
@@ -74,7 +75,7 @@ def make_td3(cfg: TD3Config) -> offpolicy.OffPolicyFns:
     actor_tx = offpolicy.make_adam(cfg.actor_lr, cfg.max_grad_norm)
     critic_tx = offpolicy.make_adam(cfg.critic_lr, cfg.max_grad_norm)
 
-    def act_fn(params, obs, noise, key, step):
+    def act_with(actor_params, obs, noise, key, step):
         """Tanh actor + Gaussian noise; uniform-random during warmup.
 
         ``noise`` is an unused placeholder (TD3 noise is i.i.d. per
@@ -82,45 +83,155 @@ def make_td3(cfg: TD3Config) -> offpolicy.OffPolicyFns:
         ``act_then_store`` signature.
         """
         k_eps, k_rand = jax.random.split(key)
-        a = actor.apply(params.actor, obs)
+        a = actor.apply(actor_params, obs)
         eps = cfg.explore_sigma * jax.random.normal(k_eps, a.shape, a.dtype)
         a = jnp.clip(a + eps, -1.0, 1.0)
         rand = jax.random.uniform(k_rand, a.shape, a.dtype, -1.0, 1.0)
         a = jnp.where(step < s.warmup_iters, rand, a)
         return a * s.action_scale, noise
 
-    def init(key: jax.Array) -> offpolicy.OffPolicyState:
-        k_env, k_actor, k_critic, k_state = jax.random.split(key, 4)
-        env_state, obs = s.genv.reset(k_env, s.env_params)
-        actor_params = actor.init(k_actor, obs[:1])
+    def act_fn(params, obs, noise, key, step):
+        return act_with(params.actor, obs, noise, key, step)
+
+    def init_params(key: jax.Array, obs_example):
+        k_actor, k_critic = jax.random.split(key)
+        actor_params = actor.init(k_actor, obs_example)
         critic_params = critic.init(
-            k_critic, obs[:1], jnp.zeros((1, s.action_dim))
+            k_critic, obs_example, jnp.zeros((1, s.action_dim))
         )
         # Targets are COPIES: with donated state, aliasing online and
         # target leaves would donate the same buffer twice.
         copy = lambda t: jax.tree_util.tree_map(jnp.copy, t)
+        params = TD3Params(
+            actor=actor_params,
+            critic=critic_params,
+            target_actor=copy(actor_params),
+            target_critic=copy(critic_params),
+        )
+        opt_state = {
+            "actor": actor_tx.init(actor_params),
+            "critic": critic_tx.init(critic_params),
+            # Count of updates actually EXECUTED (the policy-delay
+            # phase): iteration-derived counters drift whenever an
+            # iteration is skipped because the replay buffer has
+            # not filled yet (ready also gates on replay.size).
+            "updates_done": jnp.zeros((), jnp.int32),
+        }
+        return params, opt_state
+
+    def init(key: jax.Array) -> offpolicy.OffPolicyState:
+        k_env, k_params, k_state = jax.random.split(key, 3)
+        env_state, obs = s.genv.reset(k_env, s.env_params)
+        params, opt_state = init_params(k_params, obs[:1])
         return offpolicy.assemble_state(
             s,
-            params=TD3Params(
-                actor=actor_params,
-                critic=critic_params,
-                target_actor=copy(actor_params),
-                target_critic=copy(critic_params),
-            ),
-            opt_state={
-                "actor": actor_tx.init(actor_params),
-                "critic": critic_tx.init(critic_params),
-                # Count of updates actually EXECUTED (the policy-delay
-                # phase): iteration-derived counters drift whenever an
-                # iteration is skipped because the replay buffer has
-                # not filled yet (ready also gates on replay.size).
-                "updates_done": jnp.zeros((), jnp.int32),
-            },
+            params=params,
+            opt_state=opt_state,
             env_state=env_state,
             obs=obs,
             noise=jnp.zeros(()),
             key=k_state,
         )
+
+    def one_update(replay, carry, key):
+        params, opt_state = carry
+        upd_idx = opt_state["updates_done"]
+        k_batch, k_smooth = jax.random.split(key)
+        batch = s.buf.sample(replay, k_batch, cfg.batch_size)
+
+        def critic_loss_fn(cp):
+            # Target-policy smoothing: clipped noise on the target
+            # action before the twin-min backup (TD3 eq. 14-15).
+            a_next = actor.apply(params.target_actor, batch.next_obs)
+            eps = jnp.clip(
+                cfg.target_sigma
+                * jax.random.normal(k_smooth, a_next.shape, a_next.dtype),
+                -cfg.target_clip,
+                cfg.target_clip,
+            )
+            a_next = jnp.clip(a_next + eps, -1.0, 1.0)
+            q1t, q2t = critic.apply(
+                params.target_critic,
+                batch.next_obs,
+                a_next * s.action_scale,
+            )
+            q_next = jnp.minimum(q1t, q2t)
+            y = batch.reward + cfg.gamma * (1.0 - batch.terminated) * q_next
+            y = jax.lax.stop_gradient(y)
+            q1, q2 = critic.apply(cp, batch.obs, batch.action)
+            loss = jnp.mean((q1 - y) ** 2) + jnp.mean((q2 - y) ** 2)
+            return loss, q1
+
+        (q_loss, q1), q_grads = jax.value_and_grad(
+            critic_loss_fn, has_aux=True
+        )(params.critic)
+        q_grads = jax.lax.pmean(q_grads, DATA_AXIS)
+        q_up, c_opt = critic_tx.update(
+            q_grads, opt_state["critic"], params.critic
+        )
+        new_critic = optax.apply_updates(params.critic, q_up)
+
+        # Delayed policy + target updates, every policy_delay
+        # critic steps. The actor forward/backward and its pmean
+        # run only in the taken branch: the predicate is the same
+        # on every device (upd_idx is replicated), so the
+        # collective inside the branch is uniform across the mesh.
+        def do_actor(_):
+            def actor_loss_fn(ap):
+                a = actor.apply(ap, batch.obs)
+                q1_pi, _ = critic.apply(
+                    params.critic, batch.obs, a * s.action_scale
+                )
+                return -jnp.mean(q1_pi)
+
+            a_loss, a_grads = jax.value_and_grad(actor_loss_fn)(
+                params.actor
+            )
+            a_grads = jax.lax.pmean(a_grads, DATA_AXIS)
+            a_up, a_opt = actor_tx.update(
+                a_grads, opt_state["actor"], params.actor
+            )
+            new_actor = optax.apply_updates(params.actor, a_up)
+            return (
+                new_actor,
+                a_opt,
+                polyak_update(params.target_actor, new_actor, cfg.tau),
+                polyak_update(params.target_critic, new_critic, cfg.tau),
+                a_loss,
+                jnp.ones(()),
+            )
+
+        def skip_actor(_):
+            return (
+                params.actor,
+                opt_state["actor"],
+                params.target_actor,
+                params.target_critic,
+                jnp.zeros(()),
+                jnp.zeros(()),
+            )
+
+        new_actor, a_opt, t_actor, t_critic, a_loss, did = jax.lax.cond(
+            upd_idx % cfg.policy_delay == 0, do_actor, skip_actor, None
+        )
+        new_params = TD3Params(
+            actor=new_actor,
+            critic=new_critic,
+            target_actor=t_actor,
+            target_critic=t_critic,
+        )
+        m = {
+            "q_loss": q_loss,
+            "actor_loss": a_loss,
+            "actor_updates": did,
+            "q_mean": jnp.mean(q1),
+        }
+        new_opt = {
+            "actor": a_opt,
+            "critic": c_opt,
+            "updates_done": upd_idx + 1,
+        }
+        return (new_params, new_opt), m
 
     def local_iteration(state: offpolicy.OffPolicyState):
         dev = jax.lax.axis_index(DATA_AXIS)
@@ -135,111 +246,11 @@ def make_td3(cfg: TD3Config) -> offpolicy.OffPolicyFns:
             k_roll, cfg.steps_per_iter, state.step,
         )
 
-        def one_update(carry, key):
-            params, opt_state = carry
-            upd_idx = opt_state["updates_done"]
-            k_batch, k_smooth = jax.random.split(key)
-            batch = s.buf.sample(replay, k_batch, cfg.batch_size)
-
-            def critic_loss_fn(cp):
-                # Target-policy smoothing: clipped noise on the target
-                # action before the twin-min backup (TD3 eq. 14-15).
-                a_next = actor.apply(params.target_actor, batch.next_obs)
-                eps = jnp.clip(
-                    cfg.target_sigma
-                    * jax.random.normal(k_smooth, a_next.shape, a_next.dtype),
-                    -cfg.target_clip,
-                    cfg.target_clip,
-                )
-                a_next = jnp.clip(a_next + eps, -1.0, 1.0)
-                q1t, q2t = critic.apply(
-                    params.target_critic,
-                    batch.next_obs,
-                    a_next * s.action_scale,
-                )
-                q_next = jnp.minimum(q1t, q2t)
-                y = batch.reward + cfg.gamma * (1.0 - batch.terminated) * q_next
-                y = jax.lax.stop_gradient(y)
-                q1, q2 = critic.apply(cp, batch.obs, batch.action)
-                loss = jnp.mean((q1 - y) ** 2) + jnp.mean((q2 - y) ** 2)
-                return loss, q1
-
-            (q_loss, q1), q_grads = jax.value_and_grad(
-                critic_loss_fn, has_aux=True
-            )(params.critic)
-            q_grads = jax.lax.pmean(q_grads, DATA_AXIS)
-            q_up, c_opt = critic_tx.update(
-                q_grads, opt_state["critic"], params.critic
-            )
-            new_critic = optax.apply_updates(params.critic, q_up)
-
-            # Delayed policy + target updates, every policy_delay
-            # critic steps. The actor forward/backward and its pmean
-            # run only in the taken branch: the predicate is the same
-            # on every device (upd_idx is replicated), so the
-            # collective inside the branch is uniform across the mesh.
-            def do_actor(_):
-                def actor_loss_fn(ap):
-                    a = actor.apply(ap, batch.obs)
-                    q1_pi, _ = critic.apply(
-                        params.critic, batch.obs, a * s.action_scale
-                    )
-                    return -jnp.mean(q1_pi)
-
-                a_loss, a_grads = jax.value_and_grad(actor_loss_fn)(
-                    params.actor
-                )
-                a_grads = jax.lax.pmean(a_grads, DATA_AXIS)
-                a_up, a_opt = actor_tx.update(
-                    a_grads, opt_state["actor"], params.actor
-                )
-                new_actor = optax.apply_updates(params.actor, a_up)
-                return (
-                    new_actor,
-                    a_opt,
-                    polyak_update(params.target_actor, new_actor, cfg.tau),
-                    polyak_update(params.target_critic, new_critic, cfg.tau),
-                    a_loss,
-                    jnp.ones(()),
-                )
-
-            def skip_actor(_):
-                return (
-                    params.actor,
-                    opt_state["actor"],
-                    params.target_actor,
-                    params.target_critic,
-                    jnp.zeros(()),
-                    jnp.zeros(()),
-                )
-
-            new_actor, a_opt, t_actor, t_critic, a_loss, did = jax.lax.cond(
-                upd_idx % cfg.policy_delay == 0, do_actor, skip_actor, None
-            )
-            new_params = TD3Params(
-                actor=new_actor,
-                critic=new_critic,
-                target_actor=t_actor,
-                target_critic=t_critic,
-            )
-            m = {
-                "q_loss": q_loss,
-                "actor_loss": a_loss,
-                "actor_updates": did,
-                "q_mean": jnp.mean(q1),
-            }
-            new_opt = {
-                "actor": a_opt,
-                "critic": c_opt,
-                "updates_done": upd_idx + 1,
-            }
-            return (new_params, new_opt), m
-
         ready = jnp.logical_and(
             state.step >= s.warmup_iters, replay.size >= cfg.batch_size
         )
         (params, opt_state), m = offpolicy.gated_updates(
-            one_update,
+            functools.partial(one_update, replay),
             (state.params, state.opt_state),
             jax.random.split(k_upd, cfg.updates_per_iter),
             ready,
@@ -262,4 +273,15 @@ def make_td3(cfg: TD3Config) -> offpolicy.OffPolicyFns:
             ep_info=ep_info,
         )
 
-    return offpolicy.build_fns(s, init, local_iteration)
+    parts = offpolicy.TrainerParts(
+        cfg=cfg,
+        setup=s,
+        act_fn=act_fn,
+        one_update=one_update,
+        init_params=init_params,
+        noise_init=lambda n: jnp.zeros(()),
+        noise_reset=None,
+        acting_slice=lambda params: params.actor,
+        act_with=act_with,
+    )
+    return offpolicy.build_fns(s, init, local_iteration, parts=parts)

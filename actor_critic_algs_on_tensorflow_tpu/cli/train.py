@@ -249,6 +249,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "platform and JAX_PLATFORMS comes too late; "
                         "host-resident gym:/native: envs need cpu or a "
                         "standard TPU host runtime")
+    p.add_argument("--host-loop", choices=("auto", "fused", "async"),
+                   default="auto",
+                   help="off-policy trainers with gym:/native: envs: "
+                        "'fused' steps envs inside the jitted program "
+                        "(io_callback), 'async' steps them host-side "
+                        "with the update block on the accelerator "
+                        "(algos.host_async). 'auto' picks async when "
+                        "the backend lacks host callbacks (single-chip "
+                        "axon TPU), else fused")
     p.add_argument("--actor-processes", action="store_true",
                    help="impala: run actors as separate processes "
                         "streaming over the TCP transport (the "
@@ -431,22 +440,62 @@ def _run(args, algo, cfg, writer) -> int:
 
         fns = make_sac(cfg)
 
+    use_async = False
+    if algo in ("ddpg", "td3", "sac"):
+        from actor_critic_algs_on_tensorflow_tpu.algos import host_async
+        from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
+            host_callbacks_supported,
+        )
+
+        if host_async.host_async_supported(cfg):
+            if args.host_loop == "async":
+                use_async = True
+            elif args.host_loop == "auto":
+                use_async = not host_callbacks_supported()
+        elif args.host_loop == "async":
+            raise SystemExit(
+                "--host-loop async needs a gym:/native: env and "
+                "num_devices<=1"
+            )
+
     def make_template():
         import jax
 
+        if use_async:
+            # No env reset on a backend without host callbacks:
+            # structure only.
+            return jax.eval_shape(fns.init, jax.random.PRNGKey(cfg.seed))
         return fns.init(jax.random.PRNGKey(cfg.seed))
 
     checkpointer, state = _open_checkpointer(args, make_template)
-    state, history = common.run_loop(
-        fns,
-        total_env_steps=cfg.total_env_steps,
-        seed=cfg.seed,
-        log_interval_iters=args.log_interval,
-        checkpointer=checkpointer,
-        checkpoint_interval_iters=args.checkpoint_interval,
-        state=state,
-        summary_writer=writer,
-    )
+    if use_async:
+        from actor_critic_algs_on_tensorflow_tpu.algos.host_async import (
+            run_host_async,
+        )
+
+        print("[train] host-async loop: envs on host CPU, updates on "
+              f"{__import__('jax').devices()[0].platform}", flush=True)
+        state, history = run_host_async(
+            fns,
+            total_env_steps=cfg.total_env_steps,
+            seed=cfg.seed,
+            log_interval_iters=args.log_interval,
+            checkpointer=checkpointer,
+            checkpoint_interval_iters=args.checkpoint_interval,
+            initial_state=state,
+            summary_writer=writer,
+        )
+    else:
+        state, history = common.run_loop(
+            fns,
+            total_env_steps=cfg.total_env_steps,
+            seed=cfg.seed,
+            log_interval_iters=args.log_interval,
+            checkpointer=checkpointer,
+            checkpoint_interval_iters=args.checkpoint_interval,
+            state=state,
+            summary_writer=writer,
+        )
     _finalize_checkpointer(
         checkpointer, int(state.step) * fns.steps_per_iteration, state
     )

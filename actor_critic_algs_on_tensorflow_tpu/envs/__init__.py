@@ -72,20 +72,9 @@ def make(
     Returns ``(vec_env, params)``.
     """
     if name.startswith(("native:", "gym:")):
-        from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
-            host_callbacks_supported,
-        )
-
-        if not host_callbacks_supported():
-            # The axon plugin HANGS on ordered host callbacks rather
-            # than erroring — fail fast with guidance instead.
-            raise RuntimeError(
-                f"host-resident env {name!r} needs jax host callbacks "
-                f"(io_callback), which this TPU backend does not "
-                f"support (axon_pjrt). Run on a TPU host with standard "
-                f"PJRT, or on CPU (JAX_PLATFORMS=cpu), or force with "
-                f"ACT_TPU_HOST_CB=1."
-            )
+        # NOTE: backend host-callback support is checked at BRIDGE USE
+        # (HostGymEnv/NativeEnvPool reset/step), not here — direct
+        # host-side stepping (algos.host_async) needs no callbacks.
         if frame_stack and frame_stack > 1:
             raise ValueError(
                 f"frame_stack is not supported on host-resident envs "

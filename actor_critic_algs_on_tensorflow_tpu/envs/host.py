@@ -38,6 +38,30 @@ from jax.experimental import io_callback
 from actor_critic_algs_on_tensorflow_tpu.envs.core import Box, Discrete, JaxEnv
 
 
+
+def _require_host_callbacks(env_name: str, probe=None) -> None:
+    from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
+        host_callbacks_supported,
+    )
+
+    if isinstance(probe, jax.core.Tracer):
+        # Abstract evaluation (eval_shape for checkpoint templates /
+        # shape probing) executes no callback — only concrete eager
+        # calls lead to the hanging runtime path.
+        return
+    if not host_callbacks_supported():
+        # The axon plugin HANGS on ordered host callbacks rather than
+        # erroring — fail fast with guidance instead.
+        raise RuntimeError(
+            f"bridged stepping of host env {env_name!r} needs jax host "
+            "callbacks (io_callback), which this TPU backend does not "
+            "support (axon_pjrt). Off-policy trainers fall back to the "
+            "async host loop (algos.host_async) automatically; "
+            "otherwise run on a TPU host with standard PJRT, on CPU "
+            "(JAX_PLATFORMS=cpu), or force with ACT_TPU_HOST_CB=1."
+        )
+
+
 @struct.dataclass
 class HostEnvState:
     """Ordering token; the simulator itself lives on the host."""
@@ -212,6 +236,7 @@ class HostGymEnv(JaxEnv):
         return None
 
     def reset(self, key: jax.Array, params=None) -> Tuple[HostEnvState, jax.Array]:
+        _require_host_callbacks(self.name, key)
         seed = jax.random.randint(key, (), 0, np.iinfo(np.int32).max)
         obs = io_callback(
             self._host_reset, self._reset_struct, seed, ordered=True
@@ -219,6 +244,7 @@ class HostGymEnv(JaxEnv):
         return HostEnvState(t=jnp.zeros((), jnp.int32)), obs
 
     def step(self, key: jax.Array, state: HostEnvState, action, params=None):
+        _require_host_callbacks(self.name, action)
         out = io_callback(
             self._host_step, self._step_struct, action, ordered=True
         )

@@ -160,6 +160,11 @@ class NativeEnvPool(JaxEnv):
         return None
 
     def reset(self, key: jax.Array, params=None) -> Tuple[NativeEnvState, jax.Array]:
+        from actor_critic_algs_on_tensorflow_tpu.envs.host import (
+            _require_host_callbacks,
+        )
+
+        _require_host_callbacks(self.name, key)
         seed = jax.random.randint(key, (), 0, np.iinfo(np.int32).max)
         obs = io_callback(
             self._host_reset, self._reset_struct, seed, ordered=True
@@ -167,6 +172,11 @@ class NativeEnvPool(JaxEnv):
         return NativeEnvState(t=jnp.zeros((), jnp.int32)), obs
 
     def step(self, key: jax.Array, state: NativeEnvState, action, params=None):
+        from actor_critic_algs_on_tensorflow_tpu.envs.host import (
+            _require_host_callbacks,
+        )
+
+        _require_host_callbacks(self.name, action)
         out = io_callback(
             self._host_step, self._step_struct, action, ordered=True
         )
