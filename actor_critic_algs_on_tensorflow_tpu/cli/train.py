@@ -139,10 +139,13 @@ PRESETS = {
         },
     ),
     # 7. IMPALA on the Atari-class on-device Pong: the async
-    # actor-learner path solving the headline task. Measured on one
-    # v5e chip: avg_return reaches 18 by ~7.5M steps and stabilizes at
-    # 19-21 from ~14M (avg 21 windows observed), ~159k env-steps/s
-    # with actors and learner sharing the chip (~113 s wall-clock).
+    # actor-learner path solving the headline task. r2 actor-width
+    # sweep: ONE 256-env actor at the same ~8k-step learner batch
+    # keeps the rollout conv MXU-fed (the r1 2x64 config starved it
+    # at width 64) — ~405-437k env-steps/s vs 159k, actors+learner
+    # sharing one v5e chip. avg_return reaches 19+ within the 25M
+    # budget (~60 s wall-clock; seeds 0/1: 19.3 @ 17.7M, 19-19.5
+    # @ 24-25M).
     "impala-pong": (
         "impala",
         {
@@ -150,14 +153,14 @@ PRESETS = {
             "torso": "nature_cnn",
             "frame_stack": 4,
             "compute_dtype": "bfloat16",
-            "num_actors": 2,
-            "envs_per_actor": 64,
+            "num_actors": 1,
+            "envs_per_actor": 256,
             "rollout_length": 32,
-            "batch_trajectories": 4,
+            "batch_trajectories": 1,
             "lr": 1e-3,
             "lr_decay": False,
             "ent_coef": 0.01,
-            "total_env_steps": 18_000_000,
+            "total_env_steps": 25_000_000,
         },
     ),
     # 8. SAC on the on-device two-link Reacher (multi-dim continuous
