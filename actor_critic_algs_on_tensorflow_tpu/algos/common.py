@@ -265,26 +265,33 @@ def evaluate(
     *,
     num_envs: int,
     max_steps: int = 1000,
+    record: bool = False,
 ):
     """Greedy/stochastic policy evaluation on a vectorized env.
 
     Runs until each env finishes its FIRST episode (or ``max_steps``).
     ``act_fn(obs, key) -> actions``. Returns ``(mean_return,
     per_env_returns, fraction_finished)``; jit-compiled by the caller.
+    With ``record=True`` returns a fourth element: env 0's per-step
+    observations ``[max_steps, ...]`` plus its ``done`` flags
+    ``[max_steps]`` (for trimming to the first episode).
     """
 
     def _step(carry, k):
         env_state, obs, done_seen, ep_ret = carry
         k_act, k_env = jax.random.split(k)
         actions = act_fn(obs, k_act)
-        env_state, obs, _, done, info = env.step(k_env, env_state, actions, env_params)
+        env_state, next_obs, _, done, info = env.step(
+            k_env, env_state, actions, env_params
+        )
         ep_ret = jnp.where(
             done_seen > 0.5,
             ep_ret,
             jnp.where(done > 0.5, info["episode_return"], ep_ret),
         )
-        done_seen = jnp.maximum(done_seen, done)
-        return (env_state, obs, done_seen, ep_ret), None
+        new_done_seen = jnp.maximum(done_seen, done)
+        out = (obs[0], done_seen[0]) if record else None
+        return (env_state, next_obs, new_done_seen, ep_ret), out
 
     k_reset, k_run = jax.random.split(key)
     env_state, obs = env.reset(k_reset, env_params)
@@ -294,9 +301,15 @@ def evaluate(
         jnp.zeros(num_envs),
         jnp.zeros(num_envs),
     )
-    (env_state, obs, done_seen, ep_ret), _ = jax.lax.scan(
+    (env_state, obs, done_seen, ep_ret), rec = jax.lax.scan(
         _step, init, jax.random.split(k_run, max_steps)
     )
+    if record:
+        frames, done_before = rec
+        return jnp.mean(ep_ret), ep_ret, jnp.mean(done_seen), (
+            frames,
+            done_before,
+        )
     return jnp.mean(ep_ret), ep_ret, jnp.mean(done_seen)
 
 

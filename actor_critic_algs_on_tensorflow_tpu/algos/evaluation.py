@@ -128,11 +128,18 @@ def evaluate_checkpoint(
     max_steps: int = 1000,
     stochastic: bool = False,
     seed: int = 1234,
+    render_dir: str | None = None,
 ) -> Tuple[float, np.ndarray, float]:
     """Restore the latest checkpoint and roll the policy.
 
     Returns ``(mean_return, per_env_returns, fraction_finished)`` over
     each env's first episode (capped at ``max_steps``).
+
+    ``render_dir`` additionally records env 0's first episode: image
+    observations become an animated ``episode.gif`` (newest frame of
+    the stack, nearest-upscaled 3x); vector observations are saved as
+    ``episode.npy`` (``[T, obs_dim]``) — the classic "enjoy script"
+    artifact (SURVEY.md L6).
     """
     from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
         Checkpointer,
@@ -158,10 +165,53 @@ def evaluate_checkpoint(
         algo, cfg, env.action_space(env_params), state.params, stochastic,
         norm=norm,
     )
-    mean_ret, per_env, frac = jax.jit(
+    record = render_dir is not None
+    out = jax.jit(
         lambda key: common.evaluate(
             env, env_params, act, key,
-            num_envs=num_envs, max_steps=max_steps,
+            num_envs=num_envs, max_steps=max_steps, record=record,
         )
     )(jax.random.PRNGKey(seed))
+    if record:
+        mean_ret, per_env, frac, (frames, done_before) = out
+        _write_episode(
+            render_dir, np.asarray(frames), np.asarray(done_before)
+        )
+    else:
+        mean_ret, per_env, frac = out
     return float(mean_ret), np.asarray(per_env), float(frac)
+
+
+def _write_episode(render_dir: str, frames: np.ndarray, done_before: np.ndarray) -> None:
+    """Trim to env 0's first episode and write gif (images) or npy."""
+    import os
+
+    os.makedirs(render_dir, exist_ok=True)
+    # done_before[t] == 1 once the episode has ALREADY finished.
+    alive = done_before < 0.5
+    frames = frames[alive]
+    if frames.ndim == 4 and frames.shape[1] >= 16 and frames.shape[2] >= 16:
+        try:
+            from PIL import Image
+        except ImportError:
+            np.save(os.path.join(render_dir, "episode.npy"), frames)
+            print(f"[eval] wrote {render_dir}/episode.npy (no PIL)")
+            return
+        imgs = []
+        for f in frames:
+            newest = f[..., -1]
+            if newest.dtype != np.uint8:
+                newest = np.clip(newest * 255.0, 0, 255).astype(np.uint8)
+            img = Image.fromarray(newest, mode="L")
+            imgs.append(
+                img.resize((img.width * 3, img.height * 3), Image.NEAREST)
+            )
+        path = os.path.join(render_dir, "episode.gif")
+        imgs[0].save(
+            path, save_all=True, append_images=imgs[1:], duration=30, loop=0
+        )
+        print(f"[eval] wrote {path} ({len(imgs)} frames)")
+    else:
+        path = os.path.join(render_dir, "episode.npy")
+        np.save(path, frames)
+        print(f"[eval] wrote {path} {frames.shape}")

@@ -245,6 +245,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="max env steps per eval episode")
     p.add_argument("--stochastic", action="store_true",
                    help="sample the policy during --eval (default: greedy)")
+    p.add_argument("--render-dir", default=None,
+                   help="with --eval: record env 0's first episode here "
+                        "(episode.gif for image envs, episode.npy for "
+                        "vector envs)")
     p.add_argument("--platform", default=None, metavar="NAME",
                    help="jax platform to run on (e.g. cpu, tpu). Applied "
                         "via jax.config before first backend use, so it "
@@ -365,6 +369,8 @@ def _finalize_checkpointer(checkpointer, env_steps: int, state) -> None:
 
 
 def _run(args, algo, cfg, writer) -> int:
+    if args.render_dir and not args.eval:
+        raise SystemExit("--render-dir requires --eval")
     if args.eval:
         if not args.checkpoint_dir:
             raise SystemExit("--eval requires --checkpoint-dir")
@@ -378,6 +384,7 @@ def _run(args, algo, cfg, writer) -> int:
             max_steps=args.eval_steps,
             stochastic=args.stochastic,
             seed=args.seed if args.seed is not None else 1234,
+            render_dir=args.render_dir,
         )
         print(
             f"[eval] avg_return={mean_ret:.2f} "

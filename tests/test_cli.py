@@ -95,6 +95,28 @@ def test_cli_train_then_eval_roundtrip(tmp_path, capsys):
     ) == 0
 
 
+def test_cli_eval_render_writes_episode_artifact(tmp_path, capsys):
+    # The "enjoy script" artifact: vector envs record episode.npy
+    # (image envs write episode.gif via the same path).
+    common = [
+        "--algo", "a2c", "--env", "CartPole-v1",
+        "--set", "num_envs=8", "--set", "rollout_length=8",
+        "--checkpoint-dir", str(tmp_path / "ck"),
+    ]
+    assert cli.main(common + ["--total-steps", "512"]) == 0
+    render = tmp_path / "render"
+    assert cli.main(
+        common + ["--eval", "--eval-envs", "4", "--eval-steps", "48",
+                  "--render-dir", str(render)]
+    ) == 0
+    import numpy as np
+
+    ep = np.load(render / "episode.npy")
+    assert ep.ndim == 2 and ep.shape[1] == 4 and 1 <= ep.shape[0] <= 48
+    out = capsys.readouterr().out
+    assert "episode.npy" in out
+
+
 def test_cli_eval_requires_checkpoint_dir():
     with pytest.raises(SystemExit, match="requires --checkpoint-dir"):
         cli.main(["--algo", "a2c", "--eval"])
