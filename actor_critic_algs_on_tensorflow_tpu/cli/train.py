@@ -126,9 +126,13 @@ PRESETS = {
     ),
     # 6. PPO on the second Atari-class on-device task (Breakout-style
     # brick wall, 4 actions, 5 lives) — the shared large-batch schedule
-    # but with the 4-epoch/lr-1e-3 update it was validated at
-    # (avg_return 88 by 4M steps; the 2-epoch Pong schedule reaches
-    # only ~48 there).
+    # with the 4-epoch/lr-1e-3 update. r2 full-budget measurement
+    # (seed 0): avg_return 8.5 @ 2.6M -> 119 @ 13M -> 163 at the 25M
+    # budget, ~145-165k steps/s. (The r1 note "88 by 4M" did not
+    # reproduce on r1's own code at seed 0 — r2 re-verified bit-equal
+    # losses across both trees — and is superseded by this curve. The
+    # 2-epoch Pong schedule and the whole-batch mb=1 schedule both
+    # learn far worse here; see PERF.md ledger.)
     "ppo-breakout": (
         "ppo",
         {
