@@ -193,7 +193,13 @@ def run_host_async(
         )
         inserted = int(replay.size)
 
-    noise = jax.device_put(parts.noise_init(cfg.num_envs), cpu)
+    if initial_state is not None:
+        # Resume the exploration carry (OU state / PRNG-noise carry)
+        # from the checkpoint so async resume matches the fused loop's
+        # semantics; only the host env simulator itself re-seeds.
+        noise = jax.device_put(initial_state.noise, cpu)
+    else:
+        noise = jax.device_put(parts.noise_init(cfg.num_envs), cpu)
     # The acting snapshot transfers ONLY the pieces acting reads
     # (actor + warmup scalars), refreshed every ``snapshot_interval``
     # iterations: on a tunneled accelerator the device->host hop is
@@ -279,13 +285,20 @@ def run_host_async(
         #    finished — the loop's only accelerator sync point).
         env_dt = time.perf_counter() - env_t0
         if snap_interval_eff <= 1 or (it_off % snap_interval_eff) == 0:
-            xfer_t0 = time.perf_counter()
+            sync_t0 = time.perf_counter()
             acting_params = jax.device_put(parts.acting_slice(params), cpu)
             jax.block_until_ready(acting_params)
-            xfer_dt = time.perf_counter() - xfer_t0
+            # Total SYNC time, deliberately including update completion
+            # (the transfer queues behind the dispatched update, and on
+            # the axon backend blocking on device arrays is a no-op so
+            # the two cannot be separated): each snapshot refresh stalls
+            # the host loop by this full amount, so cadence backs off
+            # whenever the sync point is expensive for ANY reason —
+            # slow transfer or slow updates alike.
+            sync_dt = time.perf_counter() - sync_t0
             if snapshot_interval == 0 and env_dt > 0:
                 snap_interval_eff = int(
-                    np.clip(np.ceil(xfer_dt / (env_dt / 3.0)), 1, 16)
+                    np.clip(np.ceil(sync_dt / (env_dt / 3.0)), 1, 16)
                 )
 
         if it_off == 0:

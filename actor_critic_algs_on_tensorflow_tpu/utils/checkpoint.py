@@ -49,6 +49,13 @@ class Checkpointer:
 
         ``example_state`` may be a concrete state (e.g. ``fns.init(key)``)
         whose shardings the restored arrays adopt.
+
+        Forward-compatible with checkpoints that predate fields added
+        to the state later (e.g. TD3's ``opt_state["updates_done"]``
+        counter, added after its first shipped format): when the strict
+        template restore fails on a structure mismatch, the raw saved
+        tree is grafted onto ``example_state`` and any leaf the
+        checkpoint lacks keeps the template's (init) value.
         """
         if step is None:
             step = self._mgr.latest_step()
@@ -57,7 +64,13 @@ class Checkpointer:
         abstract = jax.tree_util.tree_map(
             ocp.utils.to_shape_dtype_struct, example_state
         )
-        return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+        try:
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract)
+            )
+        except (ValueError, KeyError, TypeError) as strict_err:
+            raw = self._mgr.restore(step)
+            return _graft(example_state, raw, strict_err)
 
     def wait(self) -> None:
         """Block until async saves are durable (call before exit)."""
@@ -65,3 +78,85 @@ class Checkpointer:
 
     def close(self) -> None:
         self._mgr.close()
+
+
+def _graft(example_state: Any, raw: Any, strict_err: Exception) -> Any:
+    """Overlay ``raw`` (orbax's template-free nested-dict restore) onto
+    ``example_state``'s structure. STRICTLY a field-addition migration:
+    leaves absent from the checkpoint keep the template value (warned,
+    by path); a present leaf whose shape or dtype disagrees with the
+    template, or saved leaves the template never consumes (a rename's
+    orphaned old key), re-raise the strict restore error instead of
+    restoring silently-wrong state."""
+    import warnings
+
+    def lookup(node, path):
+        for p in path:
+            if isinstance(p, jax.tree_util.GetAttrKey):
+                key: Any = p.name
+            elif isinstance(p, jax.tree_util.DictKey):
+                key = p.key
+            elif isinstance(p, jax.tree_util.SequenceKey):
+                key = p.idx
+            else:  # FlattenedIndexKey and friends
+                key = getattr(p, "key")
+            if isinstance(node, dict):
+                node = node[key if key in node else str(key)]
+            else:
+                node = node[int(key)]
+        return node
+
+    defaulted: list = []
+    consumed = 0
+
+    def pick(path, example_leaf):
+        nonlocal consumed
+        try:
+            saved = lookup(raw, path)
+        except (KeyError, IndexError, TypeError, ValueError):
+            defaulted.append(jax.tree_util.keystr(path))
+            return example_leaf  # field the checkpoint predates
+        consumed += 1
+        if isinstance(example_leaf, jax.Array):
+            try:
+                arr = jax.numpy.asarray(saved)
+            except (TypeError, ValueError) as exc:
+                # e.g. the checkpoint holds a subtree where the template
+                # has an array leaf: a structural retype, not an addition.
+                raise ValueError(
+                    f"checkpoint migration: {jax.tree_util.keystr(path)} is "
+                    f"not an array in the checkpoint ({type(saved).__name__})"
+                    f" — not a field addition; strict error: {strict_err!r}"
+                ) from exc
+            if (
+                arr.shape != example_leaf.shape
+                or arr.dtype != example_leaf.dtype
+            ):
+                raise ValueError(
+                    f"checkpoint migration: {jax.tree_util.keystr(path)} is "
+                    f"{arr.shape}/{arr.dtype} in the checkpoint but "
+                    f"{example_leaf.shape}/{example_leaf.dtype} in the "
+                    f"template — not a field addition; strict error: "
+                    f"{strict_err!r}"
+                ) from strict_err
+            return jax.device_put(arr, example_leaf.sharding)
+        return saved
+
+    out = jax.tree_util.tree_map_with_path(pick, example_state)
+    n_saved = len(jax.tree_util.tree_leaves(raw))
+    if not defaulted or consumed != n_saved:
+        # Not a pure field addition (e.g. a rename leaves an orphaned
+        # saved key, or the structures differ some other way): the
+        # strict failure stands.
+        raise ValueError(
+            f"checkpoint does not match the template and the mismatch is "
+            f"not a pure field addition ({len(defaulted)} template leaves "
+            f"missing from the checkpoint, {n_saved - consumed} saved "
+            f"leaves unused)"
+        ) from strict_err
+    warnings.warn(
+        "checkpoint predates these state fields; restored with template "
+        f"(init) values: {', '.join(defaulted)}",
+        stacklevel=3,
+    )
+    return out

@@ -84,3 +84,94 @@ def test_off_policy_checkpoint_includes_replay(tmp_path):
     # Restored state steps onward without error.
     restored, metrics = fns.iteration(restored)
     assert np.isfinite(float(metrics["q_loss"]))
+
+
+def test_restore_tolerates_fields_added_after_save(tmp_path):
+    """A checkpoint saved before a state field existed (e.g. TD3's
+    opt_state["updates_done"], added after its first shipped format)
+    must still restore: saved leaves load, the new field keeps its
+    template (init) value."""
+    from actor_critic_algs_on_tensorflow_tpu.algos import td3
+
+    cfg = td3.TD3Config(
+        num_envs=4,
+        steps_per_iter=2,
+        updates_per_iter=2,
+        replay_capacity=64,
+        batch_size=8,
+        warmup_env_steps=0,
+        hidden_sizes=(8, 8),
+        num_devices=1,
+    )
+    fns = td3.make_td3(cfg)
+    state, _ = fns.iteration(fns.init(jax.random.PRNGKey(0)))
+    jax.block_until_ready(state)
+
+    # Simulate the OLD format: the counter field does not exist.
+    old_opt = dict(state.opt_state)
+    counter = old_opt.pop("updates_done")
+    assert int(counter) > 0
+    old_state = state.replace(opt_state=old_opt)
+
+    ckpt = Checkpointer(tmp_path / "ckpt-old", async_save=False)
+    ckpt.save(1, old_state)
+    ckpt.wait()
+
+    template = fns.init(jax.random.PRNGKey(1))
+    restored = ckpt.restore(template)
+    ckpt.close()
+
+    # New field falls back to the template's init value...
+    assert int(restored.opt_state["updates_done"]) == int(
+        template.opt_state["updates_done"]
+    )
+    # ...while saved leaves come from the checkpoint, not the template.
+    s_leaves = jax.tree_util.tree_leaves(old_state.params)
+    r_leaves = jax.tree_util.tree_leaves(restored.params)
+    for s, r in zip(s_leaves, r_leaves):
+        np.testing.assert_allclose(np.asarray(s), np.asarray(r))
+    assert int(restored.step) == int(state.step)
+
+
+def test_restore_graft_rejects_renames_and_reshapes(tmp_path):
+    """The migration path ONLY tolerates pure field additions: a rename
+    (orphaned saved key) or a shape change must still fail loudly."""
+    from actor_critic_algs_on_tensorflow_tpu.algos import td3
+
+    cfg = td3.TD3Config(
+        num_envs=4,
+        steps_per_iter=2,
+        updates_per_iter=2,
+        replay_capacity=64,
+        batch_size=8,
+        warmup_env_steps=0,
+        hidden_sizes=(8, 8),
+        num_devices=1,
+    )
+    fns = td3.make_td3(cfg)
+    state, _ = fns.iteration(fns.init(jax.random.PRNGKey(0)))
+    jax.block_until_ready(state)
+    template = fns.init(jax.random.PRNGKey(1))
+
+    # Rename: counter saved under an old name -> orphaned saved leaf.
+    renamed_opt = dict(state.opt_state)
+    renamed_opt["n_updates"] = renamed_opt.pop("updates_done")
+    ckpt = Checkpointer(tmp_path / "renamed", async_save=False)
+    ckpt.save(1, state.replace(opt_state=renamed_opt))
+    ckpt.wait()
+    with pytest.raises(ValueError, match="not a pure field addition"):
+        ckpt.restore(template)
+    ckpt.close()
+
+    # Shape change on a present leaf (old replay capacity).
+    old_opt = dict(state.opt_state)
+    old_opt.pop("updates_done")
+    small_replay = jax.tree_util.tree_map(
+        lambda x: x[:, :32] if x.ndim >= 2 else x, state.replay
+    )
+    ckpt2 = Checkpointer(tmp_path / "reshaped", async_save=False)
+    ckpt2.save(1, state.replace(opt_state=old_opt, replay=small_replay))
+    ckpt2.wait()
+    with pytest.raises(ValueError, match="checkpoint migration|not a pure"):
+        ckpt2.restore(template)
+    ckpt2.close()

@@ -112,3 +112,39 @@ def test_host_async_rejects_on_device_envs():
         host_async.run_host_async(
             fns, total_env_steps=100, log_fn=lambda s, m: None
         )
+
+
+def test_host_async_resume_restores_noise_carry():
+    """Async resume keeps the checkpointed exploration carry (DDPG's OU
+    state) instead of re-initializing it — matching the fused loop's
+    resume semantics; only the host env simulator re-seeds."""
+    cfg = _tiny(ddpg.DDPGConfig)
+    fns = ddpg.make_ddpg(cfg)
+    state, _ = host_async.run_host_async(
+        fns,
+        total_env_steps=cfg.total_env_steps,
+        seed=0,
+        log_interval_iters=100,
+        log_fn=lambda s, m: None,
+    )
+    noise0 = np.asarray(state.noise)
+    assert np.any(noise0 != 0.0), "OU carry never moved"
+
+    seen = {}
+    orig_init = fns.parts.noise_init
+
+    def spying_init(n):
+        seen["called"] = True
+        return orig_init(n)
+
+    fns2 = fns._replace(parts=fns.parts._replace(noise_init=spying_init))
+    state2, _ = host_async.run_host_async(
+        fns2,
+        total_env_steps=cfg.total_env_steps + 4 * 4,
+        seed=0,
+        log_interval_iters=1,
+        log_fn=lambda s, m: None,
+        initial_state=state,
+    )
+    assert "called" not in seen, "resume re-initialized the noise carry"
+    assert int(state2.step) > int(state.step)
