@@ -71,6 +71,14 @@ class PPOConfig:
     max_grad_norm: float = 0.5
     num_epochs: int = 4
     num_minibatches: int = 4
+    # Whole-batch epochs only (num_minibatches=1): accumulate the epoch
+    # gradient over this many CONTIGUOUS rollout slices instead of one
+    # giant forward/backward. No shuffle, no gather, and advantage
+    # normalization runs over the full batch first, so the summed
+    # gradient is mathematically the whole-batch gradient — but peak
+    # activation memory drops by the accumulation factor (lets 2048-env
+    # whole-batch schedules fit where the single pass OOMs).
+    grad_accum: int = 1
     normalize_adv: bool = True
     # Running mean/std observation normalization (vector obs only) —
     # the VecNormalize-style statistics live in state.extra, frozen
@@ -102,6 +110,19 @@ def make_ppo(cfg: PPOConfig) -> common.IterationFns:
             f"local batch {local_batch} not divisible by "
             f"{cfg.num_minibatches} minibatches"
         )
+    if cfg.grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {cfg.grad_accum}")
+    if cfg.grad_accum > 1:
+        if cfg.num_minibatches != 1:
+            raise ValueError(
+                "grad_accum accumulates whole-batch epochs; it requires "
+                f"num_minibatches=1 (got {cfg.num_minibatches})"
+            )
+        if local_batch % cfg.grad_accum:
+            raise ValueError(
+                f"local batch {local_batch} not divisible by "
+                f"grad_accum={cfg.grad_accum}"
+            )
     common.check_host_env_topology(cfg.env, n_dev)
     env, env_params = envs_lib.make(
         cfg.env, num_envs=local_envs, frame_stack=cfg.frame_stack
@@ -242,11 +263,10 @@ def make_ppo(cfg: PPOConfig) -> common.IterationFns:
             def minibatch_obs(idx):
                 return jnp.take(obs_flat, idx, axis=0)
 
-        def minibatch_update(carry, mb):
-            params, opt_state = carry
-            adv = mb["advantages"]
-            if cfg.normalize_adv:
-                adv = common.global_normalize_advantages(adv)
+        def batch_grads(params, mb, adv):
+            """PPO loss value+grad on ``mb`` with advantages ``adv``
+            (normalization is the CALLER's job: per-minibatch for the
+            minibatch path, whole-batch for accumulation)."""
 
             def loss_fn(p):
                 dist, values = dist_and_value(p, norm(mb["obs"]))
@@ -270,9 +290,6 @@ def make_ppo(cfg: PPOConfig) -> common.IterationFns:
             (loss, (stats, vf, ent)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(params)
-            grads = jax.lax.pmean(grads, DATA_AXIS)
-            updates, opt_state = tx.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
             m = {
                 "loss": loss,
                 "policy_loss": stats.policy_loss,
@@ -281,12 +298,66 @@ def make_ppo(cfg: PPOConfig) -> common.IterationFns:
                 "clip_fraction": stats.clip_fraction,
                 "approx_kl": stats.approx_kl,
             }
+            return grads, m
+
+        def apply_grads(params, opt_state, grads):
+            grads = jax.lax.pmean(grads, DATA_AXIS)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        def minibatch_update(carry, mb):
+            params, opt_state = carry
+            adv = mb["advantages"]
+            if cfg.normalize_adv:
+                adv = common.global_normalize_advantages(adv)
+            grads, m = batch_grads(params, mb, adv)
+            params, opt_state = apply_grads(params, opt_state, grads)
             return (params, opt_state), m
 
         def minibatch_step(carry, idx):
             mb = take_minibatch(batch, idx)
             mb["obs"] = minibatch_obs(idx)
             return minibatch_update(carry, mb)
+
+        def accum_epoch_update(carry):
+            """Whole-batch epoch as ``grad_accum`` CONTIGUOUS slices:
+            advantages normalized over the FULL batch first, per-slice
+            gradients accumulated, ONE optimizer step — the mean of
+            equal-size slice gradients IS the whole-batch gradient, but
+            peak activation memory shrinks by the accumulation factor.
+            No permutation, so no shuffle gather (contiguous reshape)."""
+            params, opt_state = carry
+            adv = batch["advantages"]
+            if cfg.normalize_adv:
+                adv = common.global_normalize_advantages(adv)
+            n_acc = cfg.grad_accum
+            resh = lambda x: x.reshape((n_acc, -1) + x.shape[1:])
+            sliced = {k: resh(v) for k, v in batch.items()}
+            sliced["advantages"] = resh(adv)
+            if cfg.compact_frames:
+                obs_xs = jnp.arange(local_batch).reshape(n_acc, -1)
+                get_obs = minibatch_obs
+            else:
+                obs_xs = resh(obs_flat)
+                get_obs = lambda o: o
+
+            def slice_step(gacc, xs):
+                mb, obs_x = xs
+                mb = dict(mb)
+                mb["obs"] = get_obs(obs_x)
+                grads, m = batch_grads(params, mb, mb["advantages"])
+                gacc = jax.tree_util.tree_map(jnp.add, gacc, grads)
+                return gacc, m
+
+            gacc, ms = jax.lax.scan(
+                slice_step,
+                jax.tree_util.tree_map(jnp.zeros_like, params),
+                (sliced, obs_xs),
+            )
+            grads = jax.tree_util.tree_map(lambda g: g / n_acc, gacc)
+            params, opt_state = apply_grads(params, opt_state, grads)
+            m = jax.tree_util.tree_map(jnp.mean, ms)
+            return (params, opt_state), m
 
         def epoch_step(carry, k):
             if cfg.num_minibatches == 1:
@@ -295,12 +366,15 @@ def make_ppo(cfg: PPOConfig) -> common.IterationFns:
                 # random gather (a pure HBM-bandwidth tax at this
                 # scale; the obs buffer alone is ~3.7 GB at 1024
                 # envs x 128 steps).
-                mb = dict(batch)
-                if cfg.compact_frames:
-                    mb["obs"] = minibatch_obs(jnp.arange(local_batch))
+                if cfg.grad_accum > 1:
+                    carry, m = accum_epoch_update(carry)
                 else:
-                    mb["obs"] = obs_flat
-                carry, m = minibatch_update(carry, mb)
+                    mb = dict(batch)
+                    if cfg.compact_frames:
+                        mb["obs"] = minibatch_obs(jnp.arange(local_batch))
+                    else:
+                        mb["obs"] = obs_flat
+                    carry, m = minibatch_update(carry, mb)
                 return carry, jax.tree_util.tree_map(lambda x: x[None], m)
             idx = minibatch_iter_indices(k, local_batch, cfg.num_minibatches)
             return jax.lax.scan(minibatch_step, carry, idx)

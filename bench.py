@@ -57,6 +57,8 @@ def measure(num_envs: int, rollout: int, timed_iters: int) -> float:
         # budget (~20 at the full budget in ~67 s on one v5e chip).
         num_epochs=int(os.environ.get("BENCH_EPOCHS", 2)),
         num_minibatches=int(os.environ.get("BENCH_MINIBATCHES", 1)),
+        grad_accum=int(os.environ.get("BENCH_GRAD_ACCUM", 1)),
+        compact_frames=bool(int(os.environ.get("BENCH_COMPACT", 0))),
         time_limit_bootstrap=False,
         compute_dtype="bfloat16",
         num_devices=n_dev,

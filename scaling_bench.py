@@ -45,13 +45,19 @@ def measure(
         total_env_steps=10**9,
         num_devices=devs,
     )
-    fns = make_a2c(cfg)
+    return _timed_best(make_a2c(cfg), iters)
+
+
+def _timed_best(fns, iters: int) -> float:
+    """Warmup (compile + 1 iteration, sync-closed) then best-of-R timed
+    windows: small iterations are dispatch- and tunnel-latency-bound, so
+    a single window is hostage to transient host/tunnel hiccups; the
+    max over windows is the chip's capability. Every window ends with a
+    REAL host fetch (``sync``) because block_until_ready does not block
+    on the tunneled axon backend."""
     state = fns.init(jax.random.PRNGKey(0))
     state, metrics = fns.iteration(state)
     sync(metrics)
-    # Best-of-R timed windows: the small A2C iteration is dispatch- and
-    # tunnel-latency-bound, so a single window is hostage to transient
-    # host/tunnel hiccups; the max over windows is the chip's capability.
     repeats = max(1, int(os.environ.get("SCALE_REPEATS", 3)))
     best = 0.0
     for _ in range(repeats):
@@ -64,10 +70,38 @@ def measure(
     return best
 
 
+def measure_ppo(
+    num_envs: int, rollout: int, iters: int, num_devices: int
+) -> float:
+    """The headline PPO Atari-class workload (Nature-CNN over PongTPU,
+    whole-batch epochs) at tiny shapes, for mesh-overhead measurement."""
+    from actor_critic_algs_on_tensorflow_tpu.algos.ppo import (
+        PPOConfig,
+        make_ppo,
+    )
+
+    cfg = PPOConfig(
+        env="PongTPU-v0",
+        num_envs=num_envs,
+        rollout_length=rollout,
+        total_env_steps=10**9,
+        frame_stack=4,
+        torso="nature_cnn",
+        num_epochs=2,
+        num_minibatches=1,
+        lr_decay=False,
+        time_limit_bootstrap=False,
+        num_devices=num_devices,
+    )
+    return _timed_best(make_ppo(cfg), iters)
+
+
 def main_devices():
     """``SCALE_MODE=devices``: weak-scaling sweep over mesh widths
     1..8 with FIXED per-device envs — the DP-mesh counterpart of the
-    actor sweep (VERDICT r1 weak#7/next#9).
+    actor sweep (VERDICT r1 weak#7/next#9), for BOTH the A2C scaling
+    workload and the headline PPO Atari-class workload (VERDICT r2
+    next#7).
 
     Runs on the virtual 8-device CPU mesh (self-provisioned the way
     tests/conftest.py does). All virtual devices share this host's
@@ -78,31 +112,47 @@ def main_devices():
     all-reduce) adds no overhead beyond the inherent compute, which is
     what transfers to real chips where the compute truly parallelizes.
     """
-    rollout = int(os.environ.get("SCALE_ROLLOUT", 32))
-    iters = int(os.environ.get("SCALE_ITERS", 20))
-    envs_per_dev = int(os.environ.get("SCALE_ENVS_PER_DEV", 32))
     widths = [int(c) for c in os.environ.get(
         "SCALE_DEVICES", "1,2,4,8"
     ).split(",")]
-    results = []
-    base = None
-    for d in widths:
-        sps = measure(d * envs_per_dev, rollout, iters, num_devices=d)
-        if base is None:
-            base = sps
-        results.append({
-            "devices": d,
-            "envs": d * envs_per_dev,
-            "steps_per_sec": round(sps, 1),
-            "adjusted_efficiency_vs_1dev": round(sps / base, 3),
-        })
-        print(json.dumps(results[-1]), flush=True)
-    print(json.dumps({
-        "metric": "a2c_dp_mesh_adjusted_efficiency_1_to_8_devices",
-        "value": results[-1]["adjusted_efficiency_vs_1dev"],
-        "unit": "fraction-of-ideal",
-        "points": results,
-    }))
+    workloads = os.environ.get("SCALE_WORKLOADS", "a2c,ppo").split(",")
+    for workload in workloads:
+        if workload == "a2c":
+            rollout = int(os.environ.get("SCALE_ROLLOUT", 32))
+            iters = int(os.environ.get("SCALE_ITERS", 20))
+            envs_per_dev = int(os.environ.get("SCALE_ENVS_PER_DEV", 32))
+            fn = measure
+        elif workload == "ppo":
+            # CNN fwd+bwd on shared host cores: keep shapes tiny so the
+            # full sweep stays in CI-able wall-clock.
+            rollout = int(os.environ.get("SCALE_PPO_ROLLOUT", 16))
+            iters = int(os.environ.get("SCALE_PPO_ITERS", 5))
+            envs_per_dev = int(os.environ.get("SCALE_PPO_ENVS_PER_DEV", 8))
+            fn = measure_ppo
+        else:
+            raise SystemExit(f"unknown SCALE_WORKLOADS entry {workload!r}")
+        results = []
+        base = None
+        for d in widths:
+            sps = fn(d * envs_per_dev, rollout, iters, num_devices=d)
+            if base is None:
+                base = sps
+            results.append({
+                "workload": workload,
+                "devices": d,
+                "envs": d * envs_per_dev,
+                "steps_per_sec": round(sps, 1),
+                "adjusted_efficiency_vs_1dev": round(sps / base, 3),
+            })
+            print(json.dumps(results[-1]), flush=True)
+        print(json.dumps({
+            "metric": (
+                f"{workload}_dp_mesh_adjusted_efficiency_1_to_8_devices"
+            ),
+            "value": results[-1]["adjusted_efficiency_vs_1dev"],
+            "unit": "fraction-of-ideal",
+            "points": results,
+        }), flush=True)
     return 0
 
 
