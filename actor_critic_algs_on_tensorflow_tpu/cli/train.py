@@ -125,20 +125,26 @@ PRESETS = {
         {"env": "CartPole-v1", "num_actors": 8, "total_env_steps": 1_000_000},
     ),
     # 6. PPO on the second Atari-class on-device task (Breakout-style
-    # brick wall, 4 actions, 5 lives) — the shared large-batch schedule
-    # with the 4-epoch/lr-1e-3 update. r2 full-budget measurement
-    # (seed 0): avg_return 8.5 @ 2.6M -> 119 @ 13M -> 163 at the 25M
-    # budget, ~145-165k steps/s. (The r1 note "88 by 4M" did not
-    # reproduce on r1's own code at seed 0 — r2 re-verified bit-equal
-    # losses across both trees — and is superseded by this curve. The
-    # 2-epoch Pong schedule and the whole-batch mb=1 schedule both
-    # learn far worse here; see PERF.md ledger.)
+    # brick wall, 4 actions, 5 lives). r3 schedule sweep (17 probes at
+    # 4.2M steps, PERF.md "ppo-breakout schedule frontier"): breakout
+    # rewards UPDATE COUNT — returns rise monotonically from mb=1
+    # (collapse) through mb=4 (preset was 29.8) to a peak at mb=16
+    # (50.5), falling slightly at mb=32/64 (~46); lr 1e-3 beats 5e-4,
+    # 1.5e-3, 2e-3, 3e-3 at every minibatch count tried, and extra
+    # entropy (0.02) or epochs (6) only hurt. The 16-minibatch epoch
+    # costs no throughput at this batch size (~156k steps/s either
+    # way). Full 25M budget (seed 0): avg_return 163 was the OLD mb=4
+    # curve's endpoint; the shipped mb=16 schedule's curve is in
+    # PERF.md. (The r1 note "88 by 4M" did not reproduce and was
+    # corrected in r2; whole-batch mb=1 entropy-collapses here — the
+    # brick-wall task is the anti-Pong, see PERF.md ledger.)
     "ppo-breakout": (
         "ppo",
         {
             "env": "BreakoutTPU-v0",
             **_PPO_ATARI_SCHEDULE,
             "num_epochs": 4,
+            "num_minibatches": 16,
             "lr": 1e-3,
         },
     ),
