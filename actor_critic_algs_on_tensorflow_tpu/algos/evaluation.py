@@ -80,7 +80,7 @@ def _act_fn(algo: str, cfg, aspace, params, stochastic: bool, norm=None):
         scale = float(aspace.high)
 
         def act(obs, key):
-            mean, log_std = actor.apply(params.actor, obs)
+            mean, log_std = actor.apply(params.actor, norm(obs))
             if stochastic:
                 return TanhGaussian(mean, log_std).sample(key) * scale
             return jnp.tanh(mean) * scale
@@ -159,7 +159,13 @@ def evaluate_checkpoint(
     )
     norm = None
     if getattr(cfg, "normalize_obs", False):
-        rms = state.extra
+        # PPO keeps the running stats in state.extra; SAC in
+        # params.obs_rms (the off-policy state has no extra slot).
+        rms = (
+            state.params.obs_rms
+            if algo == "sac"
+            else state.extra
+        )
         norm = lambda o: rms_normalize(o, rms)
     act = _act_fn(
         algo, cfg, env.action_space(env_params), state.params, stochastic,

@@ -28,7 +28,13 @@ from actor_critic_algs_on_tensorflow_tpu.models import (
     SquashedGaussianActor,
     TwinQCritic,
 )
-from actor_critic_algs_on_tensorflow_tpu.ops import TanhGaussian, polyak_update
+from actor_critic_algs_on_tensorflow_tpu.ops import (
+    TanhGaussian,
+    polyak_update,
+    rms_init,
+    rms_normalize,
+    rms_update,
+)
 from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import DATA_AXIS
 from actor_critic_algs_on_tensorflow_tpu.utils import prng
 
@@ -52,6 +58,11 @@ class SACConfig:
     target_entropy_scale: float = 1.0
     gamma: float = 0.99
     tau: float = 0.005
+    # Running mean/std observation normalization (vector obs). Stats
+    # live in params.obs_rms, fold in the sampled batch each update
+    # (uniform replay over recent data ≈ the visitation distribution),
+    # and apply at BOTH acting and update time; replay stores raw obs.
+    normalize_obs: bool = False
     seed: int = 0
     num_devices: int = 0
 
@@ -62,6 +73,11 @@ class SACParams:
     critic: any
     target_critic: any
     log_alpha: jax.Array
+    # RunningMeanStd when cfg.normalize_obs, else () (leafless, so the
+    # checkpoint layout of normalize-free configs is unchanged). Not a
+    # gradient path: optimizers are built per-subtree (actor/critic/
+    # log_alpha) and never see this field.
+    obs_rms: any = ()
 
 
 def make_sac(cfg: SACConfig) -> offpolicy.OffPolicyFns:
@@ -75,17 +91,28 @@ def make_sac(cfg: SACConfig) -> offpolicy.OffPolicyFns:
     critic_tx = offpolicy.make_adam(cfg.critic_lr)
     alpha_tx = offpolicy.make_adam(cfg.alpha_lr)
 
-    def act_with(actor_params, obs, noise, key, step):
-        """Stochastic squashed-Gaussian acting; uniform during warmup."""
+    def norm_with(obs_rms, obs):
+        if not cfg.normalize_obs:
+            return obs
+        return rms_normalize(obs, obs_rms)
+
+    def act_with(acting_params, obs, noise, key, step):
+        """Stochastic squashed-Gaussian acting; uniform during warmup.
+
+        ``acting_params`` is ``acting_slice(params)``: (actor, obs_rms).
+        """
+        actor_params, obs_rms = acting_params
         k_sample, k_rand = jax.random.split(key)
-        mean, log_std = actor.apply(actor_params, obs)
+        mean, log_std = actor.apply(actor_params, norm_with(obs_rms, obs))
         a = TanhGaussian(mean, log_std).sample(k_sample)
         rand = jax.random.uniform(k_rand, a.shape, a.dtype, -1.0, 1.0)
         a = jnp.where(step < s.warmup_iters, rand, a)
         return a * s.action_scale, noise
 
     def act_fn(params, obs, noise, key, step):
-        return act_with(params.actor, obs, noise, key, step)
+        return act_with(
+            (params.actor, params.obs_rms), obs, noise, key, step
+        )
 
     def init_params(key: jax.Array, obs_example):
         k_actor, k_critic = jax.random.split(key)
@@ -94,12 +121,21 @@ def make_sac(cfg: SACConfig) -> offpolicy.OffPolicyFns:
             k_critic, obs_example, jnp.zeros((1, s.action_dim))
         )
         log_alpha = jnp.log(jnp.asarray(cfg.init_alpha, jnp.float32))
+        if cfg.normalize_obs:
+            if len(obs_example.shape) != 2:
+                raise ValueError(
+                    "normalize_obs supports vector observations only"
+                )
+            obs_rms = rms_init(obs_example.shape[1:])
+        else:
+            obs_rms = ()
         params = SACParams(
             actor=actor_params,
             critic=critic_params,
             # Copy: donated state must not alias online/target buffers.
             target_critic=jax.tree_util.tree_map(jnp.copy, critic_params),
             log_alpha=log_alpha,
+            obs_rms=obs_rms,
         )
         opt_state = {
             "actor": actor_tx.init(actor_params),
@@ -125,7 +161,15 @@ def make_sac(cfg: SACConfig) -> offpolicy.OffPolicyFns:
     def one_update(replay, carry, key):
         params, opt_state = carry
         k_batch, k_next, k_pi = jax.random.split(key, 3)
-        batch = s.buf.sample(replay, k_batch, cfg.batch_size)
+        raw_batch = s.buf.sample(replay, k_batch, cfg.batch_size)
+        # Replay stores RAW obs; normalize the sampled views with the
+        # PRE-update stats (no gradient path: the loss closures
+        # differentiate w.r.t. actor/critic subtrees only), then fold
+        # this batch into the stats for the next update.
+        batch = raw_batch._replace(
+            obs=norm_with(params.obs_rms, raw_batch.obs),
+            next_obs=norm_with(params.obs_rms, raw_batch.next_obs),
+        )
         alpha = jnp.exp(params.log_alpha)
 
         def critic_loss_fn(cp):
@@ -192,6 +236,13 @@ def make_sac(cfg: SACConfig) -> offpolicy.OffPolicyFns:
                 params.target_critic, params.critic, cfg.tau
             ),
             log_alpha=optax.apply_updates(params.log_alpha, al_up),
+            obs_rms=(
+                rms_update(
+                    params.obs_rms, raw_batch.obs, axis_name=DATA_AXIS
+                )
+                if cfg.normalize_obs
+                else params.obs_rms
+            ),
         )
         m = {
             "q_loss": q_loss,
@@ -247,7 +298,7 @@ def make_sac(cfg: SACConfig) -> offpolicy.OffPolicyFns:
         init_params=init_params,
         noise_init=lambda n: jnp.zeros((n,)),
         noise_reset=None,
-        acting_slice=lambda params: params.actor,
+        acting_slice=lambda params: (params.actor, params.obs_rms),
         act_with=act_with,
     )
     return offpolicy.build_fns(s, init, local_iteration, parts=parts)

@@ -378,6 +378,24 @@ def _finalize_checkpointer(checkpointer, env_steps: int, state) -> None:
     checkpointer.close()
 
 
+def format_return_hist(per_env) -> str | None:
+    """Per-episode return distribution line, when compact enough to be
+    readable (integer-valued scores like Pong's -21..21): the evidence
+    format PERF.md's reward-21 analysis uses. None for float-valued or
+    high-cardinality returns."""
+    import collections
+
+    rounded = per_env.round().astype(int)
+    if not (abs(per_env - rounded) < 1e-6).all():
+        return None
+    hist = collections.Counter(rounded.tolist())
+    if len(hist) > 32:
+        return None
+    return "[eval] return_hist " + " ".join(
+        f"{k}:{v}" for k, v in sorted(hist.items())
+    )
+
+
 def _run(args, algo, cfg, writer) -> int:
     if args.render_dir and not args.eval:
         raise SystemExit("--render-dir requires --eval")
@@ -401,6 +419,11 @@ def _run(args, algo, cfg, writer) -> int:
             f"min={per_env.min():.2f} max={per_env.max():.2f} "
             f"episodes_finished={frac * args.eval_envs:.0f}/{args.eval_envs}"
         )
+        # Unfinished episodes report return 0 and would pollute the
+        # distribution, so the hist only prints for complete evals.
+        hist_line = format_return_hist(per_env) if frac >= 1.0 else None
+        if hist_line:
+            print(hist_line)
         return 0
 
     if algo == "impala":

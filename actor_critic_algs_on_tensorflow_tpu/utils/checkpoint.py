@@ -144,19 +144,24 @@ def _graft(example_state: Any, raw: Any, strict_err: Exception) -> Any:
 
     out = jax.tree_util.tree_map_with_path(pick, example_state)
     n_saved = len(jax.tree_util.tree_leaves(raw))
-    if not defaulted or consumed != n_saved:
-        # Not a pure field addition (e.g. a rename leaves an orphaned
-        # saved key, or the structures differ some other way): the
-        # strict failure stands.
+    if consumed != n_saved:
+        # Saved leaves the template never consumed (a rename's orphaned
+        # old key, or otherwise diverged structures): the strict
+        # failure stands. Note a rename ALSO defaults the new-name
+        # template leaf, so it cannot masquerade as a field addition.
         raise ValueError(
             f"checkpoint does not match the template and the mismatch is "
             f"not a pure field addition ({len(defaulted)} template leaves "
             f"missing from the checkpoint, {n_saved - consumed} saved "
             f"leaves unused)"
         ) from strict_err
-    warnings.warn(
-        "checkpoint predates these state fields; restored with template "
-        f"(init) values: {', '.join(defaulted)}",
-        stacklevel=3,
-    )
+    if defaulted:
+        warnings.warn(
+            "checkpoint predates these state fields; restored with "
+            f"template (init) values: {', '.join(defaulted)}",
+            stacklevel=3,
+        )
+    # defaulted may be empty for structure-only additions (a new field
+    # holding an EMPTY pytree, e.g. a disabled normalizer slot): every
+    # saved leaf was consumed, so the graft is a faithful restore.
     return out

@@ -199,3 +199,19 @@ def test_cli_td3_train_then_eval(tmp_path, capsys):
     ) == 0
     out = capsys.readouterr().out
     assert "[eval] avg_return=" in out
+
+
+def test_eval_return_hist_formatting():
+    import numpy as np
+
+    from actor_critic_algs_on_tensorflow_tpu.cli.train import (
+        format_return_hist,
+    )
+
+    # Integer-valued, compact: one count per distinct value, sorted.
+    line = format_return_hist(np.asarray([21.0, 19.0, 21.0, 20.0]))
+    assert line == "[eval] return_hist 19:1 20:1 21:2"
+    # Float-valued returns: no hist.
+    assert format_return_hist(np.asarray([-1422.4, -1266.3])) is None
+    # High-cardinality integers: no hist.
+    assert format_return_hist(np.arange(40.0)) is None

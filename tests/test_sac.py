@@ -96,3 +96,46 @@ def test_sac_learns_pendulum():
     )(jax.random.PRNGKey(1))
     assert float(frac_done) == 1.0
     assert float(mean_ret) > -400.0, float(mean_ret)
+
+
+def test_sac_normalize_obs_trains_and_restores_old_format(tmp_path):
+    # Stats live in params.obs_rms, fold in sampled batches, and apply
+    # at acting + update time.
+    fns = sac.make_sac(_cfg(normalize_obs=True, warmup_env_steps=0))
+    state = fns.init(jax.random.PRNGKey(0))
+    # Read BEFORE iterating: the fused iteration donates its input.
+    count0 = float(state.params.obs_rms.count)
+    assert state.params.obs_rms.mean.shape == (3,)  # Pendulum obs dim
+    for _ in range(3):
+        state, metrics = fns.iteration(state)
+    m = {k: float(v) for k, v in metrics.items()}
+    assert np.isfinite(list(m.values())).all(), m
+    assert float(state.params.obs_rms.count) > count0
+    assert float(jnp.abs(state.params.obs_rms.mean).sum()) > 0.0
+
+    # A normalize-free config's params gained only a LEAFLESS () slot,
+    # so checkpoints written before the field existed restore cleanly
+    # (structure-only addition) — the r2 3M Humanoid artifact's layout.
+    from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
+        Checkpointer,
+    )
+
+    fns2 = sac.make_sac(_cfg())
+    state2, _ = fns2.iteration(fns2.init(jax.random.PRNGKey(1)))
+    jax.block_until_ready(state2)
+    old_params = {
+        "actor": state2.params.actor,
+        "critic": state2.params.critic,
+        "target_critic": state2.params.target_critic,
+        "log_alpha": state2.params.log_alpha,
+    }  # the pre-obs_rms field set, as orbax stored it
+    ck = Checkpointer(tmp_path / "old-sac", async_save=False)
+    ck.save(1, state2.replace(params=old_params))
+    ck.wait()
+    restored = ck.restore(fns2.init(jax.random.PRNGKey(2)))
+    ck.close()
+    assert restored.params.obs_rms == ()
+    np.testing.assert_allclose(
+        np.asarray(restored.params.log_alpha),
+        np.asarray(state2.params.log_alpha),
+    )
