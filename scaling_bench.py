@@ -19,18 +19,13 @@ from __future__ import annotations
 
 import json
 import os
+import statistics
 import sys
 import time
 
 import jax
 
 from actor_critic_algs_on_tensorflow_tpu.utils.profiling import sync
-
-
-def measure(
-    num_envs: int, rollout: int, iters: int, num_devices: int | None = None
-) -> float:
-    return max(measure_windows(num_envs, rollout, iters, num_devices))
 
 
 def measure_windows(
@@ -62,10 +57,9 @@ def _timed_windows(fns, iters: int) -> list:
     windows of ``iters`` iterations each; returns the per-window
     steps/sec list. Small iterations are dispatch- and tunnel-latency-
     bound, so single windows are hostage to transient host/tunnel
-    hiccups — the actor sweep (``main``) reports the max (the chip's
-    capability) alongside the median±spread so flaky points are
-    visible (VERDICT r2 weak#3); the devices sweep reports max only
-    (mesh-overhead ratios, same windows).
+    hiccups — both sweeps report the max (the chip's capability)
+    alongside the median±spread so flaky points are visible
+    (VERDICT r2 weak#3).
     Every window ends with a REAL host fetch (``sync``) because
     block_until_ready does not block on the tunneled axon backend."""
     state = fns.init(jax.random.PRNGKey(0))
@@ -83,9 +77,9 @@ def _timed_windows(fns, iters: int) -> list:
     return rates
 
 
-def measure_ppo(
+def measure_ppo_windows(
     num_envs: int, rollout: int, iters: int, num_devices: int
-) -> float:
+) -> list:
     """The headline PPO Atari-class workload (Nature-CNN over PongTPU,
     whole-batch epochs) at tiny shapes, for mesh-overhead measurement."""
     from actor_critic_algs_on_tensorflow_tpu.algos.ppo import (
@@ -106,7 +100,20 @@ def measure_ppo(
         time_limit_bootstrap=False,
         num_devices=num_devices,
     )
-    return max(_timed_windows(make_ppo(cfg), iters))
+    return _timed_windows(make_ppo(cfg), iters)
+
+
+def _window_stats(windows: list) -> dict:
+    """Best/median/[min,max] over one config's timed windows — the
+    common reporting block of both sweep modes (best = the chip's
+    capability; median±spread expose measurement noise)."""
+    windows = sorted(windows)
+    return {
+        "steps_per_sec": round(windows[-1], 1),
+        "median_steps_per_sec": round(statistics.median(windows), 1),
+        "window_spread": [round(windows[0], 1), round(windows[-1], 1)],
+        "windows": len(windows),
+    }
 
 
 def main_devices():
@@ -134,27 +141,30 @@ def main_devices():
             rollout = int(os.environ.get("SCALE_ROLLOUT", 32))
             iters = int(os.environ.get("SCALE_ITERS", 20))
             envs_per_dev = int(os.environ.get("SCALE_ENVS_PER_DEV", 32))
-            fn = measure
+            winfn = measure_windows
         elif workload == "ppo":
             # CNN fwd+bwd on shared host cores: keep shapes tiny so the
             # full sweep stays in CI-able wall-clock.
             rollout = int(os.environ.get("SCALE_PPO_ROLLOUT", 16))
             iters = int(os.environ.get("SCALE_PPO_ITERS", 5))
             envs_per_dev = int(os.environ.get("SCALE_PPO_ENVS_PER_DEV", 8))
-            fn = measure_ppo
+            winfn = measure_ppo_windows
         else:
             raise SystemExit(f"unknown SCALE_WORKLOADS entry {workload!r}")
         results = []
         base = None
         for d in widths:
-            sps = fn(d * envs_per_dev, rollout, iters, num_devices=d)
+            stats = _window_stats(
+                winfn(d * envs_per_dev, rollout, iters, num_devices=d)
+            )
+            sps = stats["steps_per_sec"]
             if base is None:
                 base = sps
             results.append({
                 "workload": workload,
                 "devices": d,
                 "envs": d * envs_per_dev,
-                "steps_per_sec": round(sps, 1),
+                **stats,
                 "adjusted_efficiency_vs_1dev": round(sps / base, 3),
             })
             print(json.dumps(results[-1]), flush=True)
@@ -178,24 +188,14 @@ def main():
     results = []
     base = None
     for n in counts:
-        windows = sorted(measure_windows(n, rollout, iters))
-        sps = windows[-1]
-        mid = len(windows) // 2
-        med = (
-            windows[mid]
-            if len(windows) % 2
-            else 0.5 * (windows[mid - 1] + windows[mid])
-        )
-        per_actor = sps / n
+        stats = _window_stats(measure_windows(n, rollout, iters))
+        per_actor = stats["steps_per_sec"] / n
         if base is None:
             base = per_actor
         eff = per_actor / base
         results.append({
             "actors": n,
-            "steps_per_sec": round(sps, 1),
-            "median_steps_per_sec": round(med, 1),
-            "window_spread": [round(windows[0], 1), round(windows[-1], 1)],
-            "windows": len(windows),
+            **stats,
             "efficiency_vs_8": round(eff, 3),
         })
         print(json.dumps(results[-1]), flush=True)

@@ -1,0 +1,31 @@
+"""Smoke coverage for the scaling study script (scaling_bench.py).
+
+The sweeps themselves are measurement runs (README / PERF.md record
+them); these tests only pin the script's machinery — the timing-window
+helpers both sweep modes share — so refactors can't silently break the
+benchmark that produces the BASELINE.json:2 scaling evidence.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import scaling_bench  # noqa: E402
+
+
+def test_a2c_measure_windows_shape_and_positive(monkeypatch):
+    monkeypatch.setenv("SCALE_REPEATS", "2")
+    windows = scaling_bench.measure_windows(8, 8, 2, num_devices=1)
+    assert len(windows) == 2
+    assert all(w > 0 for w in windows)
+
+
+@pytest.mark.slow
+def test_ppo_measure_windows_positive(monkeypatch):
+    monkeypatch.setenv("SCALE_REPEATS", "1")
+    windows = scaling_bench.measure_ppo_windows(4, 4, 1, num_devices=1)
+    assert len(windows) == 1
+    assert windows[0] > 0
