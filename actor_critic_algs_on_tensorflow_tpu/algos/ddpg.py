@@ -59,6 +59,11 @@ class DDPGConfig:
     ou_sigma: float = 0.2
     ou_dt: float = 1e-2
     max_grad_norm: float = 0.0      # 0 = no clipping (DDPG default)
+    # Running mean/std observation normalization (vector obs), as in
+    # ``SACConfig.normalize_obs``: stats live in params.obs_rms, fold
+    # in the sampled batch each update, apply at BOTH acting and
+    # update time; replay stores raw obs.
+    normalize_obs: bool = False
     seed: int = 0
     num_devices: int = 0
 
@@ -69,6 +74,10 @@ class DDPGParams:
     critic: any
     target_actor: any
     target_critic: any
+    # RunningMeanStd when cfg.normalize_obs, else () (leafless, so the
+    # checkpoint layout of normalize-free configs is unchanged). Not a
+    # gradient path: optimizers never see this field.
+    obs_rms: any = ()
 
 
 def make_ddpg(cfg: DDPGConfig) -> offpolicy.OffPolicyFns:
@@ -79,10 +88,16 @@ def make_ddpg(cfg: DDPGConfig) -> offpolicy.OffPolicyFns:
     actor_tx = offpolicy.make_adam(cfg.actor_lr, cfg.max_grad_norm)
     critic_tx = offpolicy.make_adam(cfg.critic_lr, cfg.max_grad_norm)
 
-    def act_with(actor_params, obs, noise, key, step):
-        """Tanh actor + OU noise; uniform-random during warmup."""
+    onorm = offpolicy.make_obs_norm(cfg)
+
+    def act_with(acting_params, obs, noise, key, step):
+        """Tanh actor + OU noise; uniform-random during warmup.
+
+        ``acting_params`` is ``acting_slice(params)``: (actor, obs_rms).
+        """
+        actor_params, obs_rms = acting_params
         k_ou, k_rand = jax.random.split(key)
-        a = actor.apply(actor_params, obs)
+        a = actor.apply(actor_params, onorm.norm_with(obs_rms, obs))
         noise, eps = ou_step(
             noise, k_ou, theta=cfg.ou_theta, sigma=cfg.ou_sigma, dt=cfg.ou_dt
         )
@@ -92,7 +107,9 @@ def make_ddpg(cfg: DDPGConfig) -> offpolicy.OffPolicyFns:
         return a * s.action_scale, noise
 
     def act_fn(params, obs, noise, key, step):
-        return act_with(params.actor, obs, noise, key, step)
+        return act_with(
+            (params.actor, params.obs_rms), obs, noise, key, step
+        )
 
     def init_params(key: jax.Array, obs_example):
         k_actor, k_critic = jax.random.split(key)
@@ -108,6 +125,7 @@ def make_ddpg(cfg: DDPGConfig) -> offpolicy.OffPolicyFns:
             critic=critic_params,
             target_actor=copy(actor_params),
             target_critic=copy(critic_params),
+            obs_rms=onorm.init(obs_example),
         )
         opt_state = {
             "actor": actor_tx.init(actor_params),
@@ -131,7 +149,8 @@ def make_ddpg(cfg: DDPGConfig) -> offpolicy.OffPolicyFns:
 
     def one_update(replay, carry, key):
         params, opt_state = carry
-        batch = s.buf.sample(replay, key, cfg.batch_size)
+        raw_batch = s.buf.sample(replay, key, cfg.batch_size)
+        batch = onorm.norm_batch(params.obs_rms, raw_batch)
 
         def critic_loss_fn(cp):
             a_next = actor.apply(params.target_actor, batch.next_obs)
@@ -173,6 +192,7 @@ def make_ddpg(cfg: DDPGConfig) -> offpolicy.OffPolicyFns:
             target_critic=polyak_update(
                 params.target_critic, params.critic, cfg.tau
             ),
+            obs_rms=onorm.fold(params.obs_rms, raw_batch.obs),
         )
         m = {"q_loss": q_loss, "actor_loss": a_loss, "q_mean": jnp.mean(q)}
         return (new_params, {"actor": a_opt, "critic": c_opt}), m
@@ -223,7 +243,7 @@ def make_ddpg(cfg: DDPGConfig) -> offpolicy.OffPolicyFns:
         init_params=init_params,
         noise_init=lambda n: ou_init((n, s.action_dim)),
         noise_reset=ou_reset_where,
-        acting_slice=lambda params: params.actor,
+        acting_slice=lambda params: (params.actor, params.obs_rms),
         act_with=act_with,
     )
     return offpolicy.build_fns(s, init, local_iteration, parts=parts)

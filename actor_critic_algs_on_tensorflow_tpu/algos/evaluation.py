@@ -74,7 +74,7 @@ def _act_fn(algo: str, cfg, aspace, params, stochastic: bool, norm=None):
         scale = float(aspace.high)
 
         def act(obs, key):
-            return actor.apply(params.actor, obs) * scale
+            return actor.apply(params.actor, norm(obs)) * scale
     elif algo == "sac":
         actor = SquashedGaussianActor(aspace.shape[-1], cfg.hidden_sizes)
         scale = float(aspace.high)
@@ -159,11 +159,12 @@ def evaluate_checkpoint(
     )
     norm = None
     if getattr(cfg, "normalize_obs", False):
-        # PPO keeps the running stats in state.extra; SAC in
-        # params.obs_rms (the off-policy state has no extra slot).
+        # PPO keeps the running stats in state.extra; the off-policy
+        # trainers (DDPG/TD3/SAC) in params.obs_rms (their state has
+        # no extra slot).
         rms = (
             state.params.obs_rms
-            if algo == "sac"
+            if hasattr(state.params, "obs_rms")
             else state.extra
         )
         norm = lambda o: rms_normalize(o, rms)

@@ -203,6 +203,59 @@ def make_adam(lr: float, max_grad_norm: float = 0.0):
     return optax.adam(lr)
 
 
+class ObsNorm(NamedTuple):
+    """The shared ``normalize_obs`` plumbing of the off-policy family
+    (DDPG/TD3/SAC all use it identically): running mean/std stats live
+    in ``params.obs_rms`` — leafless ``()`` when off, so the checkpoint
+    layout of normalize-free configs is unchanged — fold in each
+    sampled batch, and apply at BOTH acting and update time; replay
+    stores raw obs. Not a gradient path: the trainers' optimizers are
+    built per-subtree and never see the stats."""
+
+    norm_with: Callable   # (obs_rms, obs) -> normalized obs (id when off)
+    init: Callable        # obs_example -> RunningMeanStd | ()
+    norm_batch: Callable  # (obs_rms, raw Transition batch) -> normalized
+    fold: Callable        # (obs_rms, raw batch obs) -> updated stats
+
+
+def make_obs_norm(cfg) -> ObsNorm:
+    """Build the ``ObsNorm`` helpers from ``cfg.normalize_obs``."""
+    from actor_critic_algs_on_tensorflow_tpu.ops import (
+        rms_init,
+        rms_normalize,
+        rms_update,
+    )
+
+    def norm_with(obs_rms, obs):
+        if not cfg.normalize_obs:
+            return obs
+        return rms_normalize(obs, obs_rms)
+
+    def init(obs_example):
+        if not cfg.normalize_obs:
+            return ()
+        if len(obs_example.shape) != 2:
+            raise ValueError(
+                "normalize_obs supports vector observations only"
+            )
+        return rms_init(obs_example.shape[1:])
+
+    def norm_batch(obs_rms, raw_batch):
+        # Normalize the sampled views with the PRE-update stats (no
+        # gradient path; the caller folds the batch in afterwards).
+        return raw_batch._replace(
+            obs=norm_with(obs_rms, raw_batch.obs),
+            next_obs=norm_with(obs_rms, raw_batch.next_obs),
+        )
+
+    def fold(obs_rms, raw_obs):
+        if not cfg.normalize_obs:
+            return obs_rms
+        return rms_update(obs_rms, raw_obs, axis_name=DATA_AXIS)
+
+    return ObsNorm(norm_with, init, norm_batch, fold)
+
+
 def assemble_state(
     s: TrainerSetup,
     *,

@@ -31,9 +31,6 @@ from actor_critic_algs_on_tensorflow_tpu.models import (
 from actor_critic_algs_on_tensorflow_tpu.ops import (
     TanhGaussian,
     polyak_update,
-    rms_init,
-    rms_normalize,
-    rms_update,
 )
 from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import DATA_AXIS
 from actor_critic_algs_on_tensorflow_tpu.utils import prng
@@ -91,10 +88,7 @@ def make_sac(cfg: SACConfig) -> offpolicy.OffPolicyFns:
     critic_tx = offpolicy.make_adam(cfg.critic_lr)
     alpha_tx = offpolicy.make_adam(cfg.alpha_lr)
 
-    def norm_with(obs_rms, obs):
-        if not cfg.normalize_obs:
-            return obs
-        return rms_normalize(obs, obs_rms)
+    onorm = offpolicy.make_obs_norm(cfg)
 
     def act_with(acting_params, obs, noise, key, step):
         """Stochastic squashed-Gaussian acting; uniform during warmup.
@@ -103,7 +97,9 @@ def make_sac(cfg: SACConfig) -> offpolicy.OffPolicyFns:
         """
         actor_params, obs_rms = acting_params
         k_sample, k_rand = jax.random.split(key)
-        mean, log_std = actor.apply(actor_params, norm_with(obs_rms, obs))
+        mean, log_std = actor.apply(
+            actor_params, onorm.norm_with(obs_rms, obs)
+        )
         a = TanhGaussian(mean, log_std).sample(k_sample)
         rand = jax.random.uniform(k_rand, a.shape, a.dtype, -1.0, 1.0)
         a = jnp.where(step < s.warmup_iters, rand, a)
@@ -121,21 +117,13 @@ def make_sac(cfg: SACConfig) -> offpolicy.OffPolicyFns:
             k_critic, obs_example, jnp.zeros((1, s.action_dim))
         )
         log_alpha = jnp.log(jnp.asarray(cfg.init_alpha, jnp.float32))
-        if cfg.normalize_obs:
-            if len(obs_example.shape) != 2:
-                raise ValueError(
-                    "normalize_obs supports vector observations only"
-                )
-            obs_rms = rms_init(obs_example.shape[1:])
-        else:
-            obs_rms = ()
         params = SACParams(
             actor=actor_params,
             critic=critic_params,
             # Copy: donated state must not alias online/target buffers.
             target_critic=jax.tree_util.tree_map(jnp.copy, critic_params),
             log_alpha=log_alpha,
-            obs_rms=obs_rms,
+            obs_rms=onorm.init(obs_example),
         )
         opt_state = {
             "actor": actor_tx.init(actor_params),
@@ -162,14 +150,7 @@ def make_sac(cfg: SACConfig) -> offpolicy.OffPolicyFns:
         params, opt_state = carry
         k_batch, k_next, k_pi = jax.random.split(key, 3)
         raw_batch = s.buf.sample(replay, k_batch, cfg.batch_size)
-        # Replay stores RAW obs; normalize the sampled views with the
-        # PRE-update stats (no gradient path: the loss closures
-        # differentiate w.r.t. actor/critic subtrees only), then fold
-        # this batch into the stats for the next update.
-        batch = raw_batch._replace(
-            obs=norm_with(params.obs_rms, raw_batch.obs),
-            next_obs=norm_with(params.obs_rms, raw_batch.next_obs),
-        )
+        batch = onorm.norm_batch(params.obs_rms, raw_batch)
         alpha = jnp.exp(params.log_alpha)
 
         def critic_loss_fn(cp):
@@ -236,13 +217,7 @@ def make_sac(cfg: SACConfig) -> offpolicy.OffPolicyFns:
                 params.target_critic, params.critic, cfg.tau
             ),
             log_alpha=optax.apply_updates(params.log_alpha, al_up),
-            obs_rms=(
-                rms_update(
-                    params.obs_rms, raw_batch.obs, axis_name=DATA_AXIS
-                )
-                if cfg.normalize_obs
-                else params.obs_rms
-            ),
+            obs_rms=onorm.fold(params.obs_rms, raw_batch.obs),
         )
         m = {
             "q_loss": q_loss,

@@ -55,6 +55,11 @@ class TD3Config:
     target_clip: float = 0.5        # smoothing noise clip
     policy_delay: int = 2           # critic updates per actor/target update
     max_grad_norm: float = 0.0      # 0 = no clipping
+    # Running mean/std observation normalization (vector obs), as in
+    # ``SACConfig.normalize_obs``: stats live in params.obs_rms, fold
+    # in the sampled batch each update, apply at BOTH acting and
+    # update time; replay stores raw obs.
+    normalize_obs: bool = False
     seed: int = 0
     num_devices: int = 0
 
@@ -65,6 +70,10 @@ class TD3Params:
     critic: any
     target_actor: any
     target_critic: any
+    # RunningMeanStd when cfg.normalize_obs, else () (leafless, so the
+    # checkpoint layout of normalize-free configs is unchanged). Not a
+    # gradient path: optimizers never see this field.
+    obs_rms: any = ()
 
 
 def make_td3(cfg: TD3Config) -> offpolicy.OffPolicyFns:
@@ -75,15 +84,19 @@ def make_td3(cfg: TD3Config) -> offpolicy.OffPolicyFns:
     actor_tx = offpolicy.make_adam(cfg.actor_lr, cfg.max_grad_norm)
     critic_tx = offpolicy.make_adam(cfg.critic_lr, cfg.max_grad_norm)
 
-    def act_with(actor_params, obs, noise, key, step):
+    onorm = offpolicy.make_obs_norm(cfg)
+
+    def act_with(acting_params, obs, noise, key, step):
         """Tanh actor + Gaussian noise; uniform-random during warmup.
 
-        ``noise`` is an unused placeholder (TD3 noise is i.i.d. per
-        step, unlike DDPG's OU carry); kept for the shared
+        ``acting_params`` is ``acting_slice(params)``: (actor,
+        obs_rms). ``noise`` is an unused placeholder (TD3 noise is
+        i.i.d. per step, unlike DDPG's OU carry); kept for the shared
         ``act_then_store`` signature.
         """
+        actor_params, obs_rms = acting_params
         k_eps, k_rand = jax.random.split(key)
-        a = actor.apply(actor_params, obs)
+        a = actor.apply(actor_params, onorm.norm_with(obs_rms, obs))
         eps = cfg.explore_sigma * jax.random.normal(k_eps, a.shape, a.dtype)
         a = jnp.clip(a + eps, -1.0, 1.0)
         rand = jax.random.uniform(k_rand, a.shape, a.dtype, -1.0, 1.0)
@@ -91,7 +104,9 @@ def make_td3(cfg: TD3Config) -> offpolicy.OffPolicyFns:
         return a * s.action_scale, noise
 
     def act_fn(params, obs, noise, key, step):
-        return act_with(params.actor, obs, noise, key, step)
+        return act_with(
+            (params.actor, params.obs_rms), obs, noise, key, step
+        )
 
     def init_params(key: jax.Array, obs_example):
         k_actor, k_critic = jax.random.split(key)
@@ -107,6 +122,7 @@ def make_td3(cfg: TD3Config) -> offpolicy.OffPolicyFns:
             critic=critic_params,
             target_actor=copy(actor_params),
             target_critic=copy(critic_params),
+            obs_rms=onorm.init(obs_example),
         )
         opt_state = {
             "actor": actor_tx.init(actor_params),
@@ -137,7 +153,8 @@ def make_td3(cfg: TD3Config) -> offpolicy.OffPolicyFns:
         params, opt_state = carry
         upd_idx = opt_state["updates_done"]
         k_batch, k_smooth = jax.random.split(key)
-        batch = s.buf.sample(replay, k_batch, cfg.batch_size)
+        raw_batch = s.buf.sample(replay, k_batch, cfg.batch_size)
+        batch = onorm.norm_batch(params.obs_rms, raw_batch)
 
         def critic_loss_fn(cp):
             # Target-policy smoothing: clipped noise on the target
@@ -219,6 +236,7 @@ def make_td3(cfg: TD3Config) -> offpolicy.OffPolicyFns:
             critic=new_critic,
             target_actor=t_actor,
             target_critic=t_critic,
+            obs_rms=onorm.fold(params.obs_rms, raw_batch.obs),
         )
         m = {
             "q_loss": q_loss,
@@ -281,7 +299,7 @@ def make_td3(cfg: TD3Config) -> offpolicy.OffPolicyFns:
         init_params=init_params,
         noise_init=lambda n: jnp.zeros(()),
         noise_reset=None,
-        acting_slice=lambda params: params.actor,
+        acting_slice=lambda params: (params.actor, params.obs_rms),
         act_with=act_with,
     )
     return offpolicy.build_fns(s, init, local_iteration, parts=parts)

@@ -184,11 +184,14 @@ def test_evaluate_checkpoint_sac(tmp_path):
 
 @pytest.mark.slow
 def test_cli_td3_train_then_eval(tmp_path, capsys):
-    """TD3 through the full CLI surface: train, checkpoint, eval."""
+    """TD3 through the full CLI surface: train, checkpoint, eval —
+    with observation normalization on, so the eval leg restores and
+    applies the off-policy ``params.obs_rms`` stats."""
     common = [
         "--algo", "td3", "--env", "Pendulum-v1",
         "--set", "num_envs=8", "--set", "num_devices=1",
         "--set", "replay_capacity=2048", "--set", "warmup_env_steps=128",
+        "--set", "normalize_obs=True",
         "--checkpoint-dir", str(tmp_path / "ck"),
     ]
     assert cli.main(

@@ -105,3 +105,24 @@ def test_ddpg_learns_pendulum():
     )(jax.random.PRNGKey(1))
     assert float(frac_done) == 1.0
     assert float(mean_ret) > -400.0, float(mean_ret)
+
+
+def test_ddpg_normalize_obs_trains_and_keeps_old_format():
+    # Same contract as SAC's: stats in params.obs_rms, folded in
+    # sampled batches, applied at acting + update time; the
+    # normalize-free config keeps a leafless () slot so pre-field
+    # checkpoints restore cleanly.
+    fns = ddpg.make_ddpg(_cfg(normalize_obs=True, warmup_env_steps=0))
+    state = fns.init(jax.random.PRNGKey(0))
+    count0 = float(state.params.obs_rms.count)
+    assert state.params.obs_rms.mean.shape == (3,)  # Pendulum obs dim
+    for _ in range(3):
+        state, metrics = fns.iteration(state)
+    m = {k: float(v) for k, v in metrics.items()}
+    assert np.isfinite(list(m.values())).all(), m
+    assert float(state.params.obs_rms.count) > count0
+    assert float(jnp.abs(state.params.obs_rms.mean).sum()) > 0.0
+
+    assert ddpg.make_ddpg(_cfg()).init(
+        jax.random.PRNGKey(1)
+    ).params.obs_rms == ()

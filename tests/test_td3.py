@@ -122,3 +122,19 @@ def test_td3_learns_pendulum():
     )(jax.random.PRNGKey(1))
     assert float(frac_done) == 1.0
     assert float(mean_ret) > -400.0, float(mean_ret)
+
+
+def test_td3_normalize_obs_trains():
+    # Same contract as DDPG/SAC: stats in params.obs_rms, folded in
+    # sampled batches, applied at acting + update time.
+    fns = td3.make_td3(_cfg(normalize_obs=True, warmup_env_steps=0))
+    state = fns.init(jax.random.PRNGKey(0))
+    count0 = float(state.params.obs_rms.count)
+    for _ in range(3):
+        state, metrics = fns.iteration(state)
+    m = {k: float(v) for k, v in metrics.items()}
+    assert np.isfinite(list(m.values())).all(), m
+    assert float(state.params.obs_rms.count) > count0
+    assert td3.make_td3(_cfg()).init(
+        jax.random.PRNGKey(1)
+    ).params.obs_rms == ()
