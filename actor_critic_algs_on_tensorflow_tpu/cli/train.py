@@ -109,7 +109,12 @@ PRESETS = {
             "total_env_steps": 1_000_000,
         },
     ),
-    # 4. SAC on Humanoid: twin-Q + learned alpha (BASELINE.json:10)
+    # 4. SAC on Humanoid: twin-Q + learned alpha (BASELINE.json:10).
+    # normalize_obs defaults ON here: two full-3M seeds measured
+    # post-2M means 7,752/8,419 and greedy evals 7,946/9,950 vs
+    # 4,891/3,950 and 4,351/4,230 unnormalized (PERF.md). To RESUME a
+    # checkpoint trained without it, pass --set normalize_obs=False
+    # (the stats field changes the params layout).
     "sac-humanoid": (
         "sac",
         {
@@ -117,6 +122,7 @@ PRESETS = {
             "num_envs": 8,
             "num_devices": 1,
             "total_env_steps": 3_000_000,
+            "normalize_obs": True,
         },
     ),
     # 5. IMPALA / distributed A3C with V-trace (BASELINE.json:11)
