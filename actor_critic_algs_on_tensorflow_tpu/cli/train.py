@@ -89,7 +89,11 @@ PRESETS = {
             "lr": 8e-3,
         },
     ),
-    # 3. DDPG on MuJoCo HalfCheetah: OU-noise explore (BASELINE.json:9)
+    # 3. DDPG on MuJoCo HalfCheetah: OU-noise explore (BASELINE.json:9).
+    # normalize_obs defaults ON (as on sac-humanoid): two full-1M
+    # seeds measured final windows 7,485/7,825 vs 6,357 unnormalized,
+    # greedy evals 9,111/10,462 (PERF.md). Resuming OR evaluating a
+    # checkpoint trained without it needs --set normalize_obs=False.
     "ddpg-halfcheetah": (
         "ddpg",
         {
@@ -97,9 +101,12 @@ PRESETS = {
             "num_envs": 8,
             "num_devices": 1,
             "total_env_steps": 1_000_000,
+            "normalize_obs": True,
         },
     ),
-    # DDPG successor: twin delayed DDPG on the same MuJoCo task
+    # DDPG successor: twin delayed DDPG on the same MuJoCo task.
+    # normalize_obs ON: final windows 8,892/7,107 vs 6,374, greedy
+    # evals 9,665/8,034 across two seeds (PERF.md).
     "td3-halfcheetah": (
         "td3",
         {
@@ -107,14 +114,16 @@ PRESETS = {
             "num_envs": 8,
             "num_devices": 1,
             "total_env_steps": 1_000_000,
+            "normalize_obs": True,
         },
     ),
     # 4. SAC on Humanoid: twin-Q + learned alpha (BASELINE.json:10).
     # normalize_obs defaults ON here: two full-3M seeds measured
     # post-2M means 7,752/8,419 and greedy evals 7,946/9,950 vs
-    # 4,891/3,950 and 4,351/4,230 unnormalized (PERF.md). To RESUME a
-    # checkpoint trained without it, pass --set normalize_obs=False
-    # (the stats field changes the params layout).
+    # 4,891/3,950 and 4,351/4,230 unnormalized (PERF.md). To resume
+    # OR --eval a checkpoint trained without it, pass
+    # --set normalize_obs=False (the stats field changes the params
+    # layout).
     "sac-humanoid": (
         "sac",
         {
