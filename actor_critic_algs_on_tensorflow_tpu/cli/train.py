@@ -118,10 +118,10 @@ PRESETS = {
         },
     ),
     # 4. SAC on Humanoid: twin-Q + learned alpha (BASELINE.json:10).
-    # normalize_obs defaults ON here: two full-3M seeds measured
-    # post-2M means 7,752/8,419 and greedy evals 7,946/9,950 vs
-    # 4,891/3,950 and 4,351/4,230 unnormalized (PERF.md). To resume
-    # OR --eval a checkpoint trained without it, pass
+    # normalize_obs defaults ON here: three full-3M seeds measured
+    # post-2M means 7,752/8,419/6,594 vs 4,891/3,950 unnormalized
+    # (greedy evals 7,946/9,950/3,935 vs 4,351/4,230 — PERF.md). To
+    # resume OR --eval a checkpoint trained without it, pass
     # --set normalize_obs=False (the stats field changes the params
     # layout).
     "sac-humanoid": (
