@@ -434,15 +434,37 @@ def run_loop(
     history = []
     clock = RateClock(fns.steps_per_iteration, log_interval_iters)
     last_metrics = None
+    # Episode stats are aggregated over the WHOLE log window with
+    # on-device scalar accumulators (fetched only at log time), not
+    # sampled from the boundary iteration: envs whose episodes all
+    # truncate at the same step (e.g. the 50-step reacher) finish
+    # episodes in only ~1 of every ep_len/steps_per_iter iterations,
+    # so a sampled boundary iteration usually reports episodes=0.
+    ep_count = ret_sum = None
     for it in range(num_iters):
         state, metrics = fns.iteration(state)
         last_metrics = metrics
+        if "episodes" in metrics:
+            n = metrics["episodes"]
+            r = metrics["avg_return"] * n
+            if ep_count is None:
+                ep_count, ret_sum = n, r
+            else:
+                ep_count, ret_sum = ep_count + n, ret_sum + r
         if serialize:
             jax.block_until_ready(metrics)
         if it == 0:
             clock.first_iteration_done()
         if (it + 1) % log_interval_iters == 0 or it == num_iters - 1:
-            m = device_get_metrics(metrics)
+            fetch = dict(metrics)
+            if ep_count is not None:
+                fetch["episodes"] = ep_count
+                fetch["_window_return_sum"] = ret_sum
+            m = device_get_metrics(fetch)
+            if ep_count is not None:
+                rs = m.pop("_window_return_sum")
+                m["avg_return"] = rs / m["episodes"] if m["episodes"] else 0.0
+                ep_count = ret_sum = None
             env_steps = steps_done0 + (it + 1) * fns.steps_per_iteration
             m["steps_per_sec"] = clock.rate(it)
             emit_log(env_steps, m, history, summary_writer, log_fn)

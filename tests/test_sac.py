@@ -139,3 +139,41 @@ def test_sac_normalize_obs_trains_and_restores_old_format(tmp_path):
         np.asarray(restored.params.log_alpha),
         np.asarray(state2.params.log_alpha),
     )
+
+
+def test_truncation_only_env_reports_window_returns():
+    """Training windows must surface episode returns for envs whose
+    episodes only TRUNCATE, all at the same step (the 50-step reacher):
+    every env finishes in the SAME iteration, so a log window that
+    samples its boundary iteration usually reads episodes=0. run_loop
+    aggregates episode stats across the whole window instead."""
+    cfg = _cfg(
+        env="ReacherTPU-v0",
+        num_envs=4,
+        steps_per_iter=8,
+        updates_per_iter=1,
+        warmup_env_steps=10**6,  # gate updates off; this tests logging
+        batch_size=4,
+        total_env_steps=4 * 8 * 14,
+        num_devices=1,
+    )
+    fns = sac.make_sac(cfg)
+    history = []
+    common.run_loop(
+        fns,
+        total_env_steps=cfg.total_env_steps,
+        seed=0,
+        log_interval_iters=5,  # boundaries land at iters 7 and 13
+        log_fn=lambda step, m: history.append((step, m)),
+    )
+    assert len(history) == 3  # iters 5, 10, 14
+    # Window 1 (iters 1-5, env steps 1-40): no env reached step 50.
+    assert history[0][1]["episodes"] == 0.0
+    # Window 2 (iters 6-10): all 4 envs truncated at step 50 during
+    # iteration 7 — the aggregate must see them even though the
+    # boundary iteration (10) finished none.
+    assert history[1][1]["episodes"] == 4.0
+    assert history[1][1]["avg_return"] < 0.0  # reacher shaping is negative
+    # Window 3 (iters 11-14): the step-100 truncations, iteration 13.
+    assert history[2][1]["episodes"] == 4.0
+    assert history[2][1]["avg_return"] < 0.0
