@@ -143,13 +143,16 @@ def evaluate_checkpoint(
     """
     from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
         Checkpointer,
+        obs_norm_restore_guard,
     )
 
     ckpt = Checkpointer(checkpoint_dir)
     if ckpt.latest_step() is None:
         raise FileNotFoundError(f"no checkpoint in {checkpoint_dir}")
     template = _make_init(algo, cfg)(jax.random.PRNGKey(cfg.seed))
-    state = ckpt.restore(template)
+    state = ckpt.restore(
+        template, forbid_defaulted=obs_norm_restore_guard(cfg)
+    )
     ckpt.close()
 
     env, env_params = envs_lib.make(

@@ -210,7 +210,16 @@ class ObsNorm(NamedTuple):
     layout of normalize-free configs is unchanged — fold in each
     sampled batch, and apply at BOTH acting and update time; replay
     stores raw obs. Not a gradient path: the trainers' optimizers are
-    built per-subtree and never see the stats."""
+    built per-subtree and never see the stats.
+
+    Deliberate deviation from stream-folding VecNormalize: ``fold``
+    runs on uniformly RE-SAMPLED replay batches, so a transition can
+    fold multiple times and the stats track the replay-sampling
+    distribution, not the env stream (count grows per update). This
+    keeps the fused iteration one program (no separate collection-time
+    fold) and is what every shipped full-budget seed validated; fold
+    new transitions once at collection time if stream-faithful stats
+    are ever needed."""
 
     norm_with: Callable   # (obs_rms, obs) -> normalized obs (id when off)
     init: Callable        # obs_example -> RunningMeanStd | ()

@@ -379,23 +379,29 @@ def main(argv=None) -> int:
             writer.close()
 
 
-def _open_checkpointer(args, make_template):
+def _open_checkpointer(args, make_template, cfg=None):
     """(checkpointer, restored_state) from --checkpoint-dir/--resume.
 
     ``make_template`` is called lazily only when a restore happens; it
     must return a state pytree with the structure (and, where sharding
-    matters, the shardings) the restored arrays should adopt.
+    matters, the shardings) the restored arrays should adopt. ``cfg``
+    (when given) guards against grafting fresh obs-normalization stats
+    into a normalize_obs=True run (utils.checkpoint.obs_norm_restore_guard).
     """
     if not args.checkpoint_dir:
         return None, None
     from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
         Checkpointer,
+        obs_norm_restore_guard,
     )
 
     checkpointer = Checkpointer(args.checkpoint_dir)
     state = None
     if args.resume and checkpointer.latest_step() is not None:
-        state = checkpointer.restore(make_template())
+        state = checkpointer.restore(
+            make_template(),
+            forbid_defaulted=obs_norm_restore_guard(cfg),
+        )
         print(f"[train] resumed from step {checkpointer.latest_step()}")
     return checkpointer, state
 
@@ -543,7 +549,7 @@ def _run(args, algo, cfg, writer) -> int:
             return jax.eval_shape(fns.init, jax.random.PRNGKey(cfg.seed))
         return fns.init(jax.random.PRNGKey(cfg.seed))
 
-    checkpointer, state = _open_checkpointer(args, make_template)
+    checkpointer, state = _open_checkpointer(args, make_template, cfg)
     if use_async:
         from actor_critic_algs_on_tensorflow_tpu.algos.host_async import (
             run_host_async,

@@ -20,6 +20,25 @@ import jax
 import orbax.checkpoint as ocp
 
 
+def obs_norm_restore_guard(cfg) -> dict[str, str] | None:
+    """``forbid_defaulted`` map for restores under ``normalize_obs=True``.
+
+    A checkpoint trained WITHOUT normalization lacks the running
+    mean/std statistics (``params.obs_rms`` for DDPG/TD3/SAC,
+    ``state.extra`` for the on-policy trainers); grafting fresh RMS
+    stats under a normalize_obs=True config would silently act through
+    identity-ish normalization (and its ±10 clip) on a policy trained
+    on raw observations. Fail the restore with guidance instead.
+    """
+    if not getattr(cfg, "normalize_obs", False):
+        return None
+    hint = (
+        "This checkpoint was trained without observation normalization; "
+        "resume or --eval it with --set normalize_obs=False."
+    )
+    return {"obs_rms": hint, ".extra": hint}
+
+
 class Checkpointer:
     """Thin orbax CheckpointManager wrapper over one train-state pytree."""
 
@@ -44,7 +63,13 @@ class Checkpointer:
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 
-    def restore(self, example_state: Any, step: int | None = None) -> Any:
+    def restore(
+        self,
+        example_state: Any,
+        step: int | None = None,
+        *,
+        forbid_defaulted: dict[str, str] | None = None,
+    ) -> Any:
         """Restore into the structure/shardings of ``example_state``.
 
         ``example_state`` may be a concrete state (e.g. ``fns.init(key)``)
@@ -56,6 +81,13 @@ class Checkpointer:
         template restore fails on a structure mismatch, the raw saved
         tree is grafted onto ``example_state`` and any leaf the
         checkpoint lacks keeps the template's (init) value.
+
+        ``forbid_defaulted`` maps a path fragment to a guidance message:
+        if the graft would default a leaf whose path contains the
+        fragment, restore FAILS with that message instead of warning.
+        For fields the run configuration actively reads (observation-
+        normalization statistics under ``normalize_obs=True``), a fresh
+        init value is silently-wrong state, not a benign migration.
         """
         if step is None:
             step = self._mgr.latest_step()
@@ -70,7 +102,10 @@ class Checkpointer:
             )
         except (ValueError, KeyError, TypeError) as strict_err:
             raw = self._mgr.restore(step)
-            return _graft(example_state, raw, strict_err)
+            return _graft(
+                example_state, raw, strict_err,
+                forbid_defaulted=forbid_defaulted,
+            )
 
     def wait(self) -> None:
         """Block until async saves are durable (call before exit)."""
@@ -80,7 +115,13 @@ class Checkpointer:
         self._mgr.close()
 
 
-def _graft(example_state: Any, raw: Any, strict_err: Exception) -> Any:
+def _graft(
+    example_state: Any,
+    raw: Any,
+    strict_err: Exception,
+    *,
+    forbid_defaulted: dict[str, str] | None = None,
+) -> Any:
     """Overlay ``raw`` (orbax's template-free nested-dict restore) onto
     ``example_state``'s structure. STRICTLY a field-addition migration:
     leaves absent from the checkpoint keep the template value (warned,
@@ -155,6 +196,15 @@ def _graft(example_state: Any, raw: Any, strict_err: Exception) -> Any:
             f"missing from the checkpoint, {n_saved - consumed} saved "
             f"leaves unused)"
         ) from strict_err
+    if defaulted and forbid_defaulted:
+        for frag, hint in forbid_defaulted.items():
+            hit = [p for p in defaulted if frag in p]
+            if hit:
+                raise ValueError(
+                    f"checkpoint predates {', '.join(hit)}, and this run "
+                    f"configuration actively reads that state — refusing "
+                    f"to restore with fresh (init) values. {hint}"
+                ) from strict_err
     if defaulted:
         warnings.warn(
             "checkpoint predates these state fields; restored with "

@@ -175,3 +175,50 @@ def test_restore_graft_rejects_renames_and_reshapes(tmp_path):
     with pytest.raises(ValueError, match="checkpoint migration|not a pure"):
         ckpt2.restore(template)
     ckpt2.close()
+
+
+def test_restore_forbids_grafting_fresh_obs_norm_stats(tmp_path):
+    """Resuming/eval-ing an UNNORMALIZED checkpoint under a
+    normalize_obs=True config must fail loudly: grafting fresh RMS
+    stats would silently mis-scale a policy trained on raw obs
+    (advisor r3). The same restore without the guard still works as a
+    warned field-addition migration."""
+    from actor_critic_algs_on_tensorflow_tpu.algos import td3
+    from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
+        obs_norm_restore_guard,
+    )
+
+    base = dict(
+        env="Pendulum-v1",
+        num_envs=4,
+        steps_per_iter=2,
+        updates_per_iter=2,
+        replay_capacity=64,
+        batch_size=8,
+        warmup_env_steps=0,
+        hidden_sizes=(8, 8),
+        num_devices=1,
+    )
+    fns_raw = td3.make_td3(td3.TD3Config(**base))
+    state, _ = fns_raw.iteration(fns_raw.init(jax.random.PRNGKey(0)))
+    jax.block_until_ready(state)
+    ckpt = Checkpointer(tmp_path / "raw-ckpt", async_save=False)
+    ckpt.save(1, state)
+    ckpt.wait()
+
+    cfg_norm = td3.TD3Config(**base, normalize_obs=True)
+    assert obs_norm_restore_guard(td3.TD3Config(**base)) is None
+    guard = obs_norm_restore_guard(cfg_norm)
+    assert guard is not None
+    fns_norm = td3.make_td3(cfg_norm)
+    template = fns_norm.init(jax.random.PRNGKey(1))
+    with pytest.raises(ValueError, match="normalize_obs=False"):
+        ckpt.restore(template, forbid_defaulted=guard)
+    # The guard is the only thing standing between the configs: the
+    # unguarded graft path still migrates (with a warning).
+    with pytest.warns(UserWarning, match="obs_rms"):
+        restored = ckpt.restore(template)
+    assert float(restored.params.obs_rms.count) == float(
+        template.params.obs_rms.count
+    )
+    ckpt.close()
