@@ -166,6 +166,16 @@ PRESETS = {
     # PERF.md. (The r1 note "88 by 4M" did not reproduce and was
     # corrected in r2; whole-batch mb=1 entropy-collapses here — the
     # brick-wall task is the anti-Pong, see PERF.md ledger.)
+    # r4: shuffle="env" (contiguous env-sliced minibatches, visit order
+    # permuted per epoch — no full-buffer gather) replaced the random
+    # flat shuffle after a side-by-side 4.2M probe (88.7 vs 46.1) and a
+    # 3-seed 25M validation: final windows 293/261/302 (mean 285) vs
+    # 159.8 for the flat-shuffle schedule re-run under the same
+    # (r4 window-aggregated) metric — the r3-recorded 195/238/189 were
+    # boundary-iteration samples, so compare 285 vs ~160-207. Both at
+    # ~163k vs ~159k steps/s: the throughput gain is small (the mb=16
+    # gather was already amortized); the LEARNING gain is not — see
+    # PERF.md "shuffle='env'".
     "ppo-breakout": (
         "ppo",
         {
@@ -174,6 +184,7 @@ PRESETS = {
             "num_epochs": 4,
             "num_minibatches": 16,
             "lr": 1e-3,
+            "shuffle": "env",
         },
     ),
     # 7. IMPALA on the Atari-class on-device Pong: the async

@@ -46,6 +46,22 @@ def take_minibatch(tree, idx: jax.Array):
     return jax.tree_util.tree_map(lambda x: jnp.take(x, idx, axis=0), tree)
 
 
+def env_block_starts(key: jax.Array, num_minibatches: int, block_envs: int):
+    """Start offsets of contiguous env blocks, visit order permuted.
+
+    The gather-free minibatch schedule (``PPOConfig.shuffle="env"``):
+    the env axis is partitioned into ``num_minibatches`` CONTIGUOUS
+    blocks of ``block_envs`` envs — each minibatch is every rollout
+    step of one block, a plain slice instead of a full-buffer random
+    gather — and only the ORDER the blocks are visited in is drawn
+    per epoch. Env order is exchangeable (independent env instances),
+    so a fixed contiguous partition is as unbiased as a random one;
+    the permuted visit order still decorrelates the SGD sequence
+    across epochs. Returns ``[num_minibatches]`` int32 starts.
+    """
+    return jax.random.permutation(key, num_minibatches) * block_envs
+
+
 def frame_storage_context(obs0, frames, dones, num_stack: int):
     """Context for stack-free rollout storage of frame-stacked obs.
 
