@@ -103,6 +103,65 @@ def measure_ppo_windows(
     return _timed_windows(make_ppo(cfg), iters)
 
 
+def measure_impala_windows(
+    num_envs: int, rollout: int, iters: int, num_devices: int
+) -> list:
+    """The IMPALA learner step (V-trace + policy/value update) on a
+    synthetic trajectory batch sharded over the ``data`` mesh axis —
+    the third trainer family's mesh-overhead leg (VERDICT r3 next#7).
+    Synthetic batches isolate the LEARNER's mesh cost from actor
+    scheduling (the async actors are host threads; their throughput is
+    measured separately in PERF.md)."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from actor_critic_algs_on_tensorflow_tpu.algos.impala import (
+        ActorTrajectory,
+        ImpalaConfig,
+        make_impala,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import DATA_AXIS
+
+    envs_per_actor = num_envs // num_devices
+    cfg = ImpalaConfig(
+        env="CartPole-v1",
+        rollout_length=rollout,
+        batch_trajectories=num_devices,
+        envs_per_actor=envs_per_actor,
+        total_env_steps=10**9,
+        num_devices=num_devices,
+    )
+    init, learner_step, _, mesh = make_impala(cfg)
+    kb = jax.random.split(jax.random.PRNGKey(1), 6)
+    T, B = rollout, num_envs
+    shard = lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec))
+    batch = ActorTrajectory(
+        obs=shard(jax.random.normal(kb[0], (T, B, 4)), P(None, DATA_AXIS)),
+        actions=shard(
+            jax.random.randint(kb[1], (T, B), 0, 2), P(None, DATA_AXIS)
+        ),
+        rewards=shard(jax.random.normal(kb[2], (T, B)), P(None, DATA_AXIS)),
+        dones=shard(
+            (jax.random.uniform(kb[3], (T, B)) < 0.05).astype(jnp.float32),
+            P(None, DATA_AXIS),
+        ),
+        behaviour_log_probs=shard(
+            -jnp.abs(jax.random.normal(kb[4], (T, B))), P(None, DATA_AXIS)
+        ),
+        last_obs=shard(jax.random.normal(kb[5], (B, 4)), P(DATA_AXIS)),
+    )
+    # Reuse _timed_windows' warmup/repeat/sync methodology via an
+    # IterationFns-shaped shim (one timing harness for all three legs).
+    from types import SimpleNamespace
+
+    fns = SimpleNamespace(
+        init=init,
+        iteration=lambda state: learner_step(state, batch),
+        steps_per_iteration=T * B,
+    )
+    return _timed_windows(fns, iters)
+
+
 def _window_stats(windows: list) -> dict:
     """Best/median/[min,max] over one config's timed windows — the
     common reporting block of both sweep modes (best = the chip's
@@ -135,13 +194,20 @@ def main_devices():
     widths = [int(c) for c in os.environ.get(
         "SCALE_DEVICES", "1,2,4,8"
     ).split(",")]
-    workloads = os.environ.get("SCALE_WORKLOADS", "a2c,ppo").split(",")
+    workloads = os.environ.get(
+        "SCALE_WORKLOADS", "a2c,ppo,impala"
+    ).split(",")
     for workload in workloads:
         if workload == "a2c":
             rollout = int(os.environ.get("SCALE_ROLLOUT", 32))
             iters = int(os.environ.get("SCALE_ITERS", 20))
             envs_per_dev = int(os.environ.get("SCALE_ENVS_PER_DEV", 32))
             winfn = measure_windows
+        elif workload == "impala":
+            rollout = int(os.environ.get("SCALE_ROLLOUT", 32))
+            iters = int(os.environ.get("SCALE_ITERS", 20))
+            envs_per_dev = int(os.environ.get("SCALE_ENVS_PER_DEV", 32))
+            winfn = measure_impala_windows
         elif workload == "ppo":
             # CNN fwd+bwd on shared host cores: keep shapes tiny so the
             # full sweep stays in CI-able wall-clock.

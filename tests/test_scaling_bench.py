@@ -29,3 +29,10 @@ def test_ppo_measure_windows_positive(monkeypatch):
     windows = scaling_bench.measure_ppo_windows(4, 4, 1, num_devices=1)
     assert len(windows) == 1
     assert windows[0] > 0
+
+
+def test_impala_windows_smoke(monkeypatch):
+    monkeypatch.setenv("SCALE_REPEATS", "1")
+    windows = scaling_bench.measure_impala_windows(8, 8, 2, num_devices=2)
+    assert len(windows) == 1
+    assert all(w > 0 for w in windows)
