@@ -23,6 +23,7 @@ from actor_critic_algs_on_tensorflow_tpu.envs.pendulum import (  # noqa: F401
 )
 from actor_critic_algs_on_tensorflow_tpu.envs.pong import (  # noqa: F401
     PongParams,
+    PongServeTPU,
     PongTPU,
 )
 from actor_critic_algs_on_tensorflow_tpu.envs.reacher import (  # noqa: F401
@@ -41,6 +42,7 @@ _REGISTRY = {
     "BreakoutTPU-v0": BreakoutTPU,
     "CartPole-v1": CartPole,
     "Pendulum-v1": Pendulum,
+    "PongServeTPU-v0": PongServeTPU,
     "PongTPU-v0": PongTPU,
     "ReacherTPU-v0": ReacherTPU,
 }

@@ -234,3 +234,71 @@ class PongTPU(JaxEnv[PongState, PongParams]):
 
     def action_space(self, params):
         return Discrete(6)
+
+
+class PongServeTPU(PongTPU):
+    """PongTPU with resets oversampling the residual-flaw states.
+
+    The r3 concession taxonomy (PERF.md "Where the learned policy's
+    residual concessions come from") names the two remaining flaw
+    classes of the deep-fine-tuned policy: (1) post-score serves
+    conceded because the policy camps at its preferred ace row instead
+    of recentering — the conceding state is (paddle far from arrival
+    row, serve incoming); (2) fast-diagonal rally returns (|vy|
+    1.7-2.0) missed outright. Both are RARE under standard play (~21
+    concessions per 512k greedy steps), so their gradient signal is
+    diluted ~1e-5 at the 131k-sample batch — this env makes them the
+    EPISODE-START distribution instead:
+
+      50% standard reset (anchor: keep the base distribution present),
+      25% adversarial SERVE: paddle row uniform over its full travel
+          (covers the camped rows), ball served toward the agent from
+          center with y uniform over the full court and vy uniform
+          over ±max_ball_vy (vs the in-game serve's ±1),
+      25% adversarial RALLY: ball mid-flight in the right half-court
+          heading at the agent, |vx| uniform up to max_ball_vx and vy
+          uniform over ±max_ball_vy — the fast-diagonal class.
+
+    Dynamics (``step``) are IDENTICAL to PongTPU — only the reset
+    distribution differs — so a policy fine-tuned here transfers to
+    the standard env without re-calibration, and evals stay on
+    PongTPU-v0.
+    """
+
+    name = "PongServeTPU-v0"
+
+    def reset(self, key, params):
+        f32 = jnp.float32
+        ph = f32(params.paddle_half)
+        h, w = f32(params.height), f32(params.width)
+        k_mode, k_std, k_pad, k_y, k_vy, k_x, k_vx = jax.random.split(key, 7)
+
+        state, _ = super().reset(k_std, params)
+
+        u = jax.random.uniform(k_mode, ())
+        adversarial = u >= 0.5
+        rally = u >= 0.75
+
+        pad_y = jax.random.uniform(k_pad, (), f32, ph, h - 1.0 - ph)
+        ball_y = jax.random.uniform(k_y, (), f32, ph, h - 1.0 - ph)
+        vy = jax.random.uniform(
+            k_vy, (), f32, -params.max_ball_vy, params.max_ball_vy
+        )
+        # Serve mode: center-court launch at base speed (a serve);
+        # rally mode: mid-flight in the right half at rally speeds.
+        serve_x = w / 2.0
+        rally_x = jax.random.uniform(k_x, (), f32, w / 2.0, w - 8.0)
+        rally_vx = jax.random.uniform(
+            k_vx, (), f32, params.ball_speed, params.max_ball_vx
+        )
+        adv_state = state.replace(
+            agent_y=pad_y,
+            ball_x=jnp.where(rally, rally_x, serve_x),
+            ball_y=ball_y,
+            ball_vx=jnp.where(rally, rally_vx, params.ball_speed),
+            ball_vy=vy,
+            opp_y=h / 2.0,
+        )
+        pick = lambda a, s: jnp.where(adversarial, a, s)
+        state = jax.tree_util.tree_map(pick, adv_state, state)
+        return state, self._obs(state, params)

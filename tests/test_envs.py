@@ -338,3 +338,49 @@ def test_reacher_vectorized_rollout():
     assert bool(jnp.all(rews <= 0.0))
     # two truncations per env in 120 steps of 50-step episodes
     assert float(dones.sum(0).min()) >= 2.0
+
+
+def test_pong_serve_env_reset_mixture():
+    """PongServeTPU's resets cover the concession-taxonomy states
+    (paddle rows far from center, serves/rallies toward the agent,
+    |vy| beyond the standard serve's +-1) while keeping dynamics and
+    half its resets identical to PongTPU."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from actor_critic_algs_on_tensorflow_tpu.envs import PongServeTPU, PongTPU
+
+    env, std = PongServeTPU(), PongTPU()
+    params = env.default_params()
+    keys = jax.random.split(jax.random.PRNGKey(0), 512)
+    states = jax.vmap(lambda k: env.reset(k, params)[0])(keys)
+    pads = np.asarray(states.agent_y)
+    vys = np.asarray(states.ball_vy)
+    vxs = np.asarray(states.ball_vx)
+    bxs = np.asarray(states.ball_x)
+    # Adversarial serves/rallies put the paddle well outside the
+    # standard reset's fixed mid row (42) — including the camped ace
+    # rows (~12-18) and the bottom rows the taxonomy names.
+    assert pads.min() < 15.0 and pads.max() > 70.0
+    assert (pads == params.height / 2.0).mean() > 0.3  # standard anchor
+    # Fast diagonals: |vy| beyond the standard serve's +-1 range.
+    assert np.abs(vys).max() > 1.5
+    # Rally mode: mid-flight right-half balls at super-serve speeds.
+    assert (vxs > params.ball_speed + 0.1).any()
+    assert bxs.max() > params.width / 2.0 + 5.0
+    # All adversarial balls head TOWARD the agent or are standard
+    # serves (standard resets may serve either way).
+    toward_opp = vxs < 0.0
+    assert (bxs[toward_opp] == params.width / 2.0).all()
+
+    # Dynamics are untouched: stepping the same state with the same
+    # key/action matches PongTPU bit for bit.
+    s0, _ = std.reset(jax.random.PRNGKey(7), params)
+    k = jax.random.PRNGKey(8)
+    out_a = env.step(k, s0, jnp.int32(3), params)
+    out_b = std.step(k, s0, jnp.int32(3), params)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(out_a), jax.tree_util.tree_leaves(out_b)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
