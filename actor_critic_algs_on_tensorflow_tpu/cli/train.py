@@ -194,7 +194,11 @@ PRESETS = {
     # at width 64) — ~405-437k env-steps/s vs 159k, actors+learner
     # sharing one v5e chip. avg_return reaches 19+ within the 25M
     # budget (~60 s wall-clock; seeds 0/1: 19.3 @ 17.7M, 19-19.5
-    # @ 24-25M).
+    # @ 24-25M). LONG budgets (>25M): the constant-lr deep-queue
+    # schedule shows recurring transient dips (r3 probe); add
+    # --set lr_decay=True --set queue_size=2 — 2x50M r4 probes hold
+    # the plateau with zero sub-15 windows past onset+2M and final
+    # windows 20.3-21 (PERF.md "Long-budget stabilization").
     "impala-pong": (
         "impala",
         {
@@ -428,22 +432,37 @@ def _finalize_checkpointer(checkpointer, env_steps: int, state) -> None:
     checkpointer.close()
 
 
-def format_return_hist(per_env) -> str | None:
-    """Per-episode return distribution line, when compact enough to be
-    readable (integer-valued scores like Pong's -21..21): the evidence
-    format PERF.md's reward-21 analysis uses. None for float-valued or
-    high-cardinality returns."""
+def format_return_hist(per_env) -> str:
+    """Per-episode return distribution line.
+
+    Integer-valued scores (Pong's -21..21) print exact counts — the
+    evidence format PERF.md's reward-21 analysis uses. Float-valued
+    returns (MuJoCo) print 8 equal-width bins over [min, max] so
+    multi-modal outcomes (e.g. Humanoid falls vs full survivals) are
+    visible instead of hidden behind a mean (VERDICT r3 next#3)."""
     import collections
 
     rounded = per_env.round().astype(int)
-    if not (abs(per_env - rounded) < 1e-6).all():
-        return None
-    hist = collections.Counter(rounded.tolist())
-    if len(hist) > 32:
-        return None
-    return "[eval] return_hist " + " ".join(
-        f"{k}:{v}" for k, v in sorted(hist.items())
-    )
+    if (abs(per_env - rounded) < 1e-6).all():
+        hist = collections.Counter(rounded.tolist())
+        if len(hist) <= 32:
+            return "[eval] return_hist " + " ".join(
+                f"{k}:{v}" for k, v in sorted(hist.items())
+            )
+    lo, hi = float(per_env.min()), float(per_env.max())
+    if hi <= lo:
+        return f"[eval] return_hist {lo:.0f}:{len(per_env)}"
+    import numpy as np
+
+    counts, edges = np.histogram(per_env, bins=8, range=(lo, hi))
+    cells = [
+        # np.histogram's bins are half-open except the LAST, which is
+        # closed (it contains the max) — label it to match.
+        f"[{edges[i]:.0f},{edges[i + 1]:.0f}{']' if i == len(counts) - 1 else ')'}:{c}"
+        for i, c in enumerate(counts)
+        if c
+    ]
+    return "[eval] return_hist " + " ".join(cells)
 
 
 def _run(args, algo, cfg, writer) -> int:

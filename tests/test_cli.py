@@ -214,7 +214,15 @@ def test_eval_return_hist_formatting():
     # Integer-valued, compact: one count per distinct value, sorted.
     line = format_return_hist(np.asarray([21.0, 19.0, 21.0, 20.0]))
     assert line == "[eval] return_hist 19:1 20:1 21:2"
-    # Float-valued returns: no hist.
-    assert format_return_hist(np.asarray([-1422.4, -1266.3])) is None
-    # High-cardinality integers: no hist.
-    assert format_return_hist(np.arange(40.0)) is None
+    # Float-valued returns (MuJoCo): 8 equal-width bins, empty bins
+    # dropped, LAST bin closed (it holds the max).
+    line = format_return_hist(np.asarray([-1422.4, -1266.3]))
+    assert line == "[eval] return_hist [-1422,-1403):1 [-1286,-1266]:1"
+    # High-cardinality integers take the binned path too.
+    line = format_return_hist(np.arange(40.0))
+    assert line.startswith("[eval] return_hist [0,5):5")
+    assert line.endswith("[34,39]:5")
+    # Every episode at the same return: a single degenerate cell.
+    assert format_return_hist(np.asarray([-7.0, -7.0])) == (
+        "[eval] return_hist -7:2"
+    )
