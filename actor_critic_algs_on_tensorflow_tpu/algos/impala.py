@@ -138,6 +138,20 @@ class ParamStore:
         return self._params
 
 
+def _cpu_mesh_exec_lock(mesh) -> threading.Lock | None:
+    """Shared-execution lock for multi-device CPU meshes, else None.
+
+    Same predicate as ``common.run_loop``'s serialize guard: XLA's
+    in-process CPU communicator intermittently aborts when collectives
+    from multiple in-flight executions interleave, so every jitted
+    dispatch must run to completion under one lock there. Real TPU
+    meshes return None and overlap freely (the design point).
+    """
+    if jax.default_backend() == "cpu" and device_count(mesh) > 1:
+        return threading.Lock()
+    return None
+
+
 class ImpalaActor(threading.Thread):
     """One async actor: rollout with the newest snapshot, enqueue."""
 
@@ -150,6 +164,7 @@ class ImpalaActor(threading.Thread):
         out_queue: TrajectoryQueue,
         halt: threading.Event,
         seed: int,
+        exec_lock: threading.Lock | None = None,
     ):
         super().__init__(name=f"impala-actor-{actor_id}", daemon=True)
         self.actor_id = actor_id
@@ -159,10 +174,26 @@ class ImpalaActor(threading.Thread):
         self._queue = out_queue
         # NB: name must not shadow threading.Thread._stop
         self._halt = halt
+        # XLA's in-process CPU communicator intermittently aborts the
+        # process when collectives from multiple in-flight executions
+        # interleave (same failure class run_loop serializes against).
+        # On a multi-device CPU mesh every jitted dispatch therefore
+        # runs to completion under this shared lock; on real TPU
+        # meshes exec_lock is None and actors overlap the learner
+        # freely (the design point).
+        self._exec_lock = exec_lock
         self._key = jax.random.PRNGKey(seed)
         self.rollouts = 0
         self.error: BaseException | None = None
         self._inject_fault = threading.Event()
+
+    def _run_serialized(self, fn, *args):
+        if self._exec_lock is None:
+            return fn(*args)
+        with self._exec_lock:
+            out = fn(*args)
+            jax.block_until_ready(out)
+            return out
 
     def inject_fault(self) -> None:
         """Make the next rollout raise (fault-injection testing,
@@ -172,7 +203,7 @@ class ImpalaActor(threading.Thread):
     def run(self) -> None:
         try:
             self._key, k = jax.random.split(self._key)
-            env_state, obs = self._reset(k)
+            env_state, obs = self._run_serialized(self._reset, k)
             while not self._halt.is_set():
                 if self._inject_fault.is_set():
                     raise RuntimeError(
@@ -180,8 +211,8 @@ class ImpalaActor(threading.Thread):
                     )
                 params = self._store.snapshot()
                 self._key, k = jax.random.split(self._key)
-                env_state, obs, traj, ep = self._rollout(
-                    params, env_state, obs, k
+                env_state, obs, traj, ep = self._run_serialized(
+                    self._rollout, params, env_state, obs, k
                 )
                 while not self._halt.is_set():
                     try:
@@ -467,12 +498,15 @@ def _learner_loop(
     summary_writer,
     checkpointer=None,
     checkpoint_interval: int = 200,
+    exec_lock: threading.Lock | None = None,
 ) -> Tuple[LearnerState, List[Tuple[int, Dict[str, float]]]]:
     """Shared learner loop of the in-process and cross-process modes.
 
     ``publish(params)`` broadcasts weights; ``check_health(it)`` is
     called on every queue poll (restart/raise on dead actors, inject
     faults); ``extra_metrics()`` contributes mode-specific scalars.
+    ``exec_lock`` (CPU-mesh mode only) serializes the learner's
+    dispatches against the actor threads' — see ImpalaActor.
     """
     from actor_critic_algs_on_tensorflow_tpu.utils.metrics import (
         device_get_metrics,
@@ -506,8 +540,14 @@ def _learner_loop(
                 continue
             trajs.append(traj)
             eps.append(ep)
-        batch = stack_trajectories(trajs)
-        state, metrics = learner_step(state, batch)
+        if exec_lock is None:
+            batch = stack_trajectories(trajs)
+            state, metrics = learner_step(state, batch)
+        else:
+            with exec_lock:
+                batch = stack_trajectories(trajs)
+                state, metrics = learner_step(state, batch)
+                jax.block_until_ready(metrics)
         env_steps = steps_done0 + (i + 1) * steps_per_batch
         if (it + 1) % cfg.publish_interval == 0:
             publish(state.params)
@@ -582,11 +622,17 @@ def run_impala(
     stop = threading.Event()
     restarts = 0
     injected = False
+    # See ImpalaActor._run_serialized: the virtual multi-device CPU
+    # mesh cannot tolerate actor dispatches interleaving the learner's
+    # collectives, so all executions share one lock there (real TPU
+    # meshes run lock-free).
+    exec_lock = _cpu_mesh_exec_lock(mesh)
 
     def spawn(i: int, generation: int) -> ImpalaActor:
         a = ImpalaActor(
             i, *make_actor_programs(i), store, q, stop,
             seed=cfg.seed * 10_000 + generation * 1_000 + i,
+            exec_lock=exec_lock,
         )
         a.start()
         return a
@@ -629,6 +675,7 @@ def run_impala(
             summary_writer=summary_writer,
             checkpointer=checkpointer,
             checkpoint_interval=checkpoint_interval,
+            exec_lock=exec_lock,
         )
     finally:
         stop.set()
@@ -817,6 +864,10 @@ def run_impala_distributed(
             summary_writer=summary_writer,
             checkpointer=checkpointer,
             checkpoint_interval=checkpoint_interval,
+            # No actor threads here, but a multi-device CPU learner
+            # must still retire each collective-bearing step before
+            # the next dispatch (run_loop's serialize rule).
+            exec_lock=_cpu_mesh_exec_lock(mesh),
         )
     finally:
         closing.set()
