@@ -233,6 +233,10 @@ PRESETS = {
     # correction (the correction="none" mode of the IMPALA topology).
     # Same r3 schedule fix as impala-cartpole (small frequent
     # updates): 298 @ 1M (solved), vs 39 on the old batch-8 defaults.
+    # r4 sweep: on the r3 batch=1 schedule, lr 2e-3 dominates 1e-3 —
+    # final windows 500/500/362 across seeds 0/1/2 (500 = the env
+    # cap) vs 298; 1.5e-3 scored 304 (500 with ent 0.005), 1e-3+ent
+    # 0.005 scored 253.
     "a3c-cartpole": (
         "impala",
         {
@@ -241,7 +245,7 @@ PRESETS = {
             "correction": "none",
             "total_env_steps": 1_000_000,
             "batch_trajectories": 1,
-            "lr": 1e-3,
+            "lr": 2e-3,
             "num_devices": 1,  # see impala-cartpole
         },
     ),
