@@ -188,17 +188,26 @@ PRESETS = {
         },
     ),
     # 7. IMPALA on the Atari-class on-device Pong: the async
-    # actor-learner path solving the headline task. r2 actor-width
-    # sweep: ONE 256-env actor at the same ~8k-step learner batch
-    # keeps the rollout conv MXU-fed (the r1 2x64 config starved it
-    # at width 64) — ~405-437k env-steps/s vs 159k, actors+learner
-    # sharing one v5e chip. avg_return reaches 19+ within the 25M
-    # budget (~60 s wall-clock; seeds 0/1: 19.3 @ 17.7M, 19-19.5
-    # @ 24-25M). LONG budgets (>25M): the constant-lr deep-queue
-    # schedule shows recurring transient dips (r3 probe); add
-    # --set lr_decay=True --set queue_size=2 — 2x50M r4 probes hold
-    # the plateau with zero sub-15 windows past onset+2M and final
-    # windows 20.3-21 (PERF.md "Long-budget stabilization").
+    # actor-learner path solving the headline task. Topology from the
+    # r2 actor-width sweep: ONE 256-env actor at the same ~8k-step
+    # learner batch keeps the rollout conv MXU-fed (the r1 2x64
+    # config starved it at width 64; the deep-queue config measured
+    # ~405-437k env-steps/s under r2/r3 tunnel conditions, vs 159k).
+    # r4 flipped the preset to the STABLE schedule (linear lr decay +
+    # queue_size=2, i.e. off-policy lag bounded at ~2 batches): under
+    # end-of-round tunnel actor throughput (~240k steps/s, where the
+    # deep-queue speed edge is gone — both schedules measure
+    # 225-299k) the old constant-lr deep-queue schedule landed its
+    # final 25M window inside a transient dip in 2 of 5 re-runs
+    # (-17/-1.7), while the stable schedule reaches the plateau
+    # FASTER (onset 8.8-11.1M vs 13.9-14.4M) and finals
+    # 20.17/20.0/20.0 across three seeds; the 3x50M probes show zero
+    # sub-15 windows past onset+2M (PERF.md "Long-budget
+    # stabilization"). Constant lr + queue_size=16 remains available
+    # via --set. RESUMING a pre-r4 checkpoint: pass
+    # --set lr_decay=False --set queue_size=16 — the schedule change
+    # alters the optimizer-state layout, and a grafted restore would
+    # silently restart the decay horizon.
     "impala-pong": (
         "impala",
         {
@@ -211,7 +220,8 @@ PRESETS = {
             "rollout_length": 32,
             "batch_trajectories": 1,
             "lr": 1e-3,
-            "lr_decay": False,
+            "lr_decay": True,
+            "queue_size": 2,
             "ent_coef": 0.01,
             "total_env_steps": 25_000_000,
         },
