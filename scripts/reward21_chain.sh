@@ -1,0 +1,74 @@
+#!/usr/bin/env bash
+# Regenerate the PERF.md "reward-21" fine-tune chain from scratch.
+#
+# This encodes, as runnable commands, the stage recipe recorded in
+# PERF.md "The reward-21 question" (shipped presets + --resume/--set
+# overrides only). Resume is loss-curve-deterministic, so re-running
+# the script reproduces the chain; 512-episode evals of regenerated
+# checkpoints land within sampling noise of the recorded table (the
+# r3 from-scratch regeneration measured 475/512 perfect at 1B vs the
+# original 490/512 — see the PERF.md reproducibility note).
+#
+# Wall-clock: ~2.5-3h on one v5e chip (2.4B env steps total; the
+# mb=1 stages run ~350-370k steps/s, the mb=4 fine-tune stages
+# ~240-275k).
+#
+# Usage: scripts/reward21_chain.sh [checkpoint-dir]   (default runs/pong21)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+CKPT=${1:-runs/pong21}
+SERVE=${CKPT}-serve24
+PY=${PYTHON:-python}
+
+# A leftover chain dir would make every --resume stage restore the OLD
+# final checkpoint (orbax latest_step >= each stage's budget => zero
+# iterations trained) and "regenerate" nothing. Refuse rather than
+# silently no-op or delete ~3h of compute.
+for d in "$CKPT" "$SERVE"; do
+  if [ -e "$d" ]; then
+    echo "error: $d already exists — move it aside (or pass a fresh" >&2
+    echo "checkpoint-dir) to regenerate the chain from scratch" >&2
+    exit 2
+  fi
+done
+
+run() { "$PY" train.py --preset ppo-pong --seed 0 --checkpoint-dir "$CKPT" "$@"; }
+
+# Stage 1 — shipped preset, 25M: whole-batch epochs, lr 8e-3.
+# Recorded eval: greedy 20.73, 386/512 perfect.
+run
+# Stage 2 — +10M anneal (lr 1e-3, ent 1e-3). Recorded: 20.83, 433/512.
+run --resume --total-steps  35000000 --set lr=1e-3 --set ent_coef=1e-3
+# Stage 3 — anneal to 100M (lr 2e-4, ent 0). Recorded: 20.85, 446/512.
+run --resume --total-steps 100000000 --set lr=2e-4 --set ent_coef=0.0
+# Stage 4 — 4-minibatch fine-tune to 200M (lr 1e-4). Recorded: 20.89, 464/512.
+run --resume --total-steps 200000000 \
+    --set num_minibatches=4 --set lr=1e-4 --set ent_coef=0.0
+# Stage 5 — fine-tune to 500M (same schedule). Recorded: 20.93, 481/512.
+run --resume --total-steps 500000000 \
+    --set num_minibatches=4 --set lr=1e-4 --set ent_coef=0.0
+# Stage 6 — fine-tune to 1B (lr 5e-5, ent 5e-4). Recorded: 20.95, 490/512
+# (original chain; the r3 regenerated chain drew 475/512 here).
+run --resume --total-steps 1000000000 \
+    --set num_minibatches=4 --set lr=5e-5 --set ent_coef=5e-4
+# Stage 7 — fine-tune to 2B (same schedule). Recorded: 20.97, 498/512, min 20.
+run --resume --total-steps 2000000000 \
+    --set num_minibatches=4 --set lr=5e-5 --set ent_coef=5e-4
+
+# Stage 8 — TARGETED serve-state fine-tune (VERDICT r3 next#4): +400M
+# steps on PongServeTPU-v0 (resets oversampling the two residual
+# concession classes; dynamics identical, so the policy transfers and
+# is still evaluated on the STANDARD env). The plain 2B chain in
+# $CKPT is left unmodified. Recorded: greedy 20.99, 506/512, min 20
+# (hist 20:6 21:506); stochastic 20.97, 498/512.
+cp -r "$CKPT" "$SERVE"
+"$PY" train.py --preset ppo-pong --seed 0 --checkpoint-dir "$SERVE" \
+    --resume --env PongServeTPU-v0 --total-steps 2400000000 \
+    --set num_minibatches=4 --set lr=5e-5 --set ent_coef=5e-4
+
+# 512-episode evals of the final artifact on the standard env.
+"$PY" train.py --preset ppo-pong --checkpoint-dir "$SERVE" \
+    --eval --eval-envs 512 --eval-steps 8000
+"$PY" train.py --preset ppo-pong --checkpoint-dir "$SERVE" \
+    --eval --eval-envs 512 --eval-steps 8000 --stochastic

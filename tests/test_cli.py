@@ -204,6 +204,52 @@ def test_cli_td3_train_then_eval(tmp_path, capsys):
     assert "[eval] avg_return=" in out
 
 
+@pytest.mark.slow
+def test_cli_finetune_chain_semantics(tmp_path, capsys):
+    """The reward-21 chain's stage transitions (scripts/reward21_chain.sh)
+    at tiny scale: resume across a num_minibatches/lr/ent_coef schedule
+    change, then resume the copied checkpoint with the env switched to
+    PongServeTPU-v0 (identical dynamics/spaces, adversarial resets),
+    then eval on the STANDARD env."""
+    import shutil
+
+    ck, serve = tmp_path / "ck", tmp_path / "serve"
+    common = [
+        "--preset", "ppo-pong", "--seed", "0",
+        "--set", "num_envs=4", "--set", "rollout_length=8",
+        "--set", "num_devices=1", "--log-interval", "100",
+    ]
+    assert cli.main(
+        common + ["--checkpoint-dir", str(ck), "--total-steps", "64"]
+    ) == 0
+    # Stage-4-style schedule change on resume: optimizer state restores
+    # across it (mb/lr/ent live in the jitted update, not the state).
+    assert cli.main(
+        common + ["--checkpoint-dir", str(ck), "--resume",
+                  "--total-steps", "128",
+                  "--set", "num_minibatches=4", "--set", "lr=1e-4",
+                  "--set", "ent_coef=0.0"]
+    ) == 0
+    out = capsys.readouterr().out
+    assert "resumed from step" in out
+    # Stage-8-style targeted fine-tune: copy the chain, switch envs.
+    shutil.copytree(ck, serve)
+    assert cli.main(
+        common + ["--checkpoint-dir", str(serve), "--resume",
+                  "--env", "PongServeTPU-v0", "--total-steps", "192",
+                  "--set", "num_minibatches=4", "--set", "lr=1e-4"]
+    ) == 0
+    # Eval the fine-tuned artifact on the standard env (the preset's).
+    assert cli.main(
+        ["--preset", "ppo-pong", "--set", "num_envs=4",
+         "--set", "rollout_length=8", "--set", "num_devices=1",
+         "--checkpoint-dir", str(serve),
+         "--eval", "--eval-envs", "4", "--eval-steps", "64"]
+    ) == 0
+    out = capsys.readouterr().out
+    assert "[eval] avg_return=" in out
+
+
 def test_eval_return_hist_formatting():
     import numpy as np
 
