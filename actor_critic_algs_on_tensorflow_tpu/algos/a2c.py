@@ -56,6 +56,12 @@ class A2CConfig:
     ent_coef: float = 0.01
     max_grad_norm: float = 0.5
     normalize_adv: bool = False
+    # Recurrent (LSTM) policy (models.RecurrentActorCritic); A2C's
+    # whole-batch update replays the full [T, B] sequence, so no
+    # minibatch constraints apply — but time_limit_bootstrap must be
+    # off (V(final_obs) would need the per-step carry).
+    recurrent: bool = False
+    lstm_size: int = 128
     # Bootstrap truncated (time-limit) episodes from V(final_obs)
     # instead of treating them as terminal (see ops.gae). Costs an
     # extra [T, B, obs] buffer + value forward; disable for image envs.
@@ -85,12 +91,26 @@ def make_a2c(cfg: A2CConfig) -> common.IterationFns:
         cfg.env, num_envs=cfg.num_envs, frame_stack=cfg.frame_stack
     )
     action_space = env.action_space(env_params)
-    model = DiscreteActorCritic(
-        num_actions=action_space.n,
-        torso=cfg.torso,
-        hidden_sizes=cfg.hidden_sizes,
-        dtype=jnp.dtype(cfg.compute_dtype),
-    )
+    if cfg.recurrent:
+        if cfg.time_limit_bootstrap:
+            raise ValueError(
+                "recurrent A2C requires time_limit_bootstrap=False "
+                "(V(final_obs) would need the per-step carry)"
+            )
+        model, seq_dist_value = common.make_recurrent_policy_head(
+            action_space,
+            torso=cfg.torso,
+            hidden_sizes=cfg.hidden_sizes,
+            lstm_size=cfg.lstm_size,
+            compute_dtype=cfg.compute_dtype,
+        )
+    else:
+        model = DiscreteActorCritic(
+            num_actions=action_space.n,
+            torso=cfg.torso,
+            hidden_sizes=cfg.hidden_sizes,
+            dtype=jnp.dtype(cfg.compute_dtype),
+        )
 
     num_iters = max(1, cfg.total_env_steps // (cfg.num_envs * cfg.rollout_length))
     if cfg.lr_decay:
@@ -111,7 +131,18 @@ def make_a2c(cfg: A2CConfig) -> common.IterationFns:
     def init(key: jax.Array) -> common.OnPolicyState:
         k_env, k_model = jax.random.split(key)
         env_state, obs = genv.reset(k_env, env_params)
-        params = model.init(k_model, obs[:1])
+        if cfg.recurrent:
+            params = model.init(
+                k_model, obs[:1][None], jnp.zeros((1, 1)),
+                model.initialize_carry(1),
+            )
+            carry = {
+                "lstm": model.initialize_carry(cfg.num_envs),
+                "prev_done": jnp.zeros((cfg.num_envs,), jnp.float32),
+            }
+        else:
+            params = model.init(k_model, obs[:1])
+            carry = None
         state = common.OnPolicyState(
             params=params,
             opt_state=tx.init(params),
@@ -119,6 +150,7 @@ def make_a2c(cfg: A2CConfig) -> common.IterationFns:
             obs=obs,
             key=key,
             step=jnp.zeros((), jnp.int32),
+            carry=carry,
         )
         return put_by_specs(state, common.state_specs(state), mesh)
 
@@ -183,9 +215,74 @@ def make_a2c(cfg: A2CConfig) -> common.IterationFns:
         )
         return new_state, metrics
 
+    def local_iteration_recurrent(state: common.OnPolicyState):
+        """Recurrent A2C iteration: the whole-batch update replays the
+        full [T, B] sequence from the rollout-entry carry."""
+        dev = jax.lax.axis_index(DATA_AXIS)
+        it_key = prng.fold(state.key, state.step, dev)
+
+        carry0 = state.carry
+        env_state, obs, carry1, traj, ep_info = (
+            common.collect_rollout_recurrent(
+                env, env_params, seq_dist_value, state.params,
+                state.env_state, state.obs, carry0, it_key,
+                cfg.rollout_length,
+            )
+        )
+        _, last_value_tb, _ = seq_dist_value(
+            state.params, obs[None], carry1["prev_done"][None],
+            carry1["lstm"],
+        )
+        advantages, returns = gae_advantages(
+            traj.rewards, traj.values, traj.dones, last_value_tb[0],
+            gamma=cfg.gamma, lam=cfg.gae_lambda,
+            terminations=ep_info["terminated"],
+            truncation_values=None,
+            use_pallas=cfg.use_pallas_scan,
+        )
+        if cfg.normalize_adv:
+            advantages = common.global_normalize_advantages(advantages)
+        resets_tb = common.replay_resets(carry0["prev_done"], traj.dones)
+
+        def loss_fn(params):
+            dist, values, _ = seq_dist_value(
+                params, traj.obs, resets_tb, carry0["lstm"]
+            )
+            pg = policy_gradient_loss(
+                dist.log_prob(traj.actions), advantages
+            )
+            vf = value_loss(values, returns)
+            ent = dist.entropy().mean()
+            total = pg + cfg.vf_coef * vf - cfg.ent_coef * ent
+            return total, (pg, vf, ent)
+
+        (loss, (pg, vf, ent)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        grads = jax.lax.pmean(grads, DATA_AXIS)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+
+        metrics = jax.lax.pmean(
+            {"loss": loss, "policy_loss": pg, "value_loss": vf, "entropy": ent},
+            DATA_AXIS,
+        )
+        metrics.update(common.episode_metrics(ep_info))
+
+        return common.OnPolicyState(
+            params=params,
+            opt_state=opt_state,
+            env_state=env_state,
+            obs=obs,
+            key=state.key,
+            step=state.step + 1,
+            carry=carry1,
+        ), metrics
+
     example = jax.eval_shape(init, jax.random.PRNGKey(0))
     iteration = common.build_data_parallel_iteration(
-        local_iteration, example, mesh
+        local_iteration_recurrent if cfg.recurrent else local_iteration,
+        example, mesh,
     )
     return common.IterationFns(
         init=init,

@@ -26,6 +26,7 @@ from actor_critic_algs_on_tensorflow_tpu.data.rollout import Trajectory
 from actor_critic_algs_on_tensorflow_tpu.models import (
     DiscreteActorCritic,
     GaussianActorCritic,
+    RecurrentActorCritic,
 )
 from actor_critic_algs_on_tensorflow_tpu.ops import Categorical, DiagGaussian
 from actor_critic_algs_on_tensorflow_tpu.utils import profiling
@@ -59,6 +60,10 @@ class OnPolicyState:
     key: jax.Array
     step: jax.Array  # iteration counter; env steps = step * steps_per_iteration
     extra: Any = None
+    # Recurrent policies only: {"lstm": (c, h) each [B, lstm], "prev_done":
+    # [B]} — the policy state entering the NEXT rollout step (sharded on
+    # the env axis like obs). None for feed-forward policies.
+    carry: Any = None
 
 
 def state_specs(state: OnPolicyState) -> OnPolicyState:
@@ -71,6 +76,7 @@ def state_specs(state: OnPolicyState) -> OnPolicyState:
         key=P(),
         step=P(),
         extra=replicated_specs(state.extra),
+        carry=shard_batch_specs(state.carry),
     )
 
 
@@ -160,6 +166,110 @@ def make_policy_head(action_space, *, torso, hidden_sizes, compute_dtype):
         return DiagGaussian(mean, log_std), value
 
     return model, dist_and_value
+
+
+def make_recurrent_policy_head(
+    action_space, *, torso, hidden_sizes, lstm_size, compute_dtype
+):
+    """(model, seq_dist_value) for a recurrent (LSTM) discrete policy.
+
+    ``seq_dist_value(params, obs_tb, resets_tb, carry)`` runs the
+    time-major sequence forward: obs ``[T, B, ...]``, resets ``[T, B]``
+    (1.0 where step t begins a new episode), carry ``(c, h)``; returns
+    ``(Categorical over [T, B], values [T, B], new_carry)``. Single-step
+    collection/eval is the ``T == 1`` case of the same function.
+    """
+    if not hasattr(action_space, "n"):
+        raise ValueError(
+            "recurrent policies support discrete action spaces only "
+            "(the continuous head is the MLP GaussianActorCritic); "
+            "use recurrent=False for continuous-control envs"
+        )
+    model = RecurrentActorCritic(
+        num_actions=action_space.n,
+        torso=torso,
+        hidden_sizes=hidden_sizes,
+        lstm_size=lstm_size,
+        dtype=jnp.dtype(compute_dtype),
+    )
+
+    def seq_dist_value(params, obs_tb, resets_tb, carry):
+        logits, values, carry = model.apply(params, obs_tb, resets_tb, carry)
+        return Categorical(logits), values, carry
+
+    return model, seq_dist_value
+
+
+def collect_rollout_recurrent(
+    env,
+    env_params,
+    seq_dist_value,
+    params,
+    env_state,
+    obs,
+    carry,
+    key: jax.Array,
+    length: int,
+    *,
+    norm=None,
+):
+    """Recurrent analog of :func:`collect_rollout`.
+
+    ``carry`` is the state's ``{"lstm": (c, h), "prev_done": [B]}``
+    policy-state bundle; each step feeds ``prev_done`` as the reset mask
+    (the LSTM state is zeroed inside the cell where an episode just
+    ended), calls the ``T == 1`` sequence forward, and threads the new
+    cell state. Returns ``(env_state, obs, carry, traj, ep_info)`` with
+    ``carry`` ready for the next rollout (and, unchanged in ``traj``,
+    everything the update needs to REPLAY the sequence: the caller keeps
+    the rollout-entry carry for that).
+    """
+    norm = norm if norm is not None else (lambda o: o)
+
+    def _step(scan_carry, step_key):
+        env_state, obs, lstm, prev_done = scan_carry
+        k_act, k_env = jax.random.split(step_key)
+        dist, value, lstm = seq_dist_value(
+            params, norm(obs)[None], prev_done[None], lstm
+        )
+        action = dist.sample(k_act)[0]
+        log_prob = dist.log_prob(action[None])[0]
+        env_state, next_obs, reward, done, info = env.step(
+            k_env, env_state, action, env_params
+        )
+        traj = Trajectory(
+            obs=obs,
+            actions=action,
+            rewards=reward,
+            dones=done,
+            log_probs=log_prob,
+            values=value[0],
+        )
+        ep_info = {
+            "episode_return": info["episode_return"],
+            "done_episode": info["done_episode"],
+            "terminated": info["terminated"],
+        }
+        return (env_state, next_obs, lstm, done), (traj, ep_info)
+
+    keys = jax.random.split(key, length)
+    (env_state, obs, lstm, prev_done), (traj, ep_info) = jax.lax.scan(
+        _step, (env_state, obs, carry["lstm"], carry["prev_done"]), keys
+    )
+    return (
+        env_state,
+        obs,
+        {"lstm": lstm, "prev_done": prev_done},
+        traj,
+        ep_info,
+    )
+
+
+def replay_resets(entry_prev_done, dones):
+    """Reset mask ``[T, B]`` for replaying a collected rollout: step 0
+    resets where the rollout ENTERED on an episode boundary; step t > 0
+    where step t-1 ended an episode."""
+    return jnp.concatenate([entry_prev_done[None], dones[:-1]], axis=0)
 
 
 def collect_rollout(
@@ -266,6 +376,7 @@ def evaluate(
     num_envs: int,
     max_steps: int = 1000,
     record: bool = False,
+    act_state=None,
 ):
     """Greedy/stochastic policy evaluation on a vectorized env.
 
@@ -275,15 +386,32 @@ def evaluate(
     With ``record=True`` returns a fourth element: env 0's per-step
     observations ``[max_steps, ...]`` plus its ``done`` flags
     ``[max_steps]`` (for trimming to the first episode).
+
+    ``act_state`` (recurrent policies): an initial per-env policy-state
+    pytree with leaves ``[num_envs, ...]``; ``act_fn`` then has the
+    stateful signature ``(obs, key, act_state) -> (actions, act_state)``
+    and the state is zeroed on episode boundaries here.
     """
 
     def _step(carry, k):
-        env_state, obs, done_seen, ep_ret = carry
+        env_state, obs, done_seen, ep_ret, ast = carry
         k_act, k_env = jax.random.split(k)
-        actions = act_fn(obs, k_act)
+        if act_state is None:
+            actions = act_fn(obs, k_act)
+        else:
+            actions, ast = act_fn(obs, k_act, ast)
         env_state, next_obs, _, done, info = env.step(
             k_env, env_state, actions, env_params
         )
+        if act_state is not None:
+            # Zero the policy state where an episode just ended, so the
+            # (auto-reset) next episode starts from a fresh carry.
+            ast = jax.tree_util.tree_map(
+                lambda x: x * (1.0 - done).reshape(
+                    (num_envs,) + (1,) * (x.ndim - 1)
+                ).astype(x.dtype),
+                ast,
+            )
         ep_ret = jnp.where(
             done_seen > 0.5,
             ep_ret,
@@ -291,7 +419,7 @@ def evaluate(
         )
         new_done_seen = jnp.maximum(done_seen, done)
         out = (obs[0], done_seen[0]) if record else None
-        return (env_state, next_obs, new_done_seen, ep_ret), out
+        return (env_state, next_obs, new_done_seen, ep_ret, ast), out
 
     k_reset, k_run = jax.random.split(key)
     env_state, obs = env.reset(k_reset, env_params)
@@ -300,8 +428,9 @@ def evaluate(
         obs,
         jnp.zeros(num_envs),
         jnp.zeros(num_envs),
+        act_state,
     )
-    (env_state, obs, done_seen, ep_ret), rec = jax.lax.scan(
+    (env_state, obs, done_seen, ep_ret, _), rec = jax.lax.scan(
         _step, init, jax.random.split(k_run, max_steps)
     )
     if record:

@@ -26,6 +26,7 @@ from actor_critic_algs_on_tensorflow_tpu.models import (
     DeterministicActor,
     DiscreteActorCritic,
     GaussianActorCritic,
+    RecurrentActorCritic,
     SquashedGaussianActor,
 )
 from actor_critic_algs_on_tensorflow_tpu.ops import (
@@ -36,13 +37,39 @@ from actor_critic_algs_on_tensorflow_tpu.ops import (
 )
 
 
-def _act_fn(algo: str, cfg, aspace, params, stochastic: bool, norm=None):
-    """Policy action function matching the trainer's architecture.
+def _act_fn(algo: str, cfg, aspace, params, stochastic: bool, norm=None,
+            num_envs: int = 1):
+    """``(act, act_state0)`` matching the trainer's architecture.
 
     ``norm`` preprocesses obs (e.g. the restored running-mean/std
     normalizer a normalize_obs=True PPO policy was trained with).
+    ``act_state0`` is ``None`` for feed-forward policies; recurrent
+    policies return their initial LSTM carry and a stateful ``act``
+    (see ``common.evaluate``).
     """
     norm = norm if norm is not None else (lambda o: o)
+    act_state0 = None
+    if algo in ("a2c", "ppo", "impala") and getattr(cfg, "recurrent", False):
+        model = RecurrentActorCritic(
+            num_actions=aspace.n,
+            torso=cfg.torso,
+            hidden_sizes=cfg.hidden_sizes,
+            lstm_size=cfg.lstm_size,
+            dtype=jnp.dtype(cfg.compute_dtype),
+        )
+        act_state0 = model.initialize_carry(num_envs)
+
+        def act(obs, key, carry):
+            # common.evaluate zeroes the carry on episode boundaries, so
+            # the in-call reset mask is constant zero.
+            logits, _, carry = model.apply(
+                params, norm(obs)[None], jnp.zeros((1, obs.shape[0])), carry
+            )
+            if stochastic:
+                return Categorical(logits).sample(key)[0], carry
+            return jnp.argmax(logits[0], axis=-1), carry
+
+        return act, act_state0
     if algo in ("a2c", "ppo", "impala"):
         if hasattr(aspace, "n"):
             model = DiscreteActorCritic(
@@ -86,7 +113,7 @@ def _act_fn(algo: str, cfg, aspace, params, stochastic: bool, norm=None):
             return jnp.tanh(mean) * scale
     else:
         raise ValueError(f"unknown algo {algo!r}")
-    return act
+    return act, act_state0
 
 
 def _make_init(algo: str, cfg):
@@ -171,15 +198,16 @@ def evaluate_checkpoint(
             else state.extra
         )
         norm = lambda o: rms_normalize(o, rms)
-    act = _act_fn(
+    act, act_state0 = _act_fn(
         algo, cfg, env.action_space(env_params), state.params, stochastic,
-        norm=norm,
+        norm=norm, num_envs=num_envs,
     )
     record = render_dir is not None
     out = jax.jit(
         lambda key: common.evaluate(
             env, env_params, act, key,
             num_envs=num_envs, max_steps=max_steps, record=record,
+            act_state=act_state0,
         )
     )(jax.random.PRNGKey(seed))
     if record:

@@ -95,6 +95,14 @@ class ImpalaConfig:
     max_actor_restarts: int = 2
     compute_dtype: str = "float32"  # "bfloat16" runs the torso on the MXU in bf16
     use_pallas_scan: bool = False   # fused Pallas VMEM kernel for V-trace
+    # Recurrent (LSTM) policy — the IMPALA-paper model family. Actors
+    # thread the carry across rollouts like env state; each trajectory
+    # ships its ENTRY carry and the learner replays the sequence from
+    # it with current params (stale-entry-state truncated BPTT, as in
+    # the paper). Discrete action spaces only; incompatible with
+    # time_shards > 1 (the LSTM replay needs the full local time axis).
+    recurrent: bool = False
+    lstm_size: int = 128
     # Shard the trajectory TIME axis over this many devices (learner
     # mesh becomes 2-D data x time; V-trace runs sequence-parallel via
     # ops.sequence_parallel). For rollouts too long for one device.
@@ -105,7 +113,12 @@ class ImpalaConfig:
 
 class ActorTrajectory(struct.PyTreeNode):
     """What an actor ships to the learner: time-major ``[T, B_env]``
-    fields plus the bootstrap observation after the last step."""
+    fields plus the bootstrap observation after the last step.
+
+    Recurrent policies additionally ship the policy state at rollout
+    ENTRY (``entry_lstm`` ``(c, h)`` each ``[B_env, lstm]`` and
+    ``entry_prev_done`` ``[B_env]``) so the learner can replay the
+    sequence from it; ``None`` for feed-forward policies."""
 
     obs: Any
     actions: jax.Array
@@ -113,6 +126,8 @@ class ActorTrajectory(struct.PyTreeNode):
     dones: jax.Array
     behaviour_log_probs: jax.Array
     last_obs: Any
+    entry_lstm: Any = None
+    entry_prev_done: Any = None
 
 
 @struct.dataclass
@@ -203,7 +218,7 @@ class ImpalaActor(threading.Thread):
     def run(self) -> None:
         try:
             self._key, k = jax.random.split(self._key)
-            env_state, obs = self._run_serialized(self._reset, k)
+            env_state, obs, carry = self._run_serialized(self._reset, k)
             while not self._halt.is_set():
                 if self._inject_fault.is_set():
                     raise RuntimeError(
@@ -211,8 +226,8 @@ class ImpalaActor(threading.Thread):
                     )
                 params = self._store.snapshot()
                 self._key, k = jax.random.split(self._key)
-                env_state, obs, traj, ep = self._run_serialized(
-                    self._rollout, params, env_state, obs, k
+                env_state, obs, carry, traj, ep = self._run_serialized(
+                    self._rollout, params, env_state, obs, carry, k
                 )
                 while not self._halt.is_set():
                     try:
@@ -235,6 +250,11 @@ def make_impala(cfg: ImpalaConfig):
     if cfg.correction not in ("vtrace", "none"):
         raise ValueError(
             f"correction must be 'vtrace' or 'none', got {cfg.correction!r}"
+        )
+    if cfg.recurrent and cfg.time_shards > 1:
+        raise ValueError(
+            "recurrent IMPALA requires time_shards=1 (the LSTM replay "
+            "scans the full local time axis)"
         )
     if cfg.time_shards > 1:
         n_dev = cfg.num_devices or len(jax.devices())
@@ -281,12 +301,22 @@ def make_impala(cfg: ImpalaConfig):
     # Discrete (Categorical) or continuous (diagonal Gaussian) — the
     # latter lets the async actor-learner topology serve MuJoCo-class
     # tasks, overlapping host env stepping with learner updates.
-    model, dist_and_value = common.make_policy_head(
-        action_space,
-        torso=cfg.torso,
-        hidden_sizes=cfg.hidden_sizes,
-        compute_dtype=cfg.compute_dtype,
-    )
+    if cfg.recurrent:
+        model, seq_dist_value = common.make_recurrent_policy_head(
+            action_space,
+            torso=cfg.torso,
+            hidden_sizes=cfg.hidden_sizes,
+            lstm_size=cfg.lstm_size,
+            compute_dtype=cfg.compute_dtype,
+        )
+        dist_and_value = None
+    else:
+        model, dist_and_value = common.make_policy_head(
+            action_space,
+            torso=cfg.torso,
+            hidden_sizes=cfg.hidden_sizes,
+            compute_dtype=cfg.compute_dtype,
+        )
 
     steps_per_batch = (
         cfg.batch_trajectories * cfg.envs_per_actor * cfg.rollout_length
@@ -323,11 +353,25 @@ def make_impala(cfg: ImpalaConfig):
         else:
             aenv, aparams = env, env_params
 
-        def actor_rollout(params, env_state, obs, key):
-            env_state, obs, traj, ep_info = common.collect_rollout(
-                aenv, aparams, policy_fn,
-                params, env_state, obs, key, cfg.rollout_length,
-            )
+        def actor_rollout(params, env_state, obs, carry, key):
+            """``carry`` is the recurrent policy-state bundle (None for
+            feed-forward policies; see collect_rollout_recurrent)."""
+            if cfg.recurrent:
+                entry = carry
+                env_state, obs, carry, traj, ep_info = (
+                    common.collect_rollout_recurrent(
+                        aenv, aparams, seq_dist_value,
+                        params, env_state, obs, carry, key,
+                        cfg.rollout_length,
+                    )
+                )
+                entry_lstm, entry_prev_done = entry["lstm"], entry["prev_done"]
+            else:
+                env_state, obs, traj, ep_info = common.collect_rollout(
+                    aenv, aparams, policy_fn,
+                    params, env_state, obs, key, cfg.rollout_length,
+                )
+                entry_lstm = entry_prev_done = None
             out = ActorTrajectory(
                 obs=traj.obs,
                 actions=traj.actions,
@@ -335,15 +379,27 @@ def make_impala(cfg: ImpalaConfig):
                 dones=traj.dones,
                 behaviour_log_probs=traj.log_probs,
                 last_obs=obs,
+                entry_lstm=entry_lstm,
+                entry_prev_done=entry_prev_done,
             )
             ep = {
                 "episode_return": ep_info["episode_return"],
                 "done_episode": ep_info["done_episode"],
             }
-            return env_state, obs, out, ep
+            return env_state, obs, carry, out, ep
 
         def env_reset(key):
-            return aenv.reset(key, aparams)
+            env_state, obs = aenv.reset(key, aparams)
+            if cfg.recurrent:
+                carry = {
+                    "lstm": model.initialize_carry(cfg.envs_per_actor),
+                    "prev_done": jnp.zeros(
+                        (cfg.envs_per_actor,), jnp.float32
+                    ),
+                }
+            else:
+                carry = None
+            return env_state, obs, carry
 
         return jax.jit(actor_rollout), env_reset
 
@@ -351,7 +407,13 @@ def make_impala(cfg: ImpalaConfig):
 
     def init(key: jax.Array) -> LearnerState:
         _, obs = env.reset(key, env_params)
-        params = model.init(key, obs[:1])
+        if cfg.recurrent:
+            params = model.init(
+                key, obs[:1][None], jnp.zeros((1, 1)),
+                model.initialize_carry(1),
+            )
+        else:
+            params = model.init(key, obs[:1])
         state = LearnerState(
             params=params,
             opt_state=tx.init(params),
@@ -369,8 +431,23 @@ def make_impala(cfg: ImpalaConfig):
         ``cfg.time_shards > 1``, with V-trace sequence-parallel)."""
 
         def loss_fn(params):
-            dist, values = dist_and_value(params, batch.obs)
-            _, last_value = dist_and_value(params, batch.last_obs)
+            if cfg.recurrent:
+                resets = common.replay_resets(
+                    batch.entry_prev_done, batch.dones
+                )
+                dist, values, carry_end = seq_dist_value(
+                    params, batch.obs, resets, batch.entry_lstm
+                )
+                # Bootstrap value of last_obs continues the sequence
+                # from the replayed end-of-rollout carry.
+                _, last_value_tb, _ = seq_dist_value(
+                    params, batch.last_obs[None], batch.dones[-1][None],
+                    carry_end,
+                )
+                last_value = last_value_tb[0]
+            else:
+                dist, values = dist_and_value(params, batch.obs)
+                _, last_value = dist_and_value(params, batch.last_obs)
             target_log_probs = dist.log_prob(batch.actions)
             if cfg.correction == "none":
                 # A3C: no importance weighting — with rho = c = 1 the
@@ -450,6 +527,11 @@ def make_impala(cfg: ImpalaConfig):
         dones=P(t_axis, DATA_AXIS),
         behaviour_log_probs=P(t_axis, DATA_AXIS),
         last_obs=P(DATA_AXIS),
+        # Entry policy state is per-env: sharded on the batch axis.
+        entry_lstm=(
+            (P(DATA_AXIS), P(DATA_AXIS)) if cfg.recurrent else None
+        ),
+        entry_prev_done=P(DATA_AXIS) if cfg.recurrent else None,
     )
     # NO donation here: ParamStore and in-flight actor snapshots alias
     # state.params, and donating would delete the buffers actors are
@@ -481,6 +563,14 @@ def stack_trajectories(trajs: List[ActorTrajectory]) -> ActorTrajectory:
             *[t.behaviour_log_probs for t in trajs]
         ),
         last_obs=jax.tree_util.tree_map(cat(0), *[t.last_obs for t in trajs]),
+        # Per-env entry policy state concatenates on the env axis
+        # (tree_map over None subtrees is a no-op for feed-forward).
+        entry_lstm=jax.tree_util.tree_map(
+            cat(0), *[t.entry_lstm for t in trajs]
+        ),
+        entry_prev_done=jax.tree_util.tree_map(
+            cat(0), *[t.entry_prev_done for t in trajs]
+        ),
     )
 
 
@@ -721,10 +811,12 @@ def _actor_process_main(
         params = jax.tree_util.tree_unflatten(params_def, leaves)
         key = jax.random.PRNGKey(seed)
         key, k = jax.random.split(key)
-        env_state, obs = env_reset_fn(k)
+        env_state, obs, carry = env_reset_fn(k)
         while True:
             key, k = jax.random.split(key)
-            env_state, obs, traj, ep = rollout_fn(params, env_state, obs, k)
+            env_state, obs, carry, traj, ep = rollout_fn(
+                params, env_state, obs, carry, k
+            )
             server_version = client.push_trajectory(
                 [np.asarray(x) for x in jax.tree_util.tree_leaves(traj)],
                 [np.asarray(x) for x in jax.tree_util.tree_leaves(ep)],
@@ -785,9 +877,9 @@ def run_impala_distributed(
     # sides build them from the same config).
     rollout_fn, env_reset_fn = make_actor_programs(0)
     k0 = jax.random.PRNGKey(0)
-    es_shape, obs_shape = jax.eval_shape(env_reset_fn, k0)
-    _, _, traj_shape, ep_shape = jax.eval_shape(
-        rollout_fn, state.params, es_shape, obs_shape, k0
+    es_shape, obs_shape, carry_shape = jax.eval_shape(env_reset_fn, k0)
+    _, _, _, traj_shape, ep_shape = jax.eval_shape(
+        rollout_fn, state.params, es_shape, obs_shape, carry_shape, k0
     )
     traj_def = jax.tree_util.tree_structure(traj_shape)
     ep_def = jax.tree_util.tree_structure(ep_shape)

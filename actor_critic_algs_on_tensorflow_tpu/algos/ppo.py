@@ -91,6 +91,15 @@ class PPOConfig:
     # whole-batch schedules fit where the single pass OOMs).
     grad_accum: int = 1
     normalize_adv: bool = True
+    # Recurrent (LSTM) policy over the torso features — the partially-
+    # observable model family (models.RecurrentActorCritic). Sequence
+    # structure must survive minibatching, so recurrent runs require
+    # whole-batch epochs (num_minibatches=1) or shuffle="env" (each
+    # minibatch is all T steps of contiguous envs); grad_accum,
+    # compact_frames, and time_limit_bootstrap are unsupported (the
+    # latter would need per-step carries for V(final_obs)).
+    recurrent: bool = False
+    lstm_size: int = 128
     # Running mean/std observation normalization (vector obs only) —
     # the VecNormalize-style statistics live in state.extra, frozen
     # within an iteration so update-time log-probs match collection.
@@ -142,6 +151,27 @@ def make_ppo(cfg: PPOConfig) -> common.IterationFns:
                 f"local batch {local_batch} not divisible by "
                 f"grad_accum={cfg.grad_accum}"
             )
+    if cfg.recurrent:
+        if cfg.num_minibatches > 1 and cfg.shuffle != "env":
+            raise ValueError(
+                "recurrent PPO needs sequence-shaped minibatches: use "
+                "num_minibatches=1 or shuffle='env' (the flat random "
+                "shuffle would scatter each env's trajectory)"
+            )
+        if cfg.grad_accum > 1:
+            raise ValueError(
+                "recurrent PPO does not support grad_accum (slices cut "
+                "across trajectories)"
+            )
+        if cfg.compact_frames:
+            raise ValueError(
+                "recurrent PPO does not support compact_frames"
+            )
+        if cfg.time_limit_bootstrap:
+            raise ValueError(
+                "recurrent PPO requires time_limit_bootstrap=False "
+                "(V(final_obs) would need the per-step carry)"
+            )
     common.check_host_env_topology(cfg.env, n_dev)
     env, env_params = envs_lib.make(
         cfg.env, num_envs=local_envs, frame_stack=cfg.frame_stack
@@ -150,12 +180,22 @@ def make_ppo(cfg: PPOConfig) -> common.IterationFns:
         cfg.env, num_envs=cfg.num_envs, frame_stack=cfg.frame_stack
     )
     action_space = env.action_space(env_params)
-    model, dist_and_value = common.make_policy_head(
-        action_space,
-        torso=cfg.torso,
-        hidden_sizes=cfg.hidden_sizes,
-        compute_dtype=cfg.compute_dtype,
-    )
+    if cfg.recurrent:
+        model, seq_dist_value = common.make_recurrent_policy_head(
+            action_space,
+            torso=cfg.torso,
+            hidden_sizes=cfg.hidden_sizes,
+            lstm_size=cfg.lstm_size,
+            compute_dtype=cfg.compute_dtype,
+        )
+        dist_and_value = None
+    else:
+        model, dist_and_value = common.make_policy_head(
+            action_space,
+            torso=cfg.torso,
+            hidden_sizes=cfg.hidden_sizes,
+            compute_dtype=cfg.compute_dtype,
+        )
 
     num_iters = max(1, cfg.total_env_steps // (cfg.num_envs * cfg.rollout_length))
     if cfg.lr_decay:
@@ -185,7 +225,18 @@ def make_ppo(cfg: PPOConfig) -> common.IterationFns:
             extra = rms_init(obs.shape[1:])
         else:
             extra = None
-        params = model.init(k_model, obs[:1])
+        if cfg.recurrent:
+            params = model.init(
+                k_model, obs[:1][None], jnp.zeros((1, 1)),
+                model.initialize_carry(1),
+            )
+            carry = {
+                "lstm": model.initialize_carry(cfg.num_envs),
+                "prev_done": jnp.zeros((cfg.num_envs,), jnp.float32),
+            }
+        else:
+            params = model.init(k_model, obs[:1])
+            carry = None
         state = common.OnPolicyState(
             params=params,
             opt_state=tx.init(params),
@@ -194,6 +245,7 @@ def make_ppo(cfg: PPOConfig) -> common.IterationFns:
             key=key,
             step=jnp.zeros((), jnp.int32),
             extra=extra,
+            carry=carry,
         )
         return put_by_specs(state, common.state_specs(state), mesh)
 
@@ -461,9 +513,159 @@ def make_ppo(cfg: PPOConfig) -> common.IterationFns:
         )
         return new_state, metrics
 
+    def local_iteration_recurrent(state: common.OnPolicyState):
+        """Recurrent PPO iteration: same rollout -> GAE -> epochs shape,
+        but the policy forward is the time-major LSTM sequence and every
+        minibatch is a whole-trajectory env block replayed from the
+        rollout-entry carry (truncated BPTT over the rollout window;
+        the stored carry goes stale across epochs as params move — the
+        standard recurrent-PPO approximation)."""
+        dev = jax.lax.axis_index(DATA_AXIS)
+        it_key = prng.fold(state.key, state.step, dev)
+        k_roll, k_perm = jax.random.split(it_key)
+
+        if cfg.normalize_obs:
+            rms = state.extra
+            norm = lambda o: rms_normalize(o, rms)
+        else:
+            norm = lambda o: o
+
+        carry0 = state.carry
+        env_state, obs, carry1, traj, ep_info = (
+            common.collect_rollout_recurrent(
+                env, env_params, seq_dist_value, state.params,
+                state.env_state, state.obs, carry0, k_roll,
+                cfg.rollout_length, norm=norm,
+            )
+        )
+        _, last_value_tb, _ = seq_dist_value(
+            state.params, norm(obs)[None], carry1["prev_done"][None],
+            carry1["lstm"],
+        )
+        advantages, returns = gae_advantages(
+            traj.rewards, traj.values, traj.dones, last_value_tb[0],
+            gamma=cfg.gamma, lam=cfg.gae_lambda,
+            terminations=ep_info["terminated"],
+            truncation_values=None,
+            use_pallas=cfg.use_pallas_scan,
+        )
+
+        resets_tb = common.replay_resets(carry0["prev_done"], traj.dones)
+        env_tb = {
+            "actions": traj.actions,
+            "old_log_probs": traj.log_probs,
+            "old_values": traj.values,
+            "advantages": advantages,
+            "returns": returns,
+        }
+
+        def seq_update(carry_po, block):
+            """One optimizer step on a whole-trajectory block: obs/env
+            fields [T, b], resets [T, b], lstm carry (c, h) [b, H]."""
+            params, opt_state = carry_po
+            adv = block["advantages"].reshape(-1)
+            if cfg.normalize_adv:
+                adv = common.global_normalize_advantages(adv)
+
+            def loss_fn(p):
+                dist, values_tb, _ = seq_dist_value(
+                    p, norm(block["obs"]), block["resets"], block["lstm"]
+                )
+                stats = ppo_clip_loss(
+                    dist.log_prob(block["actions"]).reshape(-1),
+                    block["old_log_probs"].reshape(-1),
+                    adv,
+                    clip_eps=cfg.clip_eps,
+                )
+                values = values_tb.reshape(-1)
+                if cfg.vf_clip:
+                    vf = clipped_value_loss(
+                        values, block["old_values"].reshape(-1),
+                        block["returns"].reshape(-1), clip_eps=cfg.clip_eps,
+                    )
+                else:
+                    vf = value_loss(values, block["returns"].reshape(-1))
+                ent = dist.entropy().mean()
+                total = (
+                    stats.policy_loss + cfg.vf_coef * vf - cfg.ent_coef * ent
+                )
+                return total, (stats, vf, ent)
+
+            (loss, (stats, vf, ent)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            grads = jax.lax.pmean(grads, DATA_AXIS)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            m = {
+                "loss": loss,
+                "policy_loss": stats.policy_loss,
+                "value_loss": vf,
+                "entropy": ent,
+                "clip_fraction": stats.clip_fraction,
+                "approx_kl": stats.approx_kl,
+            }
+            return (params, opt_state), m
+
+        mb_envs = local_envs // cfg.num_minibatches
+
+        def env_block_update(carry_po, start):
+            block = {
+                k: jax.lax.dynamic_slice_in_dim(v, start, mb_envs, axis=1)
+                for k, v in env_tb.items()
+            }
+            block["obs"] = jax.lax.dynamic_slice_in_dim(
+                traj.obs, start, mb_envs, axis=1
+            )
+            block["resets"] = jax.lax.dynamic_slice_in_dim(
+                resets_tb, start, mb_envs, axis=1
+            )
+            block["lstm"] = jax.tree_util.tree_map(
+                lambda x: jax.lax.dynamic_slice_in_dim(x, start, mb_envs, 0),
+                carry0["lstm"],
+            )
+            return seq_update(carry_po, block)
+
+        def epoch_step(carry_po, k):
+            if cfg.num_minibatches == 1:
+                block = dict(
+                    env_tb, obs=traj.obs, resets=resets_tb,
+                    lstm=carry0["lstm"],
+                )
+                carry_po, m = seq_update(carry_po, block)
+                return carry_po, jax.tree_util.tree_map(lambda x: x[None], m)
+            starts = env_block_starts(k, cfg.num_minibatches, mb_envs)
+            return jax.lax.scan(env_block_update, carry_po, starts)
+
+        epoch_keys = jax.random.split(k_perm, cfg.num_epochs)
+        (params, opt_state), m = jax.lax.scan(
+            epoch_step, (state.params, state.opt_state), epoch_keys
+        )
+        metrics = jax.lax.pmean(
+            jax.tree_util.tree_map(jnp.mean, m), DATA_AXIS
+        )
+        metrics.update(common.episode_metrics(ep_info))
+
+        new_extra = (
+            rms_update(state.extra, traj.obs, axis_name=DATA_AXIS)
+            if cfg.normalize_obs
+            else state.extra
+        )
+        return common.OnPolicyState(
+            params=params,
+            opt_state=opt_state,
+            env_state=env_state,
+            obs=obs,
+            key=state.key,
+            step=state.step + 1,
+            extra=new_extra,
+            carry=carry1,
+        ), metrics
+
     example = jax.eval_shape(init, jax.random.PRNGKey(0))
     iteration = common.build_data_parallel_iteration(
-        local_iteration, example, mesh
+        local_iteration_recurrent if cfg.recurrent else local_iteration,
+        example, mesh,
     )
     return common.IterationFns(
         init=init,

@@ -10,6 +10,7 @@ from actor_critic_algs_on_tensorflow_tpu.envs.breakout import (  # noqa: F401
 )
 from actor_critic_algs_on_tensorflow_tpu.envs.cartpole import (  # noqa: F401
     CartPole,
+    CartPoleMasked,
     CartPoleParams,
 )
 from actor_critic_algs_on_tensorflow_tpu.envs.core import (  # noqa: F401
@@ -41,6 +42,7 @@ from actor_critic_algs_on_tensorflow_tpu.envs.wrappers import (  # noqa: F401
 _REGISTRY = {
     "BreakoutTPU-v0": BreakoutTPU,
     "CartPole-v1": CartPole,
+    "CartPoleMasked-v1": CartPoleMasked,
     "Pendulum-v1": Pendulum,
     "PongServeTPU-v0": PongServeTPU,
     "PongTPU-v0": PongTPU,

@@ -105,3 +105,23 @@ class CartPole(JaxEnv[CartPoleState, CartPoleParams]):
 
     def action_space(self, params):
         return Discrete(2)
+
+
+class CartPoleMasked(CartPole):
+    """Velocity-masked CartPole: observations are ``[x, theta]`` only.
+
+    The classic partially-observable control benchmark — without
+    ``x_dot``/``theta_dot`` the instantaneous observation cannot
+    distinguish a pole swinging left from right, so a memoryless policy
+    plateaus while a recurrent one (``recurrent=True``) can estimate
+    the velocities from its history and solve the task. Dynamics,
+    reward, and termination are identical to :class:`CartPole`.
+    """
+
+    name = "CartPoleMasked-v1"
+
+    def _obs(self, state: CartPoleState) -> jax.Array:
+        return jnp.stack([state.x, state.theta]).astype(jnp.float32)
+
+    def observation_space(self, params):
+        return Box(-jnp.inf, jnp.inf, (2,))

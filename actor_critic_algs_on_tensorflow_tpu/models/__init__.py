@@ -8,6 +8,7 @@ from actor_critic_algs_on_tensorflow_tpu.models.networks import (  # noqa: F401
     MLPTorso,
     NatureCNN,
     QCritic,
+    RecurrentActorCritic,
     SquashedGaussianActor,
     TransformerTorso,
     TwinQCritic,

@@ -282,6 +282,90 @@ class FrameTransformerEncoder(nn.Module):
         )(tokens)
 
 
+class _MaskedLSTMCell(nn.Module):
+    """LSTM cell step with per-example episode-boundary masking.
+
+    ``xs = (z, reset)``: the carry is zeroed where ``reset == 1`` BEFORE
+    the cell runs, so a step that begins a new episode cannot see state
+    from the previous one. Scanned over time by ``RecurrentActorCritic``
+    (params broadcast, so the step and sequence paths share weights).
+    """
+
+    features: int
+
+    @nn.compact
+    def __call__(self, carry, xs):
+        z, reset = xs
+        c, h = carry
+        keep = (1.0 - reset)[..., None].astype(c.dtype)
+        carry = (c * keep, h * keep)
+        # The cell runs in f32 regardless of the torso's compute dtype:
+        # the carry is train-state (its dtype must be invariant across
+        # scan steps and checkpoints), and at 128-256 units the cell is
+        # a negligible share of the policy's FLOPs.
+        carry, y = nn.OptimizedLSTMCell(self.features, name="cell")(
+            carry, z.astype(jnp.float32)
+        )
+        return carry, y
+
+
+class RecurrentActorCritic(nn.Module):
+    """Recurrent (LSTM) policy + value heads over any discrete torso —
+    the IMPALA/R2D2-era recurrent model family for partially observable
+    tasks (e.g. velocity-masked CartPole, flicker Atari).
+
+    Time-major sequence API: ``__call__(obs, resets, carry)`` with
+    ``obs [T, B, ...]``, ``resets [T, B]`` (1.0 where step t begins a
+    new episode — i.e. the previous step ended one), and ``carry`` a
+    ``(c, h)`` pair of ``[B, lstm_size]`` arrays. Returns
+    ``(logits [T, B, A], values [T, B], new_carry)``. Single-step use
+    (collection, eval) is the same call with ``T == 1``; both paths
+    share parameters because the scan broadcasts them.
+
+    The torso runs batched over all ``T * B`` observations in one call
+    (conv/MLP compute stays MXU-shaped); only the LSTM recurrence scans
+    over time.
+    """
+
+    num_actions: int
+    torso: str = "mlp"
+    hidden_sizes: Sequence[int] = (64, 64)
+    lstm_size: int = 128
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs, resets, carry):
+        if self.torso == "nature_cnn":
+            z = NatureCNN(dtype=self.dtype)(obs)
+        elif self.torso == "frame_transformer":
+            z = FrameTransformerEncoder(dtype=self.dtype)(obs)
+        else:
+            z = MLPTorso(self.hidden_sizes, dtype=self.dtype)(obs)
+        scan = nn.scan(
+            _MaskedLSTMCell,
+            variable_broadcast="params",
+            split_rngs={"params": False},
+            in_axes=0,
+            out_axes=0,
+        )(self.lstm_size, name="lstm")
+        carry, y = scan(carry, (z, resets))
+        y = y.astype(self.dtype)
+        logits = nn.Dense(
+            self.num_actions, kernel_init=_orthogonal(0.01), dtype=self.dtype
+        )(y)
+        value = nn.Dense(1, kernel_init=_orthogonal(1.0), dtype=self.dtype)(y)
+        return (
+            logits.astype(jnp.float32),
+            value[..., 0].astype(jnp.float32),
+            carry,
+        )
+
+    def initialize_carry(self, batch: int):
+        """Zero ``(c, h)`` carry for ``batch`` environments."""
+        shape = (batch, self.lstm_size)
+        return (jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32))
+
+
 class DiscreteActorCritic(nn.Module):
     """Shared-torso policy + value heads for discrete action spaces.
 

@@ -53,11 +53,11 @@ def test_learner_step_shapes_and_finiteness():
     init, learner_step, make_actor, mesh = impala.make_impala(cfg)
     actor_rollout, env_reset = make_actor(0)
     state = init(jax.random.PRNGKey(0))
-    env_state, obs = env_reset(jax.random.PRNGKey(1))
+    env_state, obs, carry = env_reset(jax.random.PRNGKey(1))
     trajs = []
     for i in range(cfg.batch_trajectories):
-        env_state, obs, traj, ep = actor_rollout(
-            state.params, env_state, obs, jax.random.PRNGKey(i)
+        env_state, obs, carry, traj, ep = actor_rollout(
+            state.params, env_state, obs, carry, jax.random.PRNGKey(i)
         )
         trajs.append(traj)
     batch = impala.stack_trajectories(trajs)
@@ -102,11 +102,11 @@ def test_a3c_mode_matches_vtrace_on_policy():
     _, step_a, _, _ = impala.make_impala(cfg_a)
     actor_rollout, env_reset = make_actor(0)
     state = init(jax.random.PRNGKey(0))
-    env_state, obs = env_reset(jax.random.PRNGKey(1))
+    env_state, obs, carry = env_reset(jax.random.PRNGKey(1))
     trajs = []
     for i in range(cfg_v.batch_trajectories):
-        env_state, obs, traj, _ = actor_rollout(
-            state.params, env_state, obs, jax.random.PRNGKey(i)
+        env_state, obs, carry, traj, _ = actor_rollout(
+            state.params, env_state, obs, carry, jax.random.PRNGKey(i)
         )
         trajs.append(traj)
     batch = impala.stack_trajectories(trajs)
@@ -222,9 +222,9 @@ def test_impala_continuous_actions_learner_step():
     init, learner_step, make_actor_programs, _ = impala.make_impala(cfg)
     state = init(jax.random.PRNGKey(0))
     rollout, env_reset = make_actor_programs(0)
-    env_state, obs = env_reset(jax.random.PRNGKey(1))
-    env_state, obs, traj, _ = rollout(
-        state.params, env_state, obs, jax.random.PRNGKey(2)
+    env_state, obs, carry = env_reset(jax.random.PRNGKey(1))
+    env_state, obs, carry, traj, _ = rollout(
+        state.params, env_state, obs, carry, jax.random.PRNGKey(2)
     )
     assert traj.actions.ndim == 3 and traj.actions.shape[-1] == 1
     assert str(traj.actions.dtype) == "float32"
@@ -273,8 +273,8 @@ def test_impala_normalize_advantages():
     init, learner_step, make_actor_programs, _ = impala.make_impala(cfg)
     state = init(jax.random.PRNGKey(0))
     rollout, env_reset = make_actor_programs(0)
-    env_state, obs = env_reset(jax.random.PRNGKey(1))
-    _, _, traj, _ = rollout(state.params, env_state, obs, jax.random.PRNGKey(2))
+    env_state, obs, carry = env_reset(jax.random.PRNGKey(1))
+    _, _, _, traj, _ = rollout(state.params, env_state, obs, carry, jax.random.PRNGKey(2))
     big = traj.replace(rewards=traj.rewards * 100.0)
     before = np.asarray(jax.tree_util.tree_leaves(state.params)[0])
     state, metrics = learner_step(state, impala.stack_trajectories([big]))
