@@ -259,7 +259,34 @@ PRESETS = {
             "num_devices": 1,  # see impala-cartpole
         },
     ),
-    # 10. Continuous-control PPO (diagonal-Gaussian policy) on the
+    # 10. Recurrent (LSTM) PPO on the velocity-masked CartPole POMDP —
+    # the partially-observable model family (IMPALA-paper LSTM class).
+    # Schedule from the r4 probe grid: lr 1e-3 is the lever (2.5e-4
+    # never breaks past the uniform-policy plateau in this budget);
+    # shuffle="env" supplies the whole-trajectory minibatches the
+    # recurrent replay requires. Measured (seed 0, 600k steps): greedy
+    # eval 499/500 (the env cap) vs ~42 for the same schedule without
+    # recurrence — memory IS the task here, see PERF.md "Recurrent
+    # policy family". The r4 slow-tier test pins >= 300.
+    "ppo-masked-cartpole": (
+        "ppo",
+        {
+            "env": "CartPoleMasked-v1",
+            "num_envs": 8,
+            "rollout_length": 128,
+            "total_env_steps": 600_000,
+            "recurrent": True,
+            "lstm_size": 128,
+            "lr": 1e-3,
+            "num_minibatches": 4,
+            "shuffle": "env",
+            "time_limit_bootstrap": False,
+            # The 8-env width doesn't divide wider meshes; the tiny
+            # workload is single-device anyway.
+            "num_devices": 1,
+        },
+    ),
+    # 11. Continuous-control PPO (diagonal-Gaussian policy) on the
     # pure-JAX Pendulum — the on-device continuous counterpart of the
     # MuJoCo presets. gamma=0.9 + multi-epoch updates: measured
     # avg_return -1200 -> ~-690 by 800k steps on one chip, still
