@@ -23,6 +23,8 @@ from actor_critic_algs_on_tensorflow_tpu.envs.pendulum import (  # noqa: F401
     PendulumParams,
 )
 from actor_critic_algs_on_tensorflow_tpu.envs.pong import (  # noqa: F401
+    PongFlickerParams,
+    PongFlickerTPU,
     PongParams,
     PongServeTPU,
     PongTPU,
@@ -44,6 +46,7 @@ _REGISTRY = {
     "CartPole-v1": CartPole,
     "CartPoleMasked-v1": CartPoleMasked,
     "Pendulum-v1": Pendulum,
+    "PongFlickerTPU-v0": PongFlickerTPU,
     "PongServeTPU-v0": PongServeTPU,
     "PongTPU-v0": PongTPU,
     "ReacherTPU-v0": ReacherTPU,

@@ -236,6 +236,45 @@ class PongTPU(JaxEnv[PongState, PongParams]):
         return Discrete(6)
 
 
+@struct.dataclass
+class PongFlickerParams(PongParams):
+    # Probability that an observation is replaced by a blank frame.
+    flicker_p: float = 0.5
+
+
+class PongFlickerTPU(PongTPU):
+    """Flickering Pong: each frame is independently blanked with
+    probability ``flicker_p`` — the classic Atari POMDP benchmark
+    (Hausknecht & Stone 2015, "Deep Recurrent Q-Learning for Partially
+    Observable MDPs"). Dynamics, rewards, and action set are identical
+    to :class:`PongTPU`; only the OBSERVATION channel is degraded, so
+    paired with ``frame_stack=1`` (single frames carry no velocity
+    information even unblanked) it isolates what a recurrent policy's
+    memory buys on the Atari-class task surface.
+    """
+
+    name = "PongFlickerTPU-v0"
+
+    def default_params(self) -> PongFlickerParams:
+        return PongFlickerParams()
+
+    def _flicker(self, key, obs, params):
+        blank = jax.random.bernoulli(key, params.flicker_p)
+        return jnp.where(blank, jnp.zeros_like(obs), obs)
+
+    def reset(self, key, params):
+        k_reset, k_flicker = jax.random.split(key)
+        state, obs = super().reset(k_reset, params)
+        return state, self._flicker(k_flicker, obs, params)
+
+    def step(self, key, state, action, params):
+        k_step, k_flicker = jax.random.split(key)
+        state, obs, reward, done, info = super().step(
+            k_step, state, action, params
+        )
+        return state, self._flicker(k_flicker, obs, params), reward, done, info
+
+
 class PongServeTPU(PongTPU):
     """PongTPU with resets oversampling the residual-flaw states.
 

@@ -194,6 +194,38 @@ def test_a2c_recurrent_runs_and_learns_signal():
     assert all(v > 0 for v in jax.tree_util.tree_leaves(changed))
 
 
+def test_pong_flicker_blanks_frames_but_not_dynamics():
+    """PongFlickerTPU: ~flicker_p of observations are blank, and the
+    env presents the same task surface as PongTPU (same spaces; the
+    dynamics are inherited unchanged — only ``_flicker`` post-processes
+    the observation channel)."""
+    from actor_critic_algs_on_tensorflow_tpu import envs as envs_lib
+
+    fenv, fparams = envs_lib.make("PongFlickerTPU-v0", num_envs=64)
+    assert float(fparams.flicker_p) == 0.5
+    key = jax.random.PRNGKey(0)
+    state, obs = fenv.reset(key, fparams)
+    blanks, total = 0, 0
+    actions = jnp.zeros((64,), jnp.int32)
+    for t in range(20):
+        k = jax.random.fold_in(key, t)
+        state, obs, rew, done, info = fenv.step(k, state, actions, fparams)
+        per_env_blank = (
+            np.asarray(obs).reshape(64, -1).max(axis=1) == 0
+        )
+        blanks += int(per_env_blank.sum())
+        total += 64
+    assert 0.35 < blanks / total < 0.65  # ~Binomial(1280, 0.5)
+
+    # Same spaces as the base env; dynamics shared by inheritance.
+    env, params = envs_lib.make("PongTPU-v0", num_envs=64)
+    assert fenv.action_space(fparams).n == env.action_space(params).n
+    assert (
+        fenv.observation_space(fparams).shape
+        == env.observation_space(params).shape
+    )
+
+
 def test_impala_recurrent_replay_consistency():
     """IMPALA-LSTM: the learner replays each trajectory from its ENTRY
     carry. With target params == behaviour params, the replayed
