@@ -286,7 +286,37 @@ PRESETS = {
             "num_devices": 1,
         },
     ),
-    # 11. Continuous-control PPO (diagonal-Gaussian policy) on the
+    # 11. Recurrent (LSTM) PPO on flickering Pong — the Atari-class
+    # POMDP benchmark (Hausknecht & Stone 2015): every observation is
+    # independently blanked with p=0.5, and frame_stack=1 means even
+    # unblanked frames carry no velocity information, so memory is the
+    # only route to state. r4 schedule: the masked-cartpole levers
+    # (lr 1e-3, shuffle="env" whole-trajectory minibatches) at 256
+    # envs, PLUS linear lr decay — constant lr 1e-3 peaks ~14 by 14M
+    # then collapses (final 5.3; the fs4 control collapses too), while
+    # the decayed schedule lands 3-seed 25M finals 20.08/18.89/19.53
+    # with greedy n=64 evals 20.36/19.81/19.91 (32/19/25 perfect 21s).
+    # Controls at the same schedule: feed-forward frame_stack=4 16.66
+    # (zero perfect episodes), frame_stack=1 (memoryless) -5.75 train /
+    # 0.80 greedy. The seed-0 policy evaluated on CLEAN single-frame
+    # PongTPU scores 20.12 — the LSTM's state tracking transfers to
+    # unflickered play (PERF.md "Flickering Pong").
+    "ppo-flicker-pong": (
+        "ppo",
+        {
+            "env": "PongFlickerTPU-v0",
+            **_PPO_ATARI_SCHEDULE,
+            "frame_stack": 1,
+            "recurrent": True,
+            "lstm_size": 256,
+            "num_envs": 256,
+            "num_minibatches": 4,
+            "shuffle": "env",
+            "lr": 1e-3,
+            "lr_decay": True,
+        },
+    ),
+    # 12. Continuous-control PPO (diagonal-Gaussian policy) on the
     # pure-JAX Pendulum — the on-device continuous counterpart of the
     # MuJoCo presets. gamma=0.9 + multi-epoch updates: measured
     # avg_return -1200 -> ~-690 by 800k steps on one chip, still

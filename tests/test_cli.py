@@ -28,6 +28,20 @@ def test_make_config_preset_and_overrides():
     assert cfg.total_env_steps == 999
 
 
+def test_make_config_flicker_preset():
+    """ppo-flicker-pong: the recurrent Atari-class POMDP preset pairs
+    the flicker env with frame_stack=1 (memory, not stacking, must
+    carry state) and the decayed env-sliced recurrent schedule."""
+    args = cli.build_parser().parse_args(["--preset", "ppo-flicker-pong"])
+    algo, cfg = cli.make_config(args)
+    assert algo == "ppo"
+    assert cfg.env == "PongFlickerTPU-v0"
+    assert cfg.recurrent is True and cfg.lstm_size == 256
+    assert cfg.frame_stack == 1
+    assert cfg.shuffle == "env" and cfg.num_minibatches == 4
+    assert cfg.lr_decay is True
+
+
 def test_unknown_override_rejected():
     args = cli.build_parser().parse_args(
         ["--algo", "a2c", "--set", "nope=1"]
