@@ -62,6 +62,13 @@ class A2CConfig:
     # off (V(final_obs) would need the per-step carry).
     recurrent: bool = False
     lstm_size: int = 128
+    # Fused LSTM update path: hoist the input-side gate projection out
+    # of the time scan into one batched MXU matmul (identical numerics
+    # and param tree; see models._FusedMaskedLSTM) and unroll the scan
+    # by this factor. Measured on flicker-pong in PERF.md "Recurrent
+    # throughput".
+    lstm_precompute_gates: bool = False
+    lstm_unroll: int = 1
     # Bootstrap truncated (time-limit) episodes from V(final_obs)
     # instead of treating them as terminal (see ops.gae). Costs an
     # extra [T, B, obs] buffer + value forward; disable for image envs.
@@ -103,6 +110,8 @@ def make_a2c(cfg: A2CConfig) -> common.IterationFns:
             hidden_sizes=cfg.hidden_sizes,
             lstm_size=cfg.lstm_size,
             compute_dtype=cfg.compute_dtype,
+            lstm_precompute_gates=cfg.lstm_precompute_gates,
+            lstm_unroll=cfg.lstm_unroll,
         )
     else:
         model = DiscreteActorCritic(

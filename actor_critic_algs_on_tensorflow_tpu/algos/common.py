@@ -169,7 +169,14 @@ def make_policy_head(action_space, *, torso, hidden_sizes, compute_dtype):
 
 
 def make_recurrent_policy_head(
-    action_space, *, torso, hidden_sizes, lstm_size, compute_dtype
+    action_space,
+    *,
+    torso,
+    hidden_sizes,
+    lstm_size,
+    compute_dtype,
+    lstm_precompute_gates=False,
+    lstm_unroll=1,
 ):
     """(model, seq_dist_value) for a recurrent (LSTM) discrete policy.
 
@@ -191,6 +198,8 @@ def make_recurrent_policy_head(
         hidden_sizes=hidden_sizes,
         lstm_size=lstm_size,
         dtype=jnp.dtype(compute_dtype),
+        precompute_gates=lstm_precompute_gates,
+        unroll=lstm_unroll,
     )
 
     def seq_dist_value(params, obs_tb, resets_tb, carry):

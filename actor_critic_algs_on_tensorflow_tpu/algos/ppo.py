@@ -100,6 +100,13 @@ class PPOConfig:
     # latter would need per-step carries for V(final_obs)).
     recurrent: bool = False
     lstm_size: int = 128
+    # Fused LSTM update path: hoist the input-side gate projection out
+    # of the time scan into one batched MXU matmul (identical numerics
+    # and param tree; see models._FusedMaskedLSTM) and unroll the scan
+    # by this factor. Measured on flicker-pong in PERF.md "Recurrent
+    # throughput".
+    lstm_precompute_gates: bool = False
+    lstm_unroll: int = 1
     # Running mean/std observation normalization (vector obs only) —
     # the VecNormalize-style statistics live in state.extra, frozen
     # within an iteration so update-time log-probs match collection.
@@ -187,6 +194,8 @@ def make_ppo(cfg: PPOConfig) -> common.IterationFns:
             hidden_sizes=cfg.hidden_sizes,
             lstm_size=cfg.lstm_size,
             compute_dtype=cfg.compute_dtype,
+            lstm_precompute_gates=cfg.lstm_precompute_gates,
+            lstm_unroll=cfg.lstm_unroll,
         )
         dist_and_value = None
     else:

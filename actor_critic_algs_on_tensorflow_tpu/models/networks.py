@@ -309,6 +309,107 @@ class _MaskedLSTMCell(nn.Module):
         return carry, y
 
 
+class _DenseP(nn.Module):
+    """Parameter-only stand-in for one of ``nn.OptimizedLSTMCell``'s
+    per-gate ``DenseParams`` — declares the identical ``kernel`` (and
+    optional ``bias``) leaves without computing anything, so the fused
+    LSTM below shares a checkpoint-compatible param tree with the
+    scan-of-cells path."""
+
+    features: int
+    in_features: int
+    use_bias: bool
+    kernel_init: Callable
+
+    @nn.compact
+    def __call__(self):
+        kernel = self.param(
+            "kernel",
+            self.kernel_init,
+            (self.in_features, self.features),
+            jnp.float32,
+        )
+        if not self.use_bias:
+            return kernel, None
+        bias = self.param(
+            "bias", nn.initializers.zeros_init(), (self.features,), jnp.float32
+        )
+        return kernel, bias
+
+
+class _LSTMParams(nn.Module):
+    """The 8 gate-param sets of ``nn.OptimizedLSTMCell`` (``i{i,f,g,o}``
+    kernels, ``h{i,f,g,o}`` kernels+biases), concatenated gate-major in
+    the cell's own ``[i|f|g|o]`` order. Same names, shapes, and inits as
+    the real cell, so checkpoints interoperate both ways."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, in_features: int):
+        wi, wh, bh = [], [], []
+        for comp in ("i", "f", "g", "o"):
+            k, _ = _DenseP(
+                self.features,
+                in_features,
+                False,
+                nn.initializers.lecun_normal(),
+                name=f"i{comp}",
+            )()
+            wi.append(k)
+            k, b = _DenseP(
+                self.features,
+                self.features,
+                True,
+                nn.initializers.orthogonal(),
+                name=f"h{comp}",
+            )()
+            wh.append(k)
+            bh.append(b)
+        return (
+            jnp.concatenate(wi, axis=-1),
+            jnp.concatenate(wh, axis=-1),
+            jnp.concatenate(bh, axis=-1),
+        )
+
+
+class _FusedMaskedLSTM(nn.Module):
+    """Masked LSTM over time with the input-side gate projection HOISTED
+    out of the scan.
+
+    The per-step cell math only depends on the input through
+    ``x @ W_i``; that projection — ``[T*B, Z] x [Z, 4H]``, two thirds of
+    the cell FLOPs when ``Z > H`` — is computed as ONE batched MXU
+    matmul before the scan, leaving just the ``[B, H] x [H, 4H]``
+    recurrence + elementwise gates inside. Numerics are identical to
+    ``_MaskedLSTMCell`` (same f32 compute, same gate order, same
+    pre-cell reset masking), and ``_LSTMParams`` keeps the param tree
+    checkpoint-identical, so the two paths are drop-in interchangeable
+    (tested in ``tests/test_recurrent.py``).
+    """
+
+    features: int
+    unroll: int = 1
+
+    @nn.compact
+    def __call__(self, carry, z, resets):
+        w_i, w_h, b_h = _LSTMParams(self.features, name="cell")(z.shape[-1])
+        gx = jnp.dot(z.astype(jnp.float32), w_i)  # [T, B, 4H], one matmul
+
+        def step(carry, xs):
+            gx_t, reset = xs
+            c, h = carry
+            keep = (1.0 - reset)[..., None].astype(c.dtype)
+            c, h = c * keep, h * keep
+            gates = gx_t + jnp.dot(h, w_h) + b_h
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            c = nn.sigmoid(f) * c + nn.sigmoid(i) * jnp.tanh(g)
+            h = nn.sigmoid(o) * jnp.tanh(c)
+            return (c, h), h
+
+        return jax.lax.scan(step, carry, (gx, resets), unroll=self.unroll)
+
+
 class RecurrentActorCritic(nn.Module):
     """Recurrent (LSTM) policy + value heads over any discrete torso —
     the IMPALA/R2D2-era recurrent model family for partially observable
@@ -332,23 +433,39 @@ class RecurrentActorCritic(nn.Module):
     hidden_sizes: Sequence[int] = (64, 64)
     lstm_size: int = 128
     dtype: Dtype = jnp.float32
+    # Scan the per-step cell (False) or hoist the input projection into
+    # one pre-scan MXU matmul (True; same numerics + param tree, faster
+    # — see _FusedMaskedLSTM). ``unroll`` is lax.scan's unroll factor
+    # over time for either path.
+    precompute_gates: bool = False
+    unroll: int = 1
 
     @nn.compact
     def __call__(self, obs, resets, carry):
         if self.torso == "nature_cnn":
             z = NatureCNN(dtype=self.dtype)(obs)
+        elif self.torso == "nature_cnn_s2d":
+            # Same params/tree as nature_cnn (s2d is a pure relayout),
+            # so checkpoints interoperate between the two torso names.
+            z = NatureCNN(dtype=self.dtype, space_to_depth=True)(obs)
         elif self.torso == "frame_transformer":
             z = FrameTransformerEncoder(dtype=self.dtype)(obs)
         else:
             z = MLPTorso(self.hidden_sizes, dtype=self.dtype)(obs)
-        scan = nn.scan(
-            _MaskedLSTMCell,
-            variable_broadcast="params",
-            split_rngs={"params": False},
-            in_axes=0,
-            out_axes=0,
-        )(self.lstm_size, name="lstm")
-        carry, y = scan(carry, (z, resets))
+        if self.precompute_gates:
+            carry, y = _FusedMaskedLSTM(
+                self.lstm_size, unroll=self.unroll, name="lstm"
+            )(carry, z, resets)
+        else:
+            scan = nn.scan(
+                _MaskedLSTMCell,
+                variable_broadcast="params",
+                split_rngs={"params": False},
+                in_axes=0,
+                out_axes=0,
+                unroll=self.unroll,
+            )(self.lstm_size, name="lstm")
+            carry, y = scan(carry, (z, resets))
         y = y.astype(self.dtype)
         logits = nn.Dense(
             self.num_actions, kernel_init=_orthogonal(0.01), dtype=self.dtype

@@ -1,0 +1,95 @@
+"""Artifact-ledger audit (VERDICT r4 next#5).
+
+The ledger is the product: every artifact the docs cite must either
+exist under ``runs/`` or be explicitly marked cycled with a
+regeneration pointer. This script enforces that, so stale references
+(like r4's ``runs/pong21-serve``) can't rot silently:
+
+1. every literal ``runs/NAME`` in PERF.md / README.md / ARCHITECTURE.md
+   resolves to a directory on disk, or the word "cycled" appears within
+   3 lines of the reference;
+2. every row of a markdown table whose header column is ``artifact``
+   names a directory that exists, or carries a "cycled" marker in the
+   row / table footnote;
+3. no interrupted-save droppings (``*.orbax-checkpoint-tmp``) exist
+   under ``runs/``.
+
+Run directly (exit 0 = green) or via tests/test_artifact_audit.py.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+DOCS = ("PERF.md", "README.md", "ARCHITECTURE.md")
+
+
+def audit(repo: Path = REPO) -> list:
+    problems = []
+    run_dirs = {
+        p.name for p in (repo / "runs").iterdir() if p.is_dir()
+    } if (repo / "runs").is_dir() else set()
+
+    for doc in DOCS:
+        path = repo / doc
+        if not path.exists():
+            continue
+        lines = path.read_text().splitlines()
+
+        # 1. literal runs/NAME references
+        for i, line in enumerate(lines):
+            for m in re.finditer(r"runs/([A-Za-z0-9_.-]+)", line):
+                name = m.group(1)
+                if name in run_dirs or "." in name:  # files like .log are not artifacts
+                    continue
+                context = "\n".join(lines[max(0, i - 3): i + 4]).lower()
+                if "cycled" not in context:
+                    problems.append(
+                        f"{doc}:{i + 1}: `runs/{name}` missing on disk "
+                        "and not marked cycled"
+                    )
+
+        # 2. rows of "| artifact |" tables
+        in_table = False
+        for i, line in enumerate(lines):
+            cells = [c.strip() for c in line.strip().strip("|").split("|")]
+            if not line.lstrip().startswith("|"):
+                in_table = False
+                continue
+            if cells and cells[0].lower() == "artifact":
+                in_table = True
+                continue
+            if not in_table or set(line) <= {"|", "-", " "}:
+                continue
+            first = cells[0]
+            name = first.split()[0].strip("`*") if first else ""
+            if not re.fullmatch(r"[a-z0-9][a-z0-9_.-]+", name):
+                continue
+            if name in run_dirs:
+                continue
+            if "cycled" not in first.lower():
+                problems.append(
+                    f"{doc}:{i + 1}: artifact `{name}` missing on disk "
+                    "and row not marked cycled"
+                )
+
+    # 3. interrupted orbax saves
+    for tmp in (repo / "runs").glob("**/*orbax-checkpoint-tmp*"):
+        problems.append(f"stale interrupted save: {tmp.relative_to(repo)}")
+
+    return problems
+
+
+def main() -> int:
+    problems = audit()
+    for p in problems:
+        print(p)
+    print(f"artifact audit: {'GREEN' if not problems else f'{len(problems)} problem(s)'}")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,25 @@
+"""The docs' artifact ledger stays consistent with runs/ on disk.
+
+VERDICT r4 next#5: r4 shipped a PERF.md reference to a cycled
+checkpoint dir (``runs/pong21-serve``) and quoted table rows whose
+artifacts had been cycled without saying so. The audit script encodes
+the rule — exists on disk OR explicitly marked cycled with a
+regeneration pointer — and this test keeps it from rotting again.
+"""
+
+import importlib.util
+import pathlib
+
+_spec = importlib.util.spec_from_file_location(
+    "audit_artifacts",
+    pathlib.Path(__file__).resolve().parent.parent
+    / "scripts"
+    / "audit_artifacts.py",
+)
+audit_artifacts = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(audit_artifacts)
+
+
+def test_artifact_ledger_consistent():
+    problems = audit_artifacts.audit()
+    assert not problems, "\n".join(problems)

@@ -52,6 +52,42 @@ def test_sequence_equals_stepwise():
     )
 
 
+def test_fused_gates_path_equivalent():
+    """The hoisted-input-projection LSTM (precompute_gates=True) is a
+    drop-in for the scan-of-cells path: identical param tree (so
+    checkpoints interoperate both ways), identical forward outputs, and
+    matching gradients — on the SAME params, with resets in play."""
+    m_scan = _make_model(precompute_gates=False)
+    m_fused = _make_model(precompute_gates=True, unroll=4)
+    obs = jax.random.normal(jax.random.PRNGKey(0), (7, 4, 6))
+    resets = (
+        jax.random.uniform(jax.random.PRNGKey(1), (7, 4)) < 0.3
+    ).astype(jnp.float32)
+    carry = m_scan.initialize_carry(4)
+    params = m_scan.init(jax.random.PRNGKey(2), obs, resets, carry)
+    params_fused = m_fused.init(jax.random.PRNGKey(2), obs, resets, carry)
+
+    tree = jax.tree_util.tree_map(jnp.shape, params)
+    tree_fused = jax.tree_util.tree_map(jnp.shape, params_fused)
+    assert tree == tree_fused  # names AND shapes
+
+    out_scan = m_scan.apply(params, obs, resets, carry)
+    out_fused = m_fused.apply(params, obs, resets, carry)  # same params
+    for a, b in zip(jax.tree_util.tree_leaves(out_scan),
+                    jax.tree_util.tree_leaves(out_fused)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+    def loss(m, p):
+        lg, v, _ = m.apply(p, obs, resets, carry)
+        return (lg**2).mean() + (v**2).mean()
+
+    g_scan = jax.grad(lambda p: loss(m_scan, p))(params)
+    g_fused = jax.grad(lambda p: loss(m_fused, p))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g_scan),
+                    jax.tree_util.tree_leaves(g_fused)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
 def test_reset_masks_history():
     """A reset at step t makes the suffix identical to a fresh-carry
     rollout of the suffix — no leakage across episode boundaries."""
