@@ -37,6 +37,7 @@ from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
     put_by_specs,
     replicated_specs,
     shard_batch_specs,
+    shard_map,
 )
 
 # policy_fn(params, obs, key) -> (action, log_prob, value)
@@ -89,7 +90,7 @@ def build_shard_map_iteration(
     local_iteration: Callable, specs, mesh: Mesh, *, donate: bool = True
 ) -> Callable:
     """shard_map + jit a ``state -> (state, metrics)`` iteration."""
-    mapped = jax.shard_map(
+    mapped = shard_map(
         local_iteration,
         mesh=mesh,
         in_specs=(specs,),

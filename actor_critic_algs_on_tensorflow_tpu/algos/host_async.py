@@ -47,7 +47,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from actor_critic_algs_on_tensorflow_tpu.algos import offpolicy
 from actor_critic_algs_on_tensorflow_tpu.envs.host import HostEnvState
-from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import DATA_AXIS
+from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
+    DATA_AXIS,
+    shard_map,
+)
 
 
 def host_async_supported(cfg) -> bool:
@@ -84,7 +87,7 @@ def _build_update(parts, accel) -> Any:
 
     mesh = Mesh(np.asarray([accel]), (DATA_AXIS,))
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             body,
             mesh=mesh,
             in_specs=(P(), P(), P(), P()),

@@ -55,6 +55,7 @@ from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
     DATA_AXIS,
     device_count,
     make_mesh,
+    shard_map,
 )
 
 TIME_AXIS = "time"
@@ -93,6 +94,18 @@ class ImpalaConfig:
     # Dead actors are restarted (stateless recovery) up to this many
     # times before the failure is surfaced (SURVEY.md §5).
     max_actor_restarts: int = 2
+    # --- transport fault tolerance (run_impala_distributed) ---------
+    # Actor-side heartbeat cadence while waiting on the learner, the
+    # silence window after which either side declares the peer wedged
+    # and recycles the connection, the cumulative BACKOFF budget an
+    # actor sleeps across retries of one operation before giving up
+    # (time blocked inside an attempt — e.g. riding out a learner
+    # stall — never counts), and the per-frame allocation cap on the
+    # wire (see distributed.resilience / distributed.transport).
+    transport_heartbeat_s: float = 10.0
+    transport_idle_timeout_s: float = 120.0
+    transport_retry_deadline_s: float = 60.0
+    transport_max_frame_mb: int = 1024
     compute_dtype: str = "float32"  # "bfloat16" runs the torso on the MXU in bf16
     use_pallas_scan: bool = False   # fused Pallas VMEM kernel for V-trace
     # Recurrent (LSTM) policy — the IMPALA-paper model family. Actors
@@ -571,7 +584,7 @@ def make_impala(cfg: ImpalaConfig):
     # state.params, and donating would delete the buffers actors are
     # reading (harmless on CPU, fatal on TPU).
     learner_step = jax.jit(
-        jax.shard_map(
+        shard_map(
             local_learner_step,
             mesh=mesh,
             in_specs=(state_spec, batch_spec),
@@ -824,8 +837,12 @@ def _actor_process_main(
     Exits cleanly when the learner closes the connection.
     """
     jax.config.update("jax_platforms", "cpu")
+    from actor_critic_algs_on_tensorflow_tpu.distributed.resilience import (
+        ResilientActorClient,
+        RetryPolicy,
+    )
     from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
-        ActorClient,
+        LearnerShutdown,
     )
 
     # Single-CPU rollout process: never runs the (possibly
@@ -836,7 +853,16 @@ def _actor_process_main(
     params_def = jax.tree_util.tree_structure(
         jax.eval_shape(lambda k: init(k).params, jax.random.PRNGKey(0))
     )
-    client = ActorClient(host, port)
+    # Transparent reconnect + re-push on transport faults: V-trace makes
+    # the resulting duplicate/stale trajectories benign, so a flaky DCN
+    # link or a learner restart costs retries, not an actor.
+    client = ResilientActorClient(
+        host, port,
+        retry=RetryPolicy(deadline_s=cfg.transport_retry_deadline_s),
+        heartbeat_interval_s=cfg.transport_heartbeat_s,
+        idle_timeout_s=cfg.transport_idle_timeout_s,
+        max_frame_bytes=cfg.transport_max_frame_mb << 20,
+    )
     try:
         version, leaves = client.fetch_params()
         while version == 0:  # learner has not published init weights yet
@@ -858,13 +884,21 @@ def _actor_process_main(
             if server_version > version:
                 version, leaves = client.fetch_params()
                 params = jax.tree_util.tree_unflatten(params_def, leaves)
-    except (ConnectionError, OSError) as e:
-        # Normal at learner shutdown (it closes the sockets); the
-        # message makes a genuine mid-training transport fault
-        # diagnosable from the actor's stderr either way.
+    except LearnerShutdown:
+        # Orderly KIND_CLOSE broadcast: the learner is done. Exit
+        # quietly — this is the expected end of every run, not a fault.
         print(
-            f"[impala-actor {actor_id}] transport closed: "
-            f"{type(e).__name__}: {e}",
+            f"[impala-actor {actor_id}] learner closed the stream; "
+            f"exiting ({client.stats()})",
+            flush=True,
+        )
+    except (ConnectionError, OSError) as e:
+        # The retry budget is exhausted: a genuine transport fault (or
+        # a learner that died without its goodbye frame). The message
+        # makes it diagnosable from the actor's stderr.
+        print(
+            f"[impala-actor {actor_id}] transport failed after retries: "
+            f"{type(e).__name__}: {e} ({client.stats()})",
             flush=True,
         )
     finally:
@@ -883,16 +917,22 @@ def run_impala_distributed(
     checkpointer=None,
     checkpoint_interval: int = 200,
     initial_state: LearnerState | None = None,
+    host: str = "127.0.0.1",
+    port: int = 0,
 ) -> Tuple[LearnerState, List[Tuple[int, Dict[str, float]]]]:
     """IMPALA with actors in separate PROCESSES streaming trajectories
     through ``distributed.transport`` — the same topology that spans
     hosts over DCN (actors on actor hosts, learner on the TPU slice).
+    ``host``/``port`` bind the learner's listener (port 0 = ephemeral;
+    bind a routable address to accept actors from other hosts).
 
     The learner-side ``TrajectoryQueue`` (bounded, watchdogged) sits
     between the server threads and the learner loop, so backpressure
     and starvation detection apply to remote actors unchanged. Dead
     actor processes are restarted statelessly up to
-    ``cfg.max_actor_restarts`` times, mirroring ``run_impala``.
+    ``cfg.max_actor_restarts`` times, mirroring ``run_impala``; actors
+    ride ``ResilientActorClient``, so transport faults cost retries and
+    reconnects (reported through the transport_* metrics), not actors.
     """
     import multiprocessing as mp
 
@@ -933,16 +973,23 @@ def run_impala_distributed(
             except queue_lib.Full:
                 continue
 
-    server = LearnerServer(on_trajectory)
+    server = LearnerServer(
+        on_trajectory,
+        host=host,
+        port=port,
+        idle_timeout_s=cfg.transport_idle_timeout_s,
+        max_frame_bytes=cfg.transport_max_frame_mb << 20,
+    )
     server.publish(jax.tree_util.tree_leaves(jax.device_get(state.params)))
 
     ctx = mp.get_context("spawn")
+    connect_host = "127.0.0.1" if host in ("0.0.0.0", "") else host
 
     def spawn(i: int, generation: int):
         p = ctx.Process(
             target=_actor_process_main,
             args=(
-                cfg, i, "127.0.0.1", server.port,
+                cfg, i, connect_host, server.port,
                 cfg.seed * 10_000 + generation * 1_000 + i,
             ),
             daemon=True,
@@ -981,9 +1028,13 @@ def run_impala_distributed(
             cfg, state, learner_step, q,
             publish=publish,
             check_health=check_health,
+            # Transport liveness rides the same log stream as the
+            # learning metrics: disconnect/reconnect counts, per-actor
+            # liveness, byte/frame totals (LearnerServer.metrics()).
             extra_metrics=lambda: {
                 "param_version": server.version,
                 "actor_restarts": restarts,
+                **server.metrics(),
             },
             log_interval=log_interval,
             log_fn=log_fn,

@@ -398,7 +398,41 @@ def build_parser() -> argparse.ArgumentParser:
                    help="impala: run actors as separate processes "
                         "streaming over the TCP transport (the "
                         "multi-host topology) instead of threads")
+    p.add_argument("--learner-bind", default=None, metavar="HOST[:PORT]",
+                   help="with --actor-processes: bind the learner's "
+                        "trajectory listener here (default "
+                        "127.0.0.1:ephemeral; bind a routable address "
+                        "to accept actors from other hosts). Transport "
+                        "fault-tolerance knobs are config fields: "
+                        "--set transport_heartbeat_s=... "
+                        "transport_idle_timeout_s= "
+                        "transport_retry_deadline_s= "
+                        "transport_max_frame_mb=")
     return p
+
+
+def parse_bind(spec: str | None) -> Tuple[str, int]:
+    """``HOST[:PORT]`` -> (host, port); port 0 (ephemeral) if omitted.
+
+    IPv6 literals use brackets (``[::1]:9000``, ``[::1]``); a bare
+    multi-colon spec (``::1``) is taken as a portless IPv6 host."""
+    if not spec:
+        return "127.0.0.1", 0
+    if spec.startswith("["):
+        host, sep, rest = spec[1:].partition("]")
+        if not sep or (rest and not rest.startswith(":")):
+            raise SystemExit(f"--learner-bind: malformed address {spec!r}")
+        port = rest[1:]
+    elif spec.count(":") > 1:
+        return spec, 0  # bare IPv6 literal, no port
+    else:
+        host, sep, port = spec.rpartition(":")
+        if not sep:
+            return spec, 0
+    try:
+        return host or "127.0.0.1", int(port) if port else 0
+    except ValueError:
+        raise SystemExit(f"--learner-bind: bad port in {spec!r}")
 
 
 def make_config(args) -> Tuple[str, Any]:
@@ -488,7 +522,7 @@ def _open_checkpointer(args, make_template, cfg=None):
             make_template(),
             forbid_defaulted=obs_norm_restore_guard(cfg),
         )
-        print(f"[train] resumed from step {checkpointer.latest_step()}")
+        print(f"[train] resumed from step {checkpointer.last_restored_step}")
     return checkpointer, state
 
 
@@ -539,6 +573,10 @@ def format_return_hist(per_env) -> str:
 def _run(args, algo, cfg, writer) -> int:
     if args.render_dir and not args.eval:
         raise SystemExit("--render-dir requires --eval")
+    if args.learner_bind and not (algo == "impala" and args.actor_processes):
+        raise SystemExit(
+            "--learner-bind requires impala with --actor-processes"
+        )
     if args.eval:
         if not args.checkpoint_dir:
             raise SystemExit("--eval requires --checkpoint-dir")
@@ -582,7 +620,12 @@ def _run(args, algo, cfg, writer) -> int:
             )
 
         checkpointer, initial_state = _open_checkpointer(args, make_template)
-        runner = run_impala_distributed if args.actor_processes else run_impala
+        kwargs = {}
+        if args.actor_processes:
+            runner = run_impala_distributed
+            kwargs["host"], kwargs["port"] = parse_bind(args.learner_bind)
+        else:
+            runner = run_impala
         state, _ = runner(
             cfg,
             log_interval=args.log_interval,
@@ -590,6 +633,7 @@ def _run(args, algo, cfg, writer) -> int:
             checkpointer=checkpointer,
             checkpoint_interval=args.checkpoint_interval,
             initial_state=initial_state,
+            **kwargs,
         )
         steps_per_batch = (
             cfg.batch_trajectories * cfg.envs_per_actor * cfg.rollout_length

@@ -1,14 +1,22 @@
 """Distributed actor-learner plumbing: bounded trajectory queue with a
-starvation watchdog (in-process) and the socket transport that carries
-the same stream across process/host boundaries (the DCN leg)."""
+starvation watchdog (in-process), the socket transport that carries the
+same stream across process/host boundaries (the DCN leg), and the
+fault-tolerance layer above it (retry/reconnect, heartbeats, chaos
+testing)."""
 
 from actor_critic_algs_on_tensorflow_tpu.distributed.queue import (  # noqa: F401
     QueueStats,
     TrajectoryQueue,
 )
+from actor_critic_algs_on_tensorflow_tpu.distributed.resilience import (  # noqa: F401
+    ChaosProxy,
+    ResilientActorClient,
+    RetryPolicy,
+)
 from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (  # noqa: F401
     ActorClient,
     LearnerServer,
+    LearnerShutdown,
     pack_arrays,
     recv_msg,
     send_msg,

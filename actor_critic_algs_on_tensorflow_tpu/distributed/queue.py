@@ -41,6 +41,8 @@ class TrajectoryQueue:
         self.stats = QueueStats()
         self._lock = threading.Lock()
         self._timeout = watchdog_timeout_s
+        # Guarded by self._lock: appended by the watchdog thread, read
+        # by metrics()/watchdog_alerts on trainer threads.
         self._watchdog_alerts: list[str] = []
         self._closed = threading.Event()
         self._watchdog = threading.Thread(
@@ -76,14 +78,20 @@ class TrajectoryQueue:
                 "queue_gets": self.stats.gets,
                 "producer_blocked_s": round(self.stats.put_blocked_s, 3),
                 "consumer_blocked_s": round(self.stats.get_blocked_s, 3),
+                "queue_watchdog_alerts": len(self._watchdog_alerts),
             }
 
     @property
     def watchdog_alerts(self) -> list[str]:
-        return list(self._watchdog_alerts)
+        with self._lock:
+            return list(self._watchdog_alerts)
 
     def close(self) -> None:
         self._closed.set()
+        # Reap the watchdog so close() leaves no thread behind; it polls
+        # the closed event every timeout/4, so this join is bounded.
+        if self._watchdog.is_alive():
+            self._watchdog.join(timeout=self._timeout / 4 + 1.0)
 
     def _watch(self) -> None:
         """Flag starvation: a full queue nobody drains, or an empty queue
@@ -104,5 +112,6 @@ class TrajectoryQueue:
                 )
 
     def _alert(self, msg: str) -> None:
-        self._watchdog_alerts.append(msg)
+        with self._lock:
+            self._watchdog_alerts.append(msg)
         print(f"[TrajectoryQueue watchdog] {msg}", flush=True)

@@ -1,0 +1,413 @@
+"""Fault tolerance for the actor⇄learner runtime.
+
+SURVEY.md §3.3 calls the actor⇄learner trajectory stream "THE
+distributed-systems surface of the repo", and on a pod preemptions and
+flaky DCN links are the steady state, not the exception. This module
+supplies the retry layer above ``distributed.transport``:
+
+  - ``RetryPolicy``: exponential backoff with decorrelated jitter and a
+    hard deadline — pure, deterministic under injected rng/clock/sleep,
+    so the math is unit-testable without sockets.
+  - ``ResilientActorClient``: wraps ``ActorClient``, transparently
+    reconnecting and re-issuing ``push_trajectory``/``fetch_params`` on
+    ``ConnectionError``/``OSError``. This is semantically safe for the
+    IMPALA stream: V-trace's rho/c clipping already corrects stale and
+    duplicated trajectories, so at-least-once delivery is free at the
+    algorithm level. An orderly ``KIND_CLOSE`` from the learner
+    (``LearnerShutdown``) is terminal, never retried — actors exit
+    quietly at shutdown instead of hammering a gone learner.
+  - ``ChaosProxy``: a fault-injection TCP proxy (reset, delay,
+    truncate-mid-frame, refuse) that lets tests prove recovery through
+    a REAL ``LearnerServer`` + resilient actors end-to-end.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import socket
+import struct as struct_lib
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+    DEFAULT_MAX_FRAME_BYTES,
+    ActorClient,
+    LearnerShutdown,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff with decorrelated jitter and a deadline.
+
+    The delay after each failure is drawn uniformly from
+    ``[base_delay_s, prev_delay * 3]`` and capped at ``max_delay_s``
+    (decorrelated jitter — avoids retry synchronization across a fleet
+    of actors hitting the same restarted learner). The first failure
+    waits ``~base_delay_s``. When the cumulative BACKOFF slept reaches
+    ``deadline_s`` (or ``max_attempts`` attempts have failed), the LAST
+    error is raised to the caller.
+    """
+
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    # Budget for the cumulative backoff slept BETWEEN attempts. Time
+    # spent inside the operation itself never counts: a slow-to-fail op
+    # (e.g. a full 120 s idle window on a half-open connection, or a
+    # learner stalled in backpressure) still gets its retries, however
+    # long each attempt blocks. Fast-failing faults (connection
+    # refused while the learner restarts) exhaust the budget in
+    # ~deadline_s of wall-clock, which is the case it exists to bound.
+    deadline_s: float = 30.0
+    max_attempts: Optional[int] = None
+
+    def next_delay(self, prev_delay: float, rng: random.Random) -> float:
+        lo = self.base_delay_s
+        hi = max(lo, prev_delay * 3.0)
+        return min(self.max_delay_s, rng.uniform(lo, hi))
+
+    def execute(
+        self,
+        fn: Callable[[], object],
+        *,
+        retry_on: tuple = (ConnectionError, OSError),
+        no_retry: tuple = (LearnerShutdown,),
+        rng: random.Random | None = None,
+        sleep: Callable[[float], None] = time.sleep,
+        on_retry: Callable[[int, float, BaseException], None] | None = None,
+    ) -> object:
+        """Run ``fn`` until it succeeds or the policy is exhausted
+        (``deadline_s`` of cumulative backoff, or ``max_attempts``).
+
+        ``no_retry`` exceptions pass straight through even when they
+        subclass a ``retry_on`` type (``LearnerShutdown`` is a
+        ``ConnectionError`` but means "stop", not "try again").
+        ``sleep``/``rng`` are injectable for deterministic tests.
+        """
+        rng = rng if rng is not None else random.Random()
+        slept = 0.0
+        prev_delay = self.base_delay_s
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                return fn()
+            except no_retry:
+                raise
+            except retry_on as err:
+                if (
+                    self.max_attempts is not None
+                    and attempt >= self.max_attempts
+                ):
+                    raise
+                remaining = self.deadline_s - slept
+                if remaining <= 0:
+                    raise  # backoff budget exhausted: last error surfaces
+                delay = min(self.next_delay(prev_delay, rng), remaining)
+                prev_delay = max(delay, self.base_delay_s)
+                slept += delay
+                if on_retry is not None:
+                    on_retry(attempt, delay, err)
+                sleep(delay)
+
+
+class ResilientActorClient:
+    """``ActorClient`` with transparent reconnect + retry.
+
+    Every operation is re-issued through ``retry`` on
+    ``ConnectionError``/``OSError`` after dropping and re-establishing
+    the connection — safe for the IMPALA stream because V-trace makes
+    duplicated/stale trajectories benign (at-least-once delivery).
+    Heartbeats + the idle deadline are on by default so a wedged
+    learner is detected and the connection recycled instead of the
+    actor hanging forever. ``LearnerShutdown`` (orderly ``KIND_CLOSE``)
+    is never retried.
+
+    Thread-safe: operations serialize on an internal lock.
+    """
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        *,
+        retry: RetryPolicy | None = None,
+        heartbeat_interval_s: float | None = 10.0,
+        idle_timeout_s: float | None = 120.0,
+        connect_timeout: float = 10.0,
+        max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+        rng: random.Random | None = None,
+    ):
+        self._host, self._port = host, port
+        self._retry = retry if retry is not None else RetryPolicy()
+        self._heartbeat = heartbeat_interval_s
+        self._idle = idle_timeout_s
+        self._connect_timeout = connect_timeout
+        self._max_frame_bytes = max_frame_bytes
+        self._rng = rng if rng is not None else random.Random()
+        self._lock = threading.Lock()
+        self._client: ActorClient | None = None
+        self._ever_connected = False
+        self.reconnects = 0   # successful re-establishments after a drop
+        self.retries = 0      # operations re-issued after a fault
+        with self._lock:
+            self._retry.execute(self._ensure_connected, rng=self._rng)
+
+    # -- connection management (lock held) -----------------------------
+
+    def _ensure_connected(self) -> ActorClient:
+        if self._client is None:
+            self._client = ActorClient(
+                self._host,
+                self._port,
+                connect_timeout=self._connect_timeout,
+                heartbeat_interval_s=self._heartbeat,
+                idle_timeout_s=self._idle,
+                max_frame_bytes=self._max_frame_bytes,
+            )
+            if self._ever_connected:
+                self.reconnects += 1
+            self._ever_connected = True
+        return self._client
+
+    def _drop(self) -> None:
+        client, self._client = self._client, None
+        if client is not None:
+            client.abort()  # no goodbye frame on a broken connection
+
+    def _op(self, fn: Callable[[ActorClient], object]) -> object:
+        def attempt():
+            client = self._ensure_connected()
+            try:
+                return fn(client)
+            except LearnerShutdown:
+                raise  # orderly shutdown: terminal, not a fault
+            except (ConnectionError, OSError):
+                self._drop()
+                raise
+
+        def note_retry(attempt_no, delay, err):
+            self.retries += 1
+
+        return self._retry.execute(
+            attempt, rng=self._rng, on_retry=note_retry
+        )
+
+    # -- public API (mirrors ActorClient) ------------------------------
+
+    def push_trajectory(
+        self,
+        traj_leaves: Sequence[np.ndarray],
+        ep_leaves: Sequence[np.ndarray] = (),
+    ) -> int:
+        with self._lock:
+            return self._op(
+                lambda c: c.push_trajectory(traj_leaves, ep_leaves)
+            )
+
+    def fetch_params(self) -> Tuple[int, List[np.ndarray]]:
+        with self._lock:
+            return self._op(lambda c: c.fetch_params())
+
+    def stats(self) -> dict:
+        return {"reconnects": self.reconnects, "retries": self.retries}
+
+    def close(self) -> None:
+        with self._lock:
+            client, self._client = self._client, None
+            if client is not None:
+                client.close()
+
+
+def _hard_reset(sock: socket.socket) -> None:
+    """Close with SO_LINGER(1, 0): the peer sees RST, not FIN."""
+    try:
+        sock.setsockopt(
+            socket.SOL_SOCKET,
+            socket.SO_LINGER,
+            struct_lib.pack("ii", 1, 0),
+        )
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+class _Link:
+    """One proxied client⇄upstream connection."""
+
+    def __init__(self, client: socket.socket, upstream: socket.socket,
+                 truncate_after: int | None):
+        self.client = client
+        self.upstream = upstream
+        self.truncate_after = truncate_after  # upstream bytes before RST
+        self.lock = threading.Lock()
+        self.closed = False
+
+    def reset(self) -> None:
+        with self.lock:
+            if self.closed:
+                return
+            self.closed = True
+        _hard_reset(self.client)
+        _hard_reset(self.upstream)
+
+    def close(self) -> None:
+        with self.lock:
+            if self.closed:
+                return
+            self.closed = True
+        for s in (self.client, self.upstream):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class ChaosProxy:
+    """Fault-injection TCP proxy for chaos-testing the transport.
+
+    Actors connect to ``proxy.port``; the proxy forwards byte streams
+    to the target learner. Faults on command:
+
+      - ``reset_all()``            — RST every live link (connection
+        reset mid-anything, including mid-frame).
+      - ``set_truncate_after(n)``  — the NEXT link forwards exactly
+        ``n`` client→learner bytes, then RSTs (truncate mid-frame).
+      - ``set_delay(s)``           — sleep ``s`` before forwarding each
+        chunk (slow/laggy DCN link).
+      - ``set_refuse(flag)``       — refuse new connections (learner
+        down / restarting).
+      - ``set_target(host, port)`` — re-point at a restarted learner.
+    """
+
+    def __init__(self, target_host: str, target_port: int,
+                 *, host: str = "127.0.0.1"):
+        self._lock = threading.Lock()
+        self._target = (target_host, target_port)
+        self._delay = 0.0
+        self._refuse = False
+        self._truncate_after: int | None = None
+        self._links: List[_Link] = []
+        self.connections_total = 0
+        self._stop = threading.Event()
+        self._listener = socket.create_server((host, 0))
+        self._listener.settimeout(0.1)
+        self.port = self._listener.getsockname()[1]
+        self._threads: List[threading.Thread] = []
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="chaos-proxy-accept", daemon=True
+        )
+        self._accept_thread.start()
+
+    # -- fault controls -------------------------------------------------
+
+    def set_target(self, host: str, port: int) -> None:
+        with self._lock:
+            self._target = (host, port)
+
+    def set_delay(self, seconds: float) -> None:
+        with self._lock:
+            self._delay = seconds
+
+    def set_refuse(self, refuse: bool) -> None:
+        with self._lock:
+            self._refuse = refuse
+
+    def set_truncate_after(self, n_bytes: int) -> None:
+        """Arm a one-shot mid-stream truncation for the next link."""
+        with self._lock:
+            self._truncate_after = n_bytes
+
+    def reset_all(self) -> int:
+        """Hard-reset every live link; returns how many were reset."""
+        with self._lock:
+            links = [l for l in self._links if not l.closed]
+        for link in links:
+            link.reset()
+        return len(links)
+
+    def live_links(self) -> int:
+        with self._lock:
+            return sum(1 for l in self._links if not l.closed)
+
+    # -- plumbing -------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                client, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            with self._lock:
+                refuse, target = self._refuse, self._target
+                truncate, self._truncate_after = self._truncate_after, None
+            if refuse:
+                _hard_reset(client)
+                continue
+            try:
+                upstream = socket.create_connection(target, timeout=2.0)
+            except OSError:
+                _hard_reset(client)
+                continue
+            link = _Link(client, upstream, truncate)
+            with self._lock:
+                self._links = [l for l in self._links if not l.closed]
+                self._links.append(link)
+                self.connections_total += 1
+            # Sweep finished pump threads: reconnect churn is the
+            # proxy's designed workload, so the list must stay O(live).
+            self._threads = [t for t in self._threads if t.is_alive()]
+            for src, dst, is_up in (
+                (client, upstream, True),
+                (upstream, client, False),
+            ):
+                t = threading.Thread(
+                    target=self._pump, args=(link, src, dst, is_up),
+                    name="chaos-proxy-pump", daemon=True,
+                )
+                t.start()
+                self._threads.append(t)
+        self._listener.close()
+
+    def _pump(self, link: _Link, src: socket.socket, dst: socket.socket,
+              upstream: bool) -> None:
+        try:
+            while not link.closed:
+                data = src.recv(65536)
+                if not data:
+                    break
+                with self._lock:
+                    delay = self._delay
+                if delay:
+                    time.sleep(delay)
+                if upstream and link.truncate_after is not None:
+                    if len(data) >= link.truncate_after:
+                        dst.sendall(data[: link.truncate_after])
+                        link.reset()
+                        return
+                    link.truncate_after -= len(data)
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            # Crude full-close on either side ending: fine for a fault
+            # proxy (a half-closed link is indistinguishable from a
+            # fault to the retry layer anyway).
+            link.close()
+
+    def close(self) -> None:
+        self._stop.set()
+        with self._lock:
+            links = list(self._links)
+        for link in links:
+            link.close()
+        self._accept_thread.join(timeout=2.0)

@@ -24,14 +24,33 @@ send garbage data, not code):
 ``tag`` is message-dependent: the param version for PARAMS/ACK frames,
 the count of trajectory leaves (vs trailing episode-info leaves) for
 TRAJ frames.
+
+Fault tolerance (see ``distributed.resilience`` for the retry layer):
+
+  - every header field is validated against configurable limits before
+    any allocation, so a truncated or garbage frame raises a clean
+    ``ConnectionError`` instead of attempting a multi-GB allocation;
+  - ``KIND_PING``/``KIND_PONG`` heartbeats plus idle deadlines on both
+    sides detect a wedged peer and recycle the connection instead of
+    hanging forever on a blocking read;
+  - the server keeps a per-actor connection registry (liveness,
+    disconnect/reconnect counters, byte/frame totals) surfaced through
+    ``LearnerServer.metrics()`` into the trainer's log stream;
+  - ``LearnerServer.close()`` broadcasts ``KIND_CLOSE`` so actors exit
+    quietly (``LearnerShutdown``) instead of tripping over a reset
+    socket.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import math
+import select
 import socket
 import struct as struct_lib
 import threading
-from typing import Callable, List, Sequence, Tuple
+import time
+from typing import Callable, Dict, List, Sequence, Tuple
 
 import numpy as np
 
@@ -41,9 +60,28 @@ KIND_ACK = 2          # learner -> actor: tag = current param version
 KIND_GET_PARAMS = 3   # actor -> learner: request weights
 KIND_PARAMS = 4       # learner -> actor: tag = version, arrays = leaves
 KIND_CLOSE = 5        # either side: orderly shutdown
+KIND_PING = 6         # heartbeat probe (tag echoed back)
+KIND_PONG = 7         # heartbeat reply
 
 _HEADER = struct_lib.Struct(">4sBQI")
 _ARRAY_HEADER = struct_lib.Struct(">B")
+
+# Wire-hardening limits: a corrupt/truncated header must fail cleanly
+# BEFORE the receiver commits memory. Per-frame byte budget is
+# configurable (largest legitimate frame is a full params broadcast);
+# the structural limits below are far above anything the trainers emit.
+DEFAULT_MAX_FRAME_BYTES = 1 << 30   # 1 GiB
+MAX_ARRAYS_PER_FRAME = 65536        # params trees are O(100) leaves
+MAX_NDIM = 32
+MAX_DTYPE_LEN = 64
+
+
+class LearnerShutdown(ConnectionError):
+    """Peer announced an orderly shutdown (``KIND_CLOSE``).
+
+    Subclasses ``ConnectionError`` so legacy handlers still catch it,
+    but lets actors (and the retry layer) distinguish "the learner is
+    done — exit quietly" from a transport fault worth retrying."""
 
 
 def pack_arrays(kind: int, tag: int, arrays: Sequence[np.ndarray]) -> bytes:
@@ -84,20 +122,86 @@ def send_msg(
     sock.sendall(pack_arrays(kind, tag, arrays))
 
 
-def recv_msg(sock: socket.socket) -> Tuple[int, int, List[np.ndarray]]:
+def recv_msg(
+    sock: socket.socket,
+    *,
+    max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+) -> Tuple[int, int, List[np.ndarray]]:
+    """Read one frame, validating every header field against sane
+    limits BEFORE allocating, so garbage on the wire surfaces as a
+    clean ``ConnectionError`` rather than a multi-GB allocation."""
     magic, kind, tag, n = _HEADER.unpack(_recv_exact(sock, _HEADER.size))
     if magic != MAGIC:
         raise ConnectionError(f"bad frame magic {magic!r}")
+    if n > MAX_ARRAYS_PER_FRAME:
+        raise ConnectionError(
+            f"frame claims {n} arrays (limit {MAX_ARRAYS_PER_FRAME}) — "
+            f"corrupt header"
+        )
+    budget = max_frame_bytes
     arrays = []
     for _ in range(n):
         (dtype_len,) = _ARRAY_HEADER.unpack(_recv_exact(sock, 1))
-        dtype = np.dtype(_recv_exact(sock, dtype_len).decode())
+        if dtype_len > MAX_DTYPE_LEN:
+            raise ConnectionError(
+                f"frame dtype string of {dtype_len} bytes — corrupt header"
+            )
+        try:
+            dtype = np.dtype(_recv_exact(sock, dtype_len).decode())
+        except (UnicodeDecodeError, TypeError, ValueError) as e:
+            raise ConnectionError(f"bad dtype in frame: {e}") from e
         (ndim,) = struct_lib.unpack(">B", _recv_exact(sock, 1))
+        if ndim > MAX_NDIM:
+            raise ConnectionError(
+                f"frame array of rank {ndim} (limit {MAX_NDIM}) — "
+                f"corrupt header"
+            )
         shape = struct_lib.unpack(f">{ndim}Q", _recv_exact(sock, 8 * ndim))
         (nbytes,) = struct_lib.unpack(">Q", _recv_exact(sock, 8))
+        if nbytes > budget:
+            raise ConnectionError(
+                f"frame array of {nbytes} bytes exceeds the remaining "
+                f"{budget}-byte frame budget (max_frame_bytes="
+                f"{max_frame_bytes}) — corrupt or hostile header"
+            )
+        expected = math.prod(shape) * dtype.itemsize
+        if expected != nbytes:
+            raise ConnectionError(
+                f"frame array header inconsistent: shape {shape} x dtype "
+                f"{dtype.str} implies {expected} bytes, header claims "
+                f"{nbytes}"
+            )
+        budget -= nbytes
         payload = _recv_exact(sock, nbytes)
-        arrays.append(np.frombuffer(payload, dtype=dtype).reshape(shape))
+        try:
+            arrays.append(np.frombuffer(payload, dtype=dtype).reshape(shape))
+        except (ValueError, TypeError) as e:
+            raise ConnectionError(f"undecodable frame array: {e}") from e
     return kind, tag, arrays
+
+
+def _set_nodelay(sock: socket.socket) -> None:
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except OSError:
+        pass  # non-TCP socket (e.g. socketpair in tests)
+
+
+@dataclasses.dataclass
+class _Conn:
+    """Per-actor connection registry entry (server side)."""
+
+    cid: int
+    sock: socket.socket
+    addr: str
+    connected_at: float
+    last_recv: float
+    frames_in: int = 0
+    bytes_in: int = 0
+    trajectories: int = 0
+    send_lock: threading.Lock = dataclasses.field(
+        default_factory=threading.Lock
+    )
 
 
 class LearnerServer:
@@ -108,6 +212,15 @@ class LearnerServer:
     thread — typically a bounded ``TrajectoryQueue.put`` so the queue's
     backpressure and starvation watchdog apply unchanged to remote
     actors.
+
+    Fault tolerance: each connection lives in a registry with liveness
+    and byte/frame counters (``metrics()``/``connections()``); a peer
+    silent for ``idle_timeout_s`` is logged and recycled instead of
+    pinning a blocked thread forever; disconnects are logged and
+    counted, so the learner degrades gracefully (keeps training on
+    surviving actors, reports who it lost) rather than silently
+    starving. ``close()`` broadcasts ``KIND_CLOSE`` first so actors
+    exit quietly.
     """
 
     def __init__(
@@ -116,14 +229,34 @@ class LearnerServer:
         *,
         host: str = "127.0.0.1",
         port: int = 0,
+        idle_timeout_s: float | None = None,
+        max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+        log: Callable[[str], None] | None = None,
     ):
         self._on_trajectory = on_trajectory
+        self._idle_timeout = idle_timeout_s
+        self._max_frame_bytes = max_frame_bytes
+        self._log = log if log is not None else (
+            lambda msg: print(f"[learner-server] {msg}", flush=True)
+        )
         self._params_lock = threading.Lock()
         self._param_leaves: List[np.ndarray] = []
         self._version = 0
         self._stopping = threading.Event()
+        self._closing = threading.Event()  # graceful drain in progress
         self._conn_threads: List[threading.Thread] = []
-        self._conns: List[socket.socket] = []
+        # Registry: live connections + lifetime counters.
+        self._reg_lock = threading.Lock()
+        self._conns: Dict[int, _Conn] = {}
+        self._next_cid = 0
+        self._accepts = 0
+        self._disconnects = 0
+        self._graceful_closes = 0
+        self._idle_recycled = 0
+        self._frames_in = 0
+        self._bytes_in = 0
+        self._trajectories = 0
+        self._pings = 0
         self._listener = socket.create_server((host, port))
         self._listener.settimeout(0.2)
         self.port = self._listener.getsockname()[1]
@@ -143,54 +276,208 @@ class LearnerServer:
     def version(self) -> int:
         return self._version
 
+    def metrics(self) -> dict:
+        """Transport counters for the trainer's log stream."""
+        with self._reg_lock:
+            return {
+                "transport_actors_connected": len(self._conns),
+                "transport_accepts": self._accepts,
+                "transport_disconnects": self._disconnects,
+                "transport_graceful_closes": self._graceful_closes,
+                "transport_idle_recycled": self._idle_recycled,
+                "transport_frames_in": self._frames_in,
+                "transport_mb_in": round(self._bytes_in / 1e6, 6),
+                "transport_trajectories": self._trajectories,
+                "transport_pings": self._pings,
+            }
+
+    def connections(self) -> List[dict]:
+        """Per-actor liveness snapshot (registry view)."""
+        now = time.monotonic()
+        with self._reg_lock:
+            return [
+                {
+                    "cid": c.cid,
+                    "addr": c.addr,
+                    "age_s": round(now - c.connected_at, 3),
+                    "idle_s": round(now - c.last_recv, 3),
+                    "frames_in": c.frames_in,
+                    "bytes_in": c.bytes_in,
+                    "trajectories": c.trajectories,
+                }
+                for c in self._conns.values()
+            ]
+
     def _accept_loop(self) -> None:
         while not self._stopping.is_set():
             try:
-                conn, _ = self._listener.accept()
+                conn, addr = self._listener.accept()
             except socket.timeout:
                 continue
             except OSError:
                 break
-            self._conns.append(conn)
+            _set_nodelay(conn)
+            with self._reg_lock:
+                cid = self._next_cid
+                self._next_cid += 1
+                self._accepts += 1
+                now = time.monotonic()
+                c = _Conn(
+                    cid=cid, sock=conn, addr=f"{addr[0]}:{addr[1]}",
+                    connected_at=now, last_recv=now,
+                )
+                self._conns[cid] = c
             t = threading.Thread(
-                target=self._serve_conn, args=(conn,),
-                name="learner-server-conn", daemon=True,
+                target=self._serve_conn, args=(c,),
+                name=f"learner-server-conn-{cid}", daemon=True,
             )
             t.start()
+            # Reconnect churn is the designed steady state: sweep
+            # finished threads so the list stays O(live connections)
+            # over days of actor recycling, not O(every accept ever).
+            self._conn_threads = [
+                x for x in self._conn_threads if x.is_alive()
+            ]
             self._conn_threads.append(t)
         self._listener.close()
 
-    def _serve_conn(self, conn: socket.socket) -> None:
+    def _send(self, c: _Conn, kind: int, tag: int = 0, arrays=()) -> None:
+        with c.send_lock:
+            send_msg(c.sock, kind, tag, arrays)
+
+    def _retire(self, c: _Conn, reason: str) -> None:
+        with self._reg_lock:
+            if self._conns.pop(c.cid, None) is None:
+                return
+            if reason == "graceful":
+                self._graceful_closes += 1
+            elif reason == "idle":
+                self._idle_recycled += 1
+                self._disconnects += 1
+            else:
+                self._disconnects += 1
+
+    def _serve_conn(self, c: _Conn) -> None:
+        conn = c.sock
+        reason = "disconnect"
         try:
+            if self._idle_timeout is not None:
+                # Covers both "no frame for idle_timeout" and a peer
+                # wedged mid-frame; either way the connection is
+                # recycled (the resilient client just reconnects).
+                conn.settimeout(self._idle_timeout)
             while not self._stopping.is_set():
-                kind, tag, arrays = recv_msg(conn)
+                try:
+                    kind, tag, arrays = recv_msg(
+                        conn, max_frame_bytes=self._max_frame_bytes
+                    )
+                except socket.timeout:
+                    # A timeout with no idle deadline configured, or
+                    # during the graceful drain, is an artifact of
+                    # close()'s bounded goodbye send temporarily
+                    # shortening this socket's timeout — not idleness.
+                    if (
+                        self._idle_timeout is None
+                        or self._closing.is_set()
+                    ):
+                        break
+                    reason = "idle"
+                    self._log(
+                        f"actor#{c.cid} ({c.addr}) silent for "
+                        f"{self._idle_timeout:.0f}s; recycling connection"
+                    )
+                    break
+                with self._reg_lock:
+                    c.last_recv = time.monotonic()
+                    c.frames_in += 1
+                    self._frames_in += 1
+                    nbytes = sum(int(a.nbytes) for a in arrays)
+                    c.bytes_in += nbytes
+                    self._bytes_in += nbytes
+                    if kind == KIND_TRAJ:
+                        c.trajectories += 1
+                        self._trajectories += 1
+                    elif kind == KIND_PING:
+                        self._pings += 1
                 if kind == KIND_TRAJ:
                     self._on_trajectory(arrays[:tag], arrays[tag:])
-                    send_msg(conn, KIND_ACK, self._version)
+                    self._send(c, KIND_ACK, self._version)
                 elif kind == KIND_GET_PARAMS:
                     with self._params_lock:
                         leaves, version = self._param_leaves, self._version
-                    send_msg(conn, KIND_PARAMS, version, leaves)
+                    self._send(c, KIND_PARAMS, version, leaves)
+                elif kind == KIND_PING:
+                    self._send(c, KIND_PONG, tag)
                 elif kind == KIND_CLOSE:
+                    reason = "graceful"
                     break
                 else:
                     raise ConnectionError(f"unknown frame kind {kind}")
-        except (ConnectionError, OSError):
-            pass
+        except (ConnectionError, OSError) as e:
+            # Not the old silent ``except: pass`` — a lost actor is an
+            # event the learner should report (it keeps training on the
+            # survivors either way). Quiet during shutdown, where resets
+            # are expected.
+            if not self._stopping.is_set():
+                self._log(
+                    f"actor#{c.cid} ({c.addr}) lost: "
+                    f"{type(e).__name__}: {e}"
+                )
         finally:
+            self._retire(c, reason)
             conn.close()
 
-    def close(self) -> None:
+    def _broadcast_close(self) -> None:
+        with self._reg_lock:
+            live = list(self._conns.values())
+        for c in live:
+            # Best-effort: never block shutdown on a wedged peer —
+            # bound both the lock wait AND the send itself (a peer that
+            # stopped reading has a full send buffer; this socket is
+            # force-closed moments later anyway).
+            if c.send_lock.acquire(timeout=0.2):
+                try:
+                    c.sock.settimeout(0.2)
+                    send_msg(c.sock, KIND_CLOSE, self._version)
+                except OSError:
+                    pass
+                finally:
+                    try:
+                        c.sock.settimeout(
+                            self._idle_timeout
+                            if self._idle_timeout is not None else None
+                        )
+                    except OSError:
+                        pass
+                    c.send_lock.release()
+
+    def close(self, *, graceful: bool = True, grace_s: float = 1.0) -> None:
+        """Shut down: broadcast ``KIND_CLOSE`` to live actors (unless
+        ``graceful=False`` — the crash-simulation path used by the
+        chaos tests), keep serving through a ``grace_s`` drain window so
+        actors mid-operation read the goodbye instead of a reset, then
+        force-close stragglers so no thread is left blocked in recv."""
+        if graceful and not self._stopping.is_set():
+            self._closing.set()
+            self._broadcast_close()
+            deadline = time.monotonic() + grace_s
+            for t in self._conn_threads:
+                t.join(timeout=max(0.0, deadline - time.monotonic()))
+            # Anyone who connected mid-drain still gets a goodbye
+            # before the force-close below.
+            self._broadcast_close()
         self._stopping.set()
-        # Force-close live connections so peers (and the threads blocked
+        # Force-close whatever is left so peers (and the threads blocked
         # in recv on them) observe shutdown instead of hanging.
-        for c in self._conns:
+        with self._reg_lock:
+            remaining = list(self._conns.values())
+        for c in remaining:
             try:
-                c.shutdown(socket.SHUT_RDWR)
+                c.sock.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
             try:
-                c.close()
+                c.sock.close()
             except OSError:
                 pass
         self._accept_thread.join(timeout=2.0)
@@ -199,16 +486,106 @@ class LearnerServer:
 
 
 class ActorClient:
-    """Actor-process side: push trajectories, pull weights."""
+    """Actor-process side: push trajectories, pull weights.
 
-    def __init__(self, host: str, port: int, *, connect_timeout: float = 60.0):
+    With ``heartbeat_interval_s`` set, the client sends ``KIND_PING``
+    while waiting for a reply and — when ``idle_timeout_s`` is also set
+    — gives up with ``ConnectionError`` after that much silence, so a
+    wedged learner is detected instead of blocking the actor forever.
+    Both default to ``None``: plain blocking I/O, where a stalled
+    learner (queue-full backpressure, long jit compile) blocks the
+    actor by design — backpressure is the flow control. The resilient
+    wrapper (``distributed.resilience.ResilientActorClient``) turns
+    both on and reconnects on failure.
+    """
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        *,
+        connect_timeout: float = 60.0,
+        heartbeat_interval_s: float | None = None,
+        idle_timeout_s: float | None = None,
+        max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+    ):
         self._sock = socket.create_connection(
             (host, port), timeout=connect_timeout
         )
-        # Blocking I/O after connect: a stalled learner (queue-full
-        # backpressure, long jit compile) must block the actor, not
-        # time it out — backpressure is the flow control.
         self._sock.settimeout(None)
+        _set_nodelay(self._sock)
+        self._heartbeat = heartbeat_interval_s
+        self._idle = idle_timeout_s
+        self._max_frame_bytes = max_frame_bytes
+
+    def _send(self, kind: int, tag: int = 0, arrays=()) -> None:
+        """Send one frame; with an idle deadline configured, a send that
+        stalls past it (peer wedged, both TCP buffers full) raises
+        instead of blocking forever."""
+        if self._idle is not None:
+            self._sock.settimeout(self._idle)
+        try:
+            send_msg(self._sock, kind, tag, arrays)
+        except socket.timeout as e:
+            raise ConnectionError(
+                f"send stalled for {self._idle:.0f}s (peer wedged?)"
+            ) from e
+        finally:
+            if self._idle is not None:
+                self._sock.settimeout(None)
+
+    def _next_frame(self) -> Tuple[int, int, List[np.ndarray]]:
+        sock = self._sock
+        if self._heartbeat is None:
+            return recv_msg(sock, max_frame_bytes=self._max_frame_bytes)
+        deadline = (
+            time.monotonic() + self._idle if self._idle is not None else None
+        )
+        while True:
+            # select-then-recv: the wait is interruptible for pings
+            # without ever timing out MID-frame (which would desync the
+            # stream). A peer that stalls mid-frame hits the recv
+            # timeout below and the connection is dropped.
+            readable, _, _ = select.select([sock], [], [], self._heartbeat)
+            if not readable:
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise ConnectionError(
+                        f"learner unresponsive for {self._idle:.0f}s "
+                        f"(idle deadline; no frames despite heartbeats)"
+                    )
+                sock.settimeout(self._heartbeat)
+                try:
+                    send_msg(sock, KIND_PING)
+                except socket.timeout as e:
+                    # A timed-out sendall may have written PART of the
+                    # frame: the stream is desynced beyond repair, so
+                    # fail the connection now rather than let the
+                    # server choke on misaligned bytes later.
+                    raise ConnectionError(
+                        "heartbeat send stalled (peer wedged?)"
+                    ) from e
+                finally:
+                    sock.settimeout(None)
+                continue
+            if self._idle is not None:
+                sock.settimeout(self._idle)
+            try:
+                return recv_msg(sock, max_frame_bytes=self._max_frame_bytes)
+            except socket.timeout as e:
+                raise ConnectionError("peer stalled mid-frame") from e
+            finally:
+                sock.settimeout(None)
+
+    def _await_reply(self) -> Tuple[int, int, List[np.ndarray]]:
+        """Next substantive frame: skips PONGs, turns ``KIND_CLOSE``
+        into ``LearnerShutdown``."""
+        while True:
+            kind, tag, arrays = self._next_frame()
+            if kind == KIND_PONG:
+                continue
+            if kind == KIND_CLOSE:
+                raise LearnerShutdown("learner closed the stream")
+            return kind, tag, arrays
 
     def push_trajectory(
         self,
@@ -219,15 +596,15 @@ class ActorClient:
         (from the ack), so the caller knows when to re-fetch weights."""
         arrays = [np.asarray(x) for x in traj_leaves]
         arrays += [np.asarray(x) for x in ep_leaves]
-        send_msg(self._sock, KIND_TRAJ, len(traj_leaves), arrays)
-        kind, tag, _ = recv_msg(self._sock)
+        self._send(KIND_TRAJ, len(traj_leaves), arrays)
+        kind, tag, _ = self._await_reply()
         if kind != KIND_ACK:
             raise ConnectionError(f"expected ACK, got kind {kind}")
         return tag
 
     def fetch_params(self) -> Tuple[int, List[np.ndarray]]:
-        send_msg(self._sock, KIND_GET_PARAMS)
-        kind, version, leaves = recv_msg(self._sock)
+        self._send(KIND_GET_PARAMS)
+        kind, version, leaves = self._await_reply()
         if kind != KIND_PARAMS:
             raise ConnectionError(f"expected PARAMS, got kind {kind}")
         return version, leaves
@@ -238,3 +615,10 @@ class ActorClient:
         except OSError:
             pass
         self._sock.close()
+
+    def abort(self) -> None:
+        """Close without the goodbye frame (connection already broken)."""
+        try:
+            self._sock.close()
+        except OSError:
+            pass

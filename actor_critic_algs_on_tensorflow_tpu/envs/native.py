@@ -37,6 +37,23 @@ _BUILD_LOCK = threading.Lock()
 _LIB = None
 
 
+def _compile() -> None:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    proc = subprocess.run(
+        [
+            "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+            "-pthread", _SRC, "-o", _LIB_PATH,
+        ],
+        capture_output=True,
+        text=True,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native envpool build failed "
+            f"(exit {proc.returncode}):\n{proc.stderr}"
+        )
+
+
 def _load_library() -> ctypes.CDLL:
     """Compile (once) and load the native pool."""
     global _LIB
@@ -46,21 +63,15 @@ def _load_library() -> ctypes.CDLL:
         if not os.path.exists(_LIB_PATH) or os.path.getmtime(
             _SRC
         ) > os.path.getmtime(_LIB_PATH):
-            os.makedirs(_BUILD_DIR, exist_ok=True)
-            proc = subprocess.run(
-                [
-                    "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                    "-pthread", _SRC, "-o", _LIB_PATH,
-                ],
-                capture_output=True,
-                text=True,
-            )
-            if proc.returncode != 0:
-                raise RuntimeError(
-                    f"native envpool build failed "
-                    f"(exit {proc.returncode}):\n{proc.stderr}"
-                )
-        lib = ctypes.CDLL(_LIB_PATH)
+            _compile()
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            # A cached binary from a different toolchain (e.g. a newer
+            # libstdc++ than this host ships) fails to load; rebuilding
+            # from source against the local toolchain recovers.
+            _compile()
+            lib = ctypes.CDLL(_LIB_PATH)
         lib.envpool_create.restype = ctypes.c_void_p
         lib.envpool_create.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_uint64,

@@ -23,6 +23,27 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DATA_AXIS = "data"
 
 
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = False):
+    """``jax.shard_map`` across jax versions.
+
+    Newer jax exposes ``jax.shard_map`` with a ``check_vma`` flag; older
+    releases only ship ``jax.experimental.shard_map.shard_map`` whose
+    equivalent flag is ``check_rep``. All call sites in this repo go
+    through this wrapper so the codebase runs on both.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
 @functools.cache
 def donation_supported() -> bool:
     """Whether jit buffer donation is safe on the active backend.

@@ -39,6 +39,15 @@ def obs_norm_restore_guard(cfg) -> dict[str, str] | None:
     return {"obs_rms": hint, ".extra": hint}
 
 
+class RestoreMismatch(ValueError):
+    """Checkpoint/template schema or config-policy mismatch.
+
+    Distinct from corruption: it afflicts every retained step of the
+    run equally, so the crash-safe restore-latest fallback must NOT
+    swallow it (a ``ValueError`` subclass, so existing handlers and
+    tests keep matching)."""
+
+
 class Checkpointer:
     """Thin orbax CheckpointManager wrapper over one train-state pytree."""
 
@@ -56,12 +65,18 @@ class Checkpointer:
                 enable_async_checkpointing=async_save,
             ),
         )
+        # Step id the last successful restore() actually loaded — the
+        # crash-safe fallback can make this OLDER than latest_step().
+        self.last_restored_step: int | None = None
 
     def save(self, step: int, state: Any) -> None:
         self._mgr.save(int(step), args=ocp.args.StandardSave(state))
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return sorted(int(s) for s in self._mgr.all_steps())
 
     def restore(
         self,
@@ -74,6 +89,16 @@ class Checkpointer:
 
         ``example_state`` may be a concrete state (e.g. ``fns.init(key)``)
         whose shardings the restored arrays adopt.
+
+        Crash-safe: with ``step=None`` (restore-latest, the resume
+        path), a latest checkpoint that fails to load — corrupt or
+        partial, e.g. a preemption mid-save — falls back to the
+        next-older retained step with a warning instead of raising, so
+        a preempted run still resumes. Schema/config mismatches
+        (``RestoreMismatch``: graft rejections, the ``forbid_defaulted``
+        guard) do NOT fall back — they afflict every retained step
+        equally, so the latest step's error surfaces immediately. An
+        explicit ``step`` is restored exactly or not at all.
 
         Forward-compatible with checkpoints that predate fields added
         to the state later (e.g. TD3's ``opt_state["updates_done"]``
@@ -89,23 +114,85 @@ class Checkpointer:
         normalization statistics under ``normalize_obs=True``), a fresh
         init value is silently-wrong state, not a benign migration.
         """
-        if step is None:
-            step = self._mgr.latest_step()
-        if step is None:
+        import warnings
+
+        if step is not None:
+            return self._restore_step(
+                int(step), example_state, forbid_defaulted
+            )
+        steps = self.all_steps()
+        if not steps:
             raise FileNotFoundError("no checkpoint found")
-        abstract = jax.tree_util.tree_map(
-            ocp.utils.to_shape_dtype_struct, example_state
-        )
+        corrupt: list[int] = []
+        for i, s in enumerate(reversed(steps)):
+            try:
+                out = self._restore_step(s, example_state, forbid_defaulted)
+            except RestoreMismatch:
+                # A schema/config policy failure, not corruption: every
+                # retained step shares the format, so falling back would
+                # only bury the real error under misleading warnings.
+                raise
+            except Exception as err:
+                older = steps[-(i + 2)] if i + 1 < len(steps) else None
+                if older is None:
+                    raise
+                warnings.warn(
+                    f"checkpoint at step {s} failed to restore "
+                    f"({type(err).__name__}: {err}); falling back to step "
+                    f"{older} — the newer save may be partial (preemption "
+                    f"mid-save)",
+                    stacklevel=2,
+                )
+                corrupt.append(s)
+                continue
+            # Drop the corrupt newer steps, or the resumed run crashes
+            # with StepAlreadyExistsError the moment it re-saves one of
+            # those ids (the dirs are finalized, just unreadable).
+            for bad in corrupt:
+                try:
+                    self._mgr.delete(bad)
+                    warnings.warn(
+                        f"removed corrupt checkpoint step {bad} so the "
+                        f"resumed run can re-save it",
+                        stacklevel=2,
+                    )
+                except Exception as del_err:
+                    warnings.warn(
+                        f"could not remove corrupt checkpoint step {bad} "
+                        f"({type(del_err).__name__}: {del_err}); re-saving "
+                        f"that step id will fail",
+                        stacklevel=2,
+                    )
+            return out
+        raise FileNotFoundError("no restorable checkpoint found")
+
+    def _restore_step(
+        self,
+        step: int,
+        example_state: Any,
+        forbid_defaulted: dict[str, str] | None,
+    ) -> Any:
+        def _abstract(x):
+            # eval_shape templates are already ShapeDtypeStructs, with
+            # sharding=None; older orbax's to_shape_dtype_struct trips
+            # over that, so pass them through untouched.
+            if isinstance(x, jax.ShapeDtypeStruct):
+                return x
+            return ocp.utils.to_shape_dtype_struct(x)
+
+        abstract = jax.tree_util.tree_map(_abstract, example_state)
         try:
-            return self._mgr.restore(
+            out = self._mgr.restore(
                 step, args=ocp.args.StandardRestore(abstract)
             )
         except (ValueError, KeyError, TypeError) as strict_err:
             raw = self._mgr.restore(step)
-            return _graft(
+            out = _graft(
                 example_state, raw, strict_err,
                 forbid_defaulted=forbid_defaulted,
             )
+        self.last_restored_step = step
+        return out
 
     def wait(self) -> None:
         """Block until async saves are durable (call before exit)."""
@@ -164,7 +251,7 @@ def _graft(
             except (TypeError, ValueError) as exc:
                 # e.g. the checkpoint holds a subtree where the template
                 # has an array leaf: a structural retype, not an addition.
-                raise ValueError(
+                raise RestoreMismatch(
                     f"checkpoint migration: {jax.tree_util.keystr(path)} is "
                     f"not an array in the checkpoint ({type(saved).__name__})"
                     f" — not a field addition; strict error: {strict_err!r}"
@@ -173,7 +260,7 @@ def _graft(
                 arr.shape != example_leaf.shape
                 or arr.dtype != example_leaf.dtype
             ):
-                raise ValueError(
+                raise RestoreMismatch(
                     f"checkpoint migration: {jax.tree_util.keystr(path)} is "
                     f"{arr.shape}/{arr.dtype} in the checkpoint but "
                     f"{example_leaf.shape}/{example_leaf.dtype} in the "
@@ -190,7 +277,7 @@ def _graft(
         # old key, or otherwise diverged structures): the strict
         # failure stands. Note a rename ALSO defaults the new-name
         # template leaf, so it cannot masquerade as a field addition.
-        raise ValueError(
+        raise RestoreMismatch(
             f"checkpoint does not match the template and the mismatch is "
             f"not a pure field addition ({len(defaulted)} template leaves "
             f"missing from the checkpoint, {n_saved - consumed} saved "
@@ -200,7 +287,7 @@ def _graft(
         for frag, hint in forbid_defaulted.items():
             hit = [p for p in defaulted if frag in p]
             if hit:
-                raise ValueError(
+                raise RestoreMismatch(
                     f"checkpoint predates {', '.join(hit)}, and this run "
                     f"configuration actively reads that state — refusing "
                     f"to restore with fresh (init) values. {hint}"

@@ -17,15 +17,35 @@ os.environ["XLA_FLAGS"] = (
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # Older jax (< 0.5) has no jax_num_cpu_devices option; the
+    # XLA_FLAGS fallback above already forces the 8-device host mesh.
+    pass
 
 # The suite is compile-bound (every mesh test pays XLA compilation on
 # 8 virtual devices); a persistent compilation cache makes warm runs
-# fast. Keyed by JAX/XLA version, so upgrades invalidate cleanly.
-_cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
-jax.config.update("jax_compilation_cache_dir", _cache_dir)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+# fast. Two hard-won caveats on old toolchains (jax 0.4.x):
+#   - entries serialized by one jax/jaxlib version segfault another on
+#     reload, so the versions are part of the DIRECTORY name, not just
+#     the cache key;
+#   - executables DESERIALIZED from the cache heap-corrupt the process
+#     when orbax restore runs in it (reproduced on jaxlib 0.4.36:
+#     cold-compile + restore is fine, warm-cache + restore crashes in
+#     the first post-restore iteration, with or without fresh copies of
+#     the restored buffers) — so the cache stays OFF below jax 0.5.
+_jax_version = tuple(int(x) for x in jax.__version__.split(".")[:2])
+if _jax_version >= (0, 5):
+    import jaxlib
+
+    _cache_dir = os.path.join(
+        os.path.dirname(__file__),
+        f".jax_cache-{jax.__version__}-{getattr(jaxlib, '__version__', '0')}",
+    )
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
 assert len(jax.devices()) == 8, jax.devices()
 

@@ -1,7 +1,34 @@
 """Shared test helpers (imported as a plain module, not via conftest)."""
 
+import contextlib
+import signal
+
 import jax
 import jax.numpy as jnp
+
+
+@contextlib.contextmanager
+def time_limit(seconds: float, what: str = "test"):
+    """Hard wall-clock guard for socket/thread tests: a hang raises
+    ``TimeoutError`` in the main thread (SIGALRM) instead of wedging
+    the whole suite. No-op off the main thread or without SIGALRM."""
+    if not hasattr(signal, "SIGALRM"):
+        yield
+        return
+    def _raise(signum, frame):
+        raise TimeoutError(f"{what} exceeded {seconds}s")
+
+    try:
+        prev = signal.signal(signal.SIGALRM, _raise)
+    except ValueError:  # not on the main thread
+        yield
+        return
+    signal.setitimer(signal.ITIMER_REAL, seconds)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0.0)
+        signal.signal(signal.SIGALRM, prev)
 
 from actor_critic_algs_on_tensorflow_tpu import envs as envs_lib
 from actor_critic_algs_on_tensorflow_tpu.algos import common

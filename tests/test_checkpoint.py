@@ -41,6 +41,92 @@ def test_resume_is_loss_curve_continuous(tmp_path):
     np.testing.assert_allclose(first + rest, full, rtol=1e-6)
 
 
+def test_restore_falls_back_to_older_step_when_latest_is_corrupt(tmp_path):
+    """Preemption mid-save leaves a partial/corrupt latest step: the
+    resume path must warn and fall back to the next-older retained
+    checkpoint instead of raising (crash-safe restore)."""
+    cfg = a2c.A2CConfig(num_envs=16, rollout_length=8)
+    fns = a2c.make_a2c(cfg)
+    state = fns.init(jax.random.PRNGKey(0))
+    step3 = int(state.step)
+    ckpt = Checkpointer(tmp_path / "ckpt3", async_save=False)
+    ckpt.save(3, state)
+    ckpt.wait()
+    state4, _ = fns.iteration(state)  # donation: `state` is consumed
+    jax.block_until_ready(state4)
+    ckpt.save(4, state4)
+    ckpt.wait()
+
+    # Simulate the preempted save: truncate every file of step 4.
+    step_dir = tmp_path / "ckpt3" / "4"
+    assert step_dir.exists()
+    truncated = 0
+    for p in step_dir.rglob("*"):
+        if p.is_file():
+            p.write_bytes(b"")
+            truncated += 1
+    assert truncated > 0
+
+    template = fns.init(jax.random.PRNGKey(1))
+    with pytest.warns(UserWarning, match="falling back to step 3"):
+        restored = ckpt.restore(template)
+    assert int(restored.step) == step3
+    assert ckpt.last_restored_step == 3
+    # The corrupt step was removed, so the resumed run can re-save the
+    # same step id (otherwise orbax raises StepAlreadyExistsError when
+    # training reaches it again).
+    assert ckpt.all_steps() == [3]
+    restored, metrics = fns.iteration(restored)
+    assert np.isfinite(float(metrics["loss"]))
+    jax.block_until_ready(restored)
+    ckpt.save(4, restored)
+    ckpt.wait()
+    assert ckpt.all_steps() == [3, 4]
+
+    # An EXPLICIT step request must still fail loudly, not fall back.
+    with pytest.raises(Exception):
+        ckpt.restore(template, step=5)
+    ckpt.close()
+
+
+def test_restore_schema_mismatch_does_not_trigger_fallback(tmp_path):
+    """A schema/config mismatch (RestoreMismatch) afflicts every
+    retained step equally: restore-latest must surface it immediately
+    instead of burying it under partial-save fallback warnings."""
+    import warnings as warnings_lib
+
+    from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
+        RestoreMismatch,
+    )
+
+    cfg = a2c.A2CConfig(num_envs=16, rollout_length=8)
+    fns = a2c.make_a2c(cfg)
+    state = fns.init(jax.random.PRNGKey(0))
+    ckpt = Checkpointer(tmp_path / "ckpt-mm", async_save=False)
+    ckpt.save(1, state)
+    ckpt.save(2, state)
+    ckpt.wait()
+
+    # Template whose params have a different shape: a graft-rejected
+    # mismatch, identical for both retained steps.
+    bad_template = fns.init(jax.random.PRNGKey(0))
+    bad_template = bad_template.replace(
+        params=jax.tree_util.tree_map(
+            lambda x: jax.numpy.zeros(x.shape + (2,), x.dtype)
+            if x.ndim >= 1 else x,
+            bad_template.params,
+        )
+    )
+    with warnings_lib.catch_warnings(record=True) as caught:
+        warnings_lib.simplefilter("always")
+        with pytest.raises(RestoreMismatch):
+            ckpt.restore(bad_template)
+    assert not any(
+        "falling back" in str(w.message) for w in caught
+    ), "schema mismatch was masked by the partial-save fallback"
+    ckpt.close()
+
+
 def test_latest_step_and_missing(tmp_path):
     ckpt = Checkpointer(tmp_path / "ckpt2", async_save=False)
     assert ckpt.latest_step() is None

@@ -45,6 +45,18 @@ def test_queue_watchdog_flags_starvation():
     q = TrajectoryQueue(maxsize=2, watchdog_timeout_s=0.4)
     time.sleep(1.0)  # nobody produces -> "actors stalled"
     assert any("actors stalled" in a for a in q.watchdog_alerts)
+    # Alert counts ride the metrics stream the learner logs.
+    assert q.metrics()["queue_watchdog_alerts"] >= 1
+    q.close()
+
+
+def test_queue_close_joins_watchdog_thread():
+    q = TrajectoryQueue(maxsize=2, watchdog_timeout_s=0.2)
+    watchdog = q._watchdog
+    assert watchdog.is_alive()
+    q.close()
+    assert not watchdog.is_alive(), "close() left the watchdog running"
+    # Idempotent.
     q.close()
 
 

@@ -22,6 +22,8 @@ _WORKER = textwrap.dedent(
 
     jax.config.update("jax_platforms", "cpu")
 
+    from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import shard_map
+
     from actor_critic_algs_on_tensorflow_tpu.parallel import multihost
 
     addr = sys.argv[1]
@@ -51,7 +53,7 @@ _WORKER = textwrap.dedent(
         NamedSharding(mesh, P("data")), np.asarray([float(pid + 1)])
     )
     out = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda x: jax.lax.psum(x, "data"),
             mesh=mesh,
             in_specs=P("data"),

@@ -5,7 +5,7 @@ import jax
 import jax.numpy as jnp
 import pytest
 import numpy as np
-from jax import shard_map
+from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from actor_critic_algs_on_tensorflow_tpu.ops import (

@@ -12,6 +12,7 @@ from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
     batch_sharded,
     make_mesh,
     replicated,
+    shard_map,
 )
 
 
@@ -50,7 +51,7 @@ def test_pmean_grads_equal_large_batch():
         return jax.lax.pmean(g, DATA_AXIS)
 
     mapped = jax.jit(
-        jax.shard_map(
+        shard_map(
             local,
             mesh=mesh,
             in_specs=(

@@ -5,6 +5,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from actor_critic_algs_on_tensorflow_tpu.data.replay import ReplayBuffer
+from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import shard_map
 
 
 def _items(lo, hi):
@@ -88,7 +89,7 @@ def test_sharded_per_device_replay():
         return jax.tree_util.tree_map(lambda x: x[None], state)
 
     step = jax.jit(
-        jax.shard_map(
+        shard_map(
             local,
             mesh=mesh,
             in_specs=(P("data"), P("data")),

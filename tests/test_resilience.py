@@ -1,0 +1,488 @@
+"""Fault tolerance of the actor⇄learner runtime: retry/backoff math,
+wire hardening, heartbeats/idle deadlines, transparent reconnect, and
+the end-to-end chaos scenario (resets + truncation + learner restart).
+
+Socket tests inject sub-second faults and carry a hard wall-clock guard
+(``helpers.time_limit``) so a regression hangs the TEST, not the suite.
+"""
+
+import random
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from actor_critic_algs_on_tensorflow_tpu.distributed.queue import (
+    TrajectoryQueue,
+)
+from actor_critic_algs_on_tensorflow_tpu.distributed.resilience import (
+    ChaosProxy,
+    ResilientActorClient,
+    RetryPolicy,
+)
+from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+    ActorClient,
+    LearnerServer,
+    LearnerShutdown,
+)
+from tests.helpers import time_limit
+
+
+# ---------------------------------------------------------------------
+# RetryPolicy: pure math, deterministic under injected rng/clock/sleep.
+# ---------------------------------------------------------------------
+
+class _FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+    def sleep(self, s):
+        self.now += s
+
+
+def test_retry_policy_jitter_bounds_and_cap():
+    policy = RetryPolicy(base_delay_s=0.1, max_delay_s=1.0, deadline_s=1e9)
+    rng = random.Random(0)
+    prev = policy.base_delay_s
+    for _ in range(200):
+        d = policy.next_delay(prev, rng)
+        # Decorrelated jitter: uniform over [base, prev*3], capped.
+        assert policy.base_delay_s <= d <= min(1.0, max(0.1, prev * 3))
+        assert d <= policy.max_delay_s  # capped exponent
+        prev = d
+
+
+def test_retry_policy_delay_growth_saturates_at_cap():
+    policy = RetryPolicy(base_delay_s=0.05, max_delay_s=0.4, deadline_s=1e9)
+
+    class _MaxRng:
+        def uniform(self, lo, hi):
+            return hi  # worst case: always the top of the window
+
+    prev = policy.base_delay_s
+    seen = []
+    for _ in range(10):
+        prev = policy.next_delay(prev, _MaxRng())
+        seen.append(prev)
+    assert seen[-1] == policy.max_delay_s
+    assert all(d <= policy.max_delay_s for d in seen)
+
+
+def test_retry_policy_success_after_failures():
+    policy = RetryPolicy(base_delay_s=0.01, max_delay_s=0.1, deadline_s=60.0)
+    clock = _FakeClock()
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 4:
+            raise ConnectionError(f"fault {calls['n']}")
+        return "ok"
+
+    retries = []
+    out = policy.execute(
+        flaky,
+        rng=random.Random(1),
+        sleep=clock.sleep,
+        on_retry=lambda n, d, e: retries.append((n, d, str(e))),
+    )
+    assert out == "ok"
+    assert calls["n"] == 4
+    assert len(retries) == 3
+    assert clock.now > 0  # backoff actually slept
+
+
+def test_retry_policy_deadline_exhaustion_raises_last_error():
+    policy = RetryPolicy(base_delay_s=0.5, max_delay_s=1.0, deadline_s=2.0)
+    clock = _FakeClock()
+    calls = {"n": 0}
+
+    def always_fails():
+        calls["n"] += 1
+        raise ConnectionError(f"fault {calls['n']}")
+
+    with pytest.raises(ConnectionError) as exc_info:
+        policy.execute(
+            always_fails, rng=random.Random(2), sleep=clock.sleep,
+        )
+    # The LAST error surfaces (not the first), after a bounded number
+    # of attempts, and the deadline capped the total time slept.
+    assert calls["n"] >= 2
+    assert str(exc_info.value) == f"fault {calls['n']}"
+    assert clock.now <= policy.deadline_s + policy.max_delay_s
+
+
+def test_retry_policy_op_time_does_not_consume_budget():
+    """An op that blocks longer than the deadline BEFORE failing (e.g.
+    a 120s idle window on a half-open link, or a learner stalled in
+    backpressure) must still get retries — deadline_s budgets the
+    backoff slept between attempts, never the operation itself."""
+    policy = RetryPolicy(base_delay_s=0.01, max_delay_s=0.1, deadline_s=30.0)
+    clock = _FakeClock()
+    calls = {"n": 0}
+
+    def slow_to_fail_then_recover():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            clock.now += 120.0  # blocked far past the deadline
+            raise ConnectionError("idle deadline")
+        return "recovered"
+
+    out = policy.execute(
+        slow_to_fail_then_recover, rng=random.Random(3), sleep=clock.sleep,
+    )
+    assert out == "recovered"
+    assert calls["n"] == 2
+
+
+def test_retry_policy_max_attempts():
+    policy = RetryPolicy(base_delay_s=0.01, deadline_s=1e9, max_attempts=3)
+    clock = _FakeClock()
+    calls = {"n": 0}
+
+    def always_fails():
+        calls["n"] += 1
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        policy.execute(
+            always_fails, rng=random.Random(0), sleep=clock.sleep,
+        )
+    assert calls["n"] == 3
+
+
+def test_retry_policy_no_retry_passes_through():
+    policy = RetryPolicy(base_delay_s=0.01, deadline_s=1e9)
+    calls = {"n": 0}
+
+    def shutdown():
+        calls["n"] += 1
+        raise LearnerShutdown("bye")
+
+    # LearnerShutdown IS a ConnectionError, but means "stop".
+    with pytest.raises(LearnerShutdown):
+        policy.execute(shutdown, sleep=lambda s: None)
+    assert calls["n"] == 1
+
+
+# ---------------------------------------------------------------------
+# Heartbeats and idle deadlines.
+# ---------------------------------------------------------------------
+
+def test_client_detects_wedged_learner():
+    """A server that accepts and then never responds must be detected
+    by the idle deadline (pings outstanding), not block forever."""
+    with time_limit(20, "wedged-learner detection"):
+        wedged = socket.create_server(("127.0.0.1", 0))
+        port = wedged.getsockname()[1]
+        accepted = []
+        t = threading.Thread(
+            target=lambda: accepted.append(wedged.accept()), daemon=True
+        )
+        t.start()
+        client = ActorClient(
+            "127.0.0.1", port,
+            heartbeat_interval_s=0.05, idle_timeout_s=0.3,
+        )
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError, match="unresponsive|mid-frame"):
+            client.push_trajectory([np.zeros(4, np.float32)])
+        assert time.monotonic() - t0 < 5.0
+        client.abort()
+        wedged.close()
+
+
+def test_server_recycles_idle_connection():
+    """An actor that connects and goes silent is logged and recycled
+    by the server-side idle deadline instead of pinning a thread."""
+    with time_limit(20, "idle-recycle"):
+        logs = []
+        server = LearnerServer(
+            lambda t, e: None, idle_timeout_s=0.2, log=logs.append
+        )
+        try:
+            sock = socket.create_connection(("127.0.0.1", server.port))
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if server.metrics()["transport_idle_recycled"] == 1:
+                    break
+                time.sleep(0.02)
+            m = server.metrics()
+            assert m["transport_idle_recycled"] == 1
+            assert m["transport_accepts"] == 1
+            assert m["transport_actors_connected"] == 0
+            assert any("silent" in line for line in logs)
+            sock.close()
+        finally:
+            server.close()
+
+
+def test_heartbeats_keep_connection_alive_through_idle_window():
+    """Pings while waiting on a reply refresh the server's idle clock:
+    a SLOW learner (long on_trajectory) must not be mistaken for a
+    dead actor, and the ack must still arrive."""
+    with time_limit(20, "heartbeat keepalive"):
+        release = threading.Event()
+
+        def slow_sink(traj, ep):
+            release.wait(1.0)  # far longer than the idle window
+
+        server = LearnerServer(
+            slow_sink, idle_timeout_s=0.4, log=lambda m: None
+        )
+        try:
+            client = ActorClient(
+                "127.0.0.1", server.port,
+                heartbeat_interval_s=0.05, idle_timeout_s=5.0,
+            )
+            server.publish([np.zeros(1, np.float32)])
+            ack = client.push_trajectory([np.ones(8, np.float32)])
+            assert ack == 1
+            # The next op must skip the buffered PONGs cleanly.
+            version, leaves = client.fetch_params()
+            assert version == 1 and len(leaves) == 1
+            # Pings sat buffered while the sink blocked; the server
+            # reads (and counts) them right after the ack.
+            deadline = time.monotonic() + 2.0
+            while (
+                server.metrics()["transport_pings"] == 0
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.02)
+            assert server.metrics()["transport_pings"] >= 1
+            client.close()
+        finally:
+            release.set()
+            server.close()
+
+
+# ---------------------------------------------------------------------
+# Transparent reconnect through real faults.
+# ---------------------------------------------------------------------
+
+def _mk_policy():
+    return RetryPolicy(base_delay_s=0.01, max_delay_s=0.05, deadline_s=15.0)
+
+
+def test_resilient_client_survives_connection_reset():
+    with time_limit(30, "reset recovery"):
+        received = []
+        lock = threading.Lock()
+
+        def sink(traj, ep):
+            with lock:
+                received.append(int(traj[0][0]))
+
+        server = LearnerServer(sink, log=lambda m: None)
+        proxy = ChaosProxy("127.0.0.1", server.port)
+        try:
+            client = ResilientActorClient(
+                "127.0.0.1", proxy.port,
+                retry=_mk_policy(),
+                heartbeat_interval_s=0.1, idle_timeout_s=2.0,
+            )
+            for i in range(10):
+                if i == 4:
+                    assert proxy.reset_all() >= 1
+                client.push_trajectory([np.array([i, 7], np.int64)])
+            with lock:
+                got = sorted(set(received))
+            # At-least-once: every trajectory arrives (duplicates are
+            # V-trace-benign and allowed).
+            assert got == list(range(10))
+            assert client.reconnects >= 1
+            assert client.retries >= 1
+            # The server-side retire runs on the conn thread; give it a
+            # beat to observe the RST.
+            deadline = time.monotonic() + 5.0
+            while (
+                server.metrics()["transport_disconnects"] == 0
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.02)
+            assert server.metrics()["transport_disconnects"] >= 1
+            client.close()
+        finally:
+            proxy.close()
+            server.close()
+
+
+def test_resilient_client_shutdown_is_terminal():
+    """KIND_CLOSE must NOT be retried: the client raises
+    LearnerShutdown promptly even with a generous retry deadline."""
+    with time_limit(20, "shutdown terminal"):
+        server = LearnerServer(lambda t, e: None, log=lambda m: None)
+        client = ResilientActorClient(
+            "127.0.0.1", server.port,
+            retry=RetryPolicy(base_delay_s=0.01, deadline_s=60.0),
+            heartbeat_interval_s=0.1, idle_timeout_s=5.0,
+        )
+        done = []
+
+        def spin():
+            try:
+                while True:
+                    client.fetch_params()
+                    time.sleep(0.01)
+            except LearnerShutdown:
+                done.append("shutdown")
+            except (ConnectionError, OSError) as e:
+                done.append(f"fault: {e!r}")
+
+        server.publish([np.zeros(2, np.float32)])
+        t = threading.Thread(target=spin, daemon=True)
+        t.start()
+        time.sleep(0.15)
+        t0 = time.monotonic()
+        server.close()  # graceful: broadcasts KIND_CLOSE
+        t.join(timeout=10.0)
+        assert not t.is_alive(), "actor did not exit after KIND_CLOSE"
+        assert done and done[0] == "shutdown", done
+        assert time.monotonic() - t0 < 8.0
+
+
+# ---------------------------------------------------------------------
+# The acceptance chaos scenario: 4 resilient actors, resets +
+# truncate-mid-frame + a learner restart; >= 95% delivery, zero actor
+# crashes, and the learner's metrics report the damage.
+# ---------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_chaos_end_to_end_delivery():
+    with time_limit(60, "chaos end-to-end"):
+        n_actors, n_traj = 4, 30
+        q = TrajectoryQueue(maxsize=8, watchdog_timeout_s=60.0)
+        delivered: set = set()
+        drain_stop = threading.Event()
+
+        def drain():
+            import queue as queue_lib
+
+            while not drain_stop.is_set():
+                try:
+                    arrays = q.get(timeout=0.1)
+                except queue_lib.Empty:
+                    continue
+                ids = arrays[0]
+                delivered.add((int(ids[0]), int(ids[1])))
+
+        drainer = threading.Thread(target=drain, daemon=True)
+        drainer.start()
+
+        def sink(traj, ep):
+            q.put([np.asarray(a) for a in traj], timeout=30.0)
+
+        def mk_server():
+            return LearnerServer(
+                sink, idle_timeout_s=30.0, log=lambda m: None
+            )
+
+        server1 = mk_server()
+        proxy = ChaosProxy("127.0.0.1", server1.port)
+        errors: list = []
+        clients: list = []
+        start = threading.Barrier(n_actors + 1)
+
+        def actor(aid: int):
+            try:
+                client = ResilientActorClient(
+                    "127.0.0.1", proxy.port,
+                    retry=_mk_policy(),
+                    heartbeat_interval_s=0.1, idle_timeout_s=3.0,
+                )
+                clients.append(client)
+                start.wait(timeout=10.0)
+                payload = np.zeros(256, np.float32)  # ~1 KiB per frame
+                for i in range(n_traj):
+                    client.push_trajectory(
+                        [np.array([aid, i], np.int64), payload]
+                    )
+                    time.sleep(0.002)
+                client.close()
+            except BaseException as e:  # noqa: BLE001 - the assertion IS "no crash"
+                errors.append((aid, repr(e)))
+
+        threads = [
+            threading.Thread(target=actor, args=(a,), daemon=True)
+            for a in range(n_actors)
+        ]
+        for t in threads:
+            t.start()
+        start.wait(timeout=10.0)
+
+        # Fault 1: reset every live link mid-stream.
+        time.sleep(0.08)
+        proxy.reset_all()
+        # Fault 2: the next reconnecting link dies mid-frame.
+        proxy.set_truncate_after(600)
+        time.sleep(0.08)
+        # Fault 3: learner crash + restart (no goodbye frame), with a
+        # refuse window while it is "down".
+        proxy.set_refuse(True)
+        server1.close(graceful=False)
+        time.sleep(0.1)
+        server2 = mk_server()
+        proxy.set_target("127.0.0.1", server2.port)
+        proxy.set_refuse(False)
+
+        for t in threads:
+            t.join(timeout=30.0)
+        alive = [t for t in threads if t.is_alive()]
+        assert not alive, f"{len(alive)} actors wedged"
+        assert not errors, f"actor crashes: {errors}"
+
+        # Drain the queue tail, then stop the drainer.
+        deadline = time.monotonic() + 5.0
+        while q.depth() > 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        drain_stop.set()
+        drainer.join(timeout=5.0)
+
+        total = n_actors * n_traj
+        assert len(delivered) >= 0.95 * total, (
+            f"only {len(delivered)}/{total} unique trajectories delivered"
+        )
+        # The learner's metrics report the carnage: the crashed server
+        # saw disconnects; the restarted one saw every actor reconnect.
+        assert server1.metrics()["transport_disconnects"] >= 1
+        m2 = server2.metrics()
+        assert m2["transport_accepts"] >= n_actors
+        assert m2["transport_trajectories"] > 0
+        assert sum(c.reconnects for c in clients) >= n_actors
+        proxy.close()
+        server2.close()
+        q.close()
+
+
+def test_chaos_proxy_truncate_mid_frame():
+    """A frame cut mid-payload surfaces as a clean ConnectionError on
+    the server (wire hardening), and the resilient client re-pushes."""
+    with time_limit(30, "truncate recovery"):
+        received = []
+        server = LearnerServer(
+            lambda t, e: received.append(int(t[0][0])), log=lambda m: None
+        )
+        proxy = ChaosProxy("127.0.0.1", server.port)
+        try:
+            # Arm BEFORE connecting: the first link dies after 200
+            # upstream bytes — inside the first push's payload.
+            proxy.set_truncate_after(200)
+            client = ResilientActorClient(
+                "127.0.0.1", proxy.port,
+                retry=_mk_policy(),
+                heartbeat_interval_s=0.1, idle_timeout_s=2.0,
+            )
+            client.push_trajectory(
+                [np.array([5], np.int64), np.zeros(512, np.float32)]
+            )
+            assert 5 in received
+            assert client.reconnects >= 1
+            client.close()
+        finally:
+            proxy.close()
+            server.close()
