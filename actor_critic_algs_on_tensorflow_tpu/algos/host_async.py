@@ -216,15 +216,32 @@ def run_host_async(
     history = []
     clock = RateClock(steps_per_iteration, log_interval_iters)
     staged = None
+    staged_slot = -1
+    # Double-buffered host staging arenas: one preallocated contiguous
+    # buffer per Transition field per slot, filled with indexed writes
+    # in the env loop (no per-iteration list + np.stack allocation).
+    # A slot is rewritten only after its previous device transfer
+    # completed (stage_pending gate), so the async H2D copy can ride
+    # under env stepping without ever reading a half-overwritten slot.
+    stage_arenas: list = [None, None]
+    stage_pending: list = [None, None]
     snap_interval_eff = max(0, snapshot_interval) or 1
+
+    def dispatch_staged():
+        # device_put + ingest of the staged arena slot; records the
+        # transfer handle that gates the slot's reuse.
+        nonlocal replay, inserted
+        staged_dev = jax.device_put(staged, accel)
+        stage_pending[staged_slot] = staged_dev
+        replay = ingest(replay, staged_dev)
+        inserted += steps_per_iteration
 
     def flush_staged():
         # Ingest any not-yet-dispatched transitions so a packed state's
         # replay ring agrees with its step counter.
-        nonlocal staged, replay, inserted
+        nonlocal staged
         if staged is not None:
-            replay = ingest(replay, jax.device_put(staged, accel))
-            inserted += steps_per_iteration
+            dispatch_staged()
             staged = None
     m_dev: Dict[str, jax.Array] = {}
     ep_returns: list = []
@@ -236,9 +253,7 @@ def run_host_async(
         # 1. Dispatch accelerator work for the PREVIOUS iteration's
         #    transitions (runs while this iteration steps envs).
         if staged is not None:
-            staged_dev = jax.device_put(staged, accel)
-            replay = ingest(replay, staged_dev)
-            inserted += steps_per_iteration
+            dispatch_staged()
         size = min(inserted, s.buf.capacity)
         if it >= s.warmup_iters and size >= cfg.batch_size:
             upd_keys = jax.device_put(
@@ -251,11 +266,18 @@ def run_host_async(
                 params, opt_state, replay, upd_keys
             )
 
-        # 2. Step envs on the host with the bounded-stale snapshot.
+        # 2. Step envs on the host with the bounded-stale snapshot,
+        #    writing transitions straight into this iteration's arena
+        #    slot (alternating slots; reuse gated on the slot's last
+        #    transfer having completed).
         env_t0 = time.perf_counter()
         step_scalar = jax.device_put(np.int32(it), cpu)
         k_steps = jax.random.fold_in(it_key, 2)  # cpu (it_key is cpu)
-        tr_obs, tr_act, tr_rew, tr_next, tr_term = [], [], [], [], []
+        slot = it_off % 2
+        if stage_pending[slot] is not None:
+            jax.block_until_ready(stage_pending[slot])
+            stage_pending[slot] = None
+        arena = stage_arenas[slot]
         for t in range(cfg.steps_per_iter):
             k_t = jax.random.fold_in(k_steps, t)
             obs_cpu = jax.device_put(obs, cpu)
@@ -263,11 +285,21 @@ def run_host_async(
             a_np = np.asarray(a)
             (next_obs, reward, done, term, trunc, final_obs,
              ep_ret, ep_len) = env._host_step(a_np)
-            tr_obs.append(obs)
-            tr_act.append(a_np)
-            tr_rew.append(reward)
-            tr_next.append(final_obs)
-            tr_term.append(term)
+            if arena is None:
+                mk = lambda x: np.empty(
+                    (cfg.steps_per_iter,) + np.shape(x),
+                    dtype=np.asarray(x).dtype,
+                )
+                arena = offpolicy.Transition(
+                    obs=mk(obs), action=mk(a_np), reward=mk(reward),
+                    next_obs=mk(final_obs), terminated=mk(term),
+                )
+                stage_arenas[slot] = arena
+            arena.obs[t] = obs
+            arena.action[t] = a_np
+            arena.reward[t] = reward
+            arena.next_obs[t] = final_obs
+            arena.terminated[t] = term
             if parts.noise_reset is not None and done.any():
                 noise = parts.noise_reset(
                     noise, jax.device_put(done, cpu)
@@ -275,13 +307,8 @@ def run_host_async(
             for i in np.nonzero(done > 0.5)[0]:
                 ep_returns.append(float(ep_ret[i]))
             obs = next_obs
-        staged = offpolicy.Transition(
-            obs=np.stack(tr_obs),
-            action=np.stack(tr_act),
-            reward=np.stack(tr_rew),
-            next_obs=np.stack(tr_next),
-            terminated=np.stack(tr_term),
-        )
+        staged = arena
+        staged_slot = slot
 
         # 3. Refresh the acting snapshot (the transfer is enqueued
         #    behind the update, so its completion implies the update

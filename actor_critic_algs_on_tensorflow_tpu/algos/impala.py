@@ -91,6 +91,20 @@ class ImpalaConfig:
     max_grad_norm: float = 40.0
     queue_size: int = 16
     publish_interval: int = 1       # learner steps between publications
+    # --- learner ingest pipeline ------------------------------------
+    # Overlap batch assembly + host->device transfer with the previous
+    # learner step's compute (data.pipeline.LearnerPipeline). False =
+    # the serial drain->assemble->dispatch loop (the numerics
+    # reference; bit-identical to the pipelined path by test).
+    pipeline: bool = True
+    pipeline_slots: int = 2         # host-arena double-buffer depth
+    # Donate learner state + batch buffers to the step so XLA reuses
+    # device memory in place instead of reallocating per iteration.
+    # Effective only where donation is supported AND dispatches are
+    # not serialized by the CPU-mesh exec lock; publication then
+    # snapshots params (device-side copy) so actor-visible weights
+    # never alias donated buffers.
+    donate_buffers: bool = True
     # Dead actors are restarted (stateless recovery) up to this many
     # times before the failure is surfaced (SURVEY.md §5).
     max_actor_restarts: int = 2
@@ -155,6 +169,70 @@ class LearnerState:
     params: Any
     opt_state: Any
     step: jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class ImpalaPrograms:
+    """Compiled IMPALA programs + the metadata the ingest pipeline
+    needs. Iterates as the legacy ``(init, learner_step,
+    make_actor_programs, mesh)`` 4-tuple, so existing call sites
+    unpack unchanged.
+
+    ``learner_step_donated`` is the same program compiled with
+    ``donate_argnums=(0, 1)`` (state AND batch buffers recycled in
+    place). Callers that use it must (a) never reuse a state or batch
+    value after passing it in, and (b) publish params as device-side
+    COPIES (``copy_params``) so actor snapshots never alias donated
+    buffers.
+    """
+
+    init: Any
+    learner_step: Any
+    make_actor_programs: Any
+    mesh: Any
+    learner_step_donated: Any
+    copy_params: Any            # jitted pytree copy (donation-safe publish)
+    batch_time_axis: Any        # TIME_AXIS or None (the t-axis spec name)
+
+    def __iter__(self):
+        return iter(
+            (self.init, self.learner_step, self.make_actor_programs, self.mesh)
+        )
+
+    def ingest_plan(self, traj_template) -> Tuple[Any, List[int], List[Any]]:
+        """(treedef, concat-axis per flat leaf, NamedSharding per flat
+        leaf) for assembling wire trajectories of ``traj_template``'s
+        structure into a sharded device batch via the host arena."""
+        axes_tree = trajectory_batch_axes(traj_template)
+        leaves, treedef = jax.tree_util.tree_flatten(traj_template)
+        axes_leaves = jax.tree_util.tree_leaves(axes_tree)
+        assert len(axes_leaves) == len(leaves)
+        spec_for_axis = {
+            1: P(self.batch_time_axis, DATA_AXIS),
+            0: P(DATA_AXIS),
+        }
+        shardings = [
+            NamedSharding(self.mesh, spec_for_axis[a]) for a in axes_leaves
+        ]
+        return treedef, axes_leaves, shardings
+
+
+def trajectory_batch_axes(traj: "ActorTrajectory") -> "ActorTrajectory":
+    """Per-leaf concatenation axis for stacking trajectories into a
+    learner batch: 1 for time-major ``[T, B_env, ...]`` fields, 0 for
+    per-env fields (``last_obs``, recurrent entry state) — the same
+    layout ``stack_trajectories`` produces."""
+    one = lambda t, a: jax.tree_util.tree_map(lambda _: a, t)
+    return ActorTrajectory(
+        obs=one(traj.obs, 1),
+        actions=one(traj.actions, 1),
+        rewards=one(traj.rewards, 1),
+        dones=one(traj.dones, 1),
+        behaviour_log_probs=one(traj.behaviour_log_probs, 1),
+        last_obs=one(traj.last_obs, 0),
+        entry_lstm=one(traj.entry_lstm, 0),
+        entry_prev_done=one(traj.entry_prev_done, 0),
+    )
 
 
 class ParamStore:
@@ -286,7 +364,8 @@ class ImpalaActor(threading.Thread):
 
 
 def make_impala(cfg: ImpalaConfig):
-    """Build (learner_init, learner_step, make_actor_programs, mesh).
+    """Build the compiled IMPALA programs (``ImpalaPrograms``; unpacks
+    as the legacy ``(init, learner_step, make_actor_programs, mesh)``).
 
     ``learner_step(state, batch) -> (state, metrics)`` is the jitted
     shard_map program; ``make_actor_programs(actor_id)`` returns that
@@ -580,19 +659,35 @@ def make_impala(cfg: ImpalaConfig):
         ),
         entry_prev_done=P(DATA_AXIS) if cfg.recurrent else None,
     )
-    # NO donation here: ParamStore and in-flight actor snapshots alias
-    # state.params, and donating would delete the buffers actors are
-    # reading (harmless on CPU, fatal on TPU).
-    learner_step = jax.jit(
-        shard_map(
-            local_learner_step,
-            mesh=mesh,
-            in_specs=(state_spec, batch_spec),
-            out_specs=(state_spec, P()),
-            check_vma=False,
-        ),
+    sharded_step = shard_map(
+        local_learner_step,
+        mesh=mesh,
+        in_specs=(state_spec, batch_spec),
+        out_specs=(state_spec, P()),
+        check_vma=False,
     )
-    return init, learner_step, make_actor_programs, mesh
+    # Two compilations of the same program, selected at run time:
+    #   - plain: safe when callers retain references to state or batch
+    #     (direct test/tool invocations; the CPU-mesh serialized mode).
+    #   - donated: state AND batch buffers are recycled in place by
+    #     XLA (no per-iteration reallocation). Safe ONLY under the run
+    #     loops' discipline: batches are pipeline-owned and never
+    #     reused, and publication snapshots params via ``copy_params``
+    #     so ParamStore / actor snapshots never alias donated buffers.
+    learner_step = jax.jit(sharded_step)
+    learner_step_donated = jax.jit(sharded_step, donate_argnums=(0, 1))
+    copy_params = jax.jit(
+        lambda p: jax.tree_util.tree_map(jnp.copy, p)
+    )
+    return ImpalaPrograms(
+        init=init,
+        learner_step=learner_step,
+        make_actor_programs=make_actor_programs,
+        mesh=mesh,
+        learner_step_donated=learner_step_donated,
+        copy_params=copy_params,
+        batch_time_axis=t_axis,
+    )
 
 
 def stack_trajectories(trajs: List[ActorTrajectory]) -> ActorTrajectory:
@@ -621,6 +716,22 @@ def stack_trajectories(trajs: List[ActorTrajectory]) -> ActorTrajectory:
     )
 
 
+def _episode_stats(eps) -> Dict[str, float]:
+    """Window episode stats in PURE NumPy: logging must never dispatch
+    device work (it would contend with ``learner_step`` under the
+    CPU-mesh exec lock, and force early syncs everywhere else)."""
+    done = np.concatenate(
+        [np.asarray(e["done_episode"]).reshape(-1) for e in eps]
+    )
+    rets = np.concatenate(
+        [np.asarray(e["episode_return"]).reshape(-1) for e in eps]
+    )
+    n_ep = float(done.sum())
+    if n_ep > 0:
+        return {"avg_return": float((rets * done).sum() / n_ep)}
+    return {}
+
+
 def _learner_loop(
     cfg: ImpalaConfig,
     state: LearnerState,
@@ -636,6 +747,7 @@ def _learner_loop(
     checkpointer=None,
     checkpoint_interval: int = 200,
     exec_lock: threading.Lock | None = None,
+    ingest_plan=None,
 ) -> Tuple[LearnerState, List[Tuple[int, Dict[str, float]]]]:
     """Shared learner loop of the in-process and cross-process modes.
 
@@ -644,7 +756,21 @@ def _learner_loop(
     faults); ``extra_metrics()`` contributes mode-specific scalars.
     ``exec_lock`` (CPU-mesh mode only) serializes the learner's
     dispatches against the actor threads' — see ImpalaActor.
+
+    With ``cfg.pipeline`` a ``LearnerPipeline`` prefetch thread drains
+    the queue and assembles/transfers the NEXT batch while the current
+    step computes; ``ingest_plan`` (cross-process mode) is the
+    ``(treedef, axes, shardings)`` triple that routes numpy wire
+    trajectories through the host arena + sharded ``device_put``.
+    ``cfg.pipeline=False`` is the serial reference path (bit-identical
+    output; proven by test). Either way the per-window time split is
+    surfaced as ``pipeline_*`` metrics next to the queue/transport
+    counters.
     """
+    from actor_critic_algs_on_tensorflow_tpu.data.pipeline import (
+        LearnerPipeline,
+        TimeSplit,
+    )
     from actor_critic_algs_on_tensorflow_tpu.utils.metrics import (
         device_get_metrics,
         format_metrics,
@@ -663,69 +789,126 @@ def _learner_loop(
         num_learner_steps = max(1, num_learner_steps)
     if num_learner_steps <= 0:
         return state, []
+
+    split = TimeSplit()
+    it_box = [iters_done0]  # prefetch-thread health checks read this
+    pipe = None
+    if cfg.pipeline:
+
+        def poll(n):
+            check_health(it_box[0])
+            try:
+                return q.get_many(n, timeout=0.25)
+            except queue_lib.Empty:
+                return ()
+
+        treedef, axes_leaves, shardings_leaves = (
+            ingest_plan if ingest_plan is not None else (None, None, None)
+        )
+        pipe = LearnerPipeline(
+            poll=poll,
+            batch_parts=cfg.batch_trajectories,
+            treedef=treedef,
+            axes_leaves=axes_leaves,
+            shardings_leaves=shardings_leaves,
+            assemble_device=stack_trajectories,
+            n_slots=max(2, cfg.pipeline_slots),
+            exec_lock=exec_lock,
+        )
+
+    def dispatch_step(state, make_batch):
+        # The one place the serialize rule lives: a CPU-mesh exec_lock
+        # (collective-bearing programs must retire before the next
+        # dispatch) wraps batch materialization + step + sync.
+        tc = time.perf_counter()
+        if exec_lock is None:
+            state, metrics = learner_step(state, make_batch())
+        else:
+            with exec_lock:
+                state, metrics = learner_step(state, make_batch())
+                jax.block_until_ready(metrics)
+        split.add("compute_s", time.perf_counter() - tc)
+        return state, metrics
+
     history: List[Tuple[int, Dict[str, float]]] = []
     t0 = time.perf_counter()
     last_log_i, last_log_t = 0, t0
-    for i in range(num_learner_steps):
-        it = iters_done0 + i
-        trajs, eps = [], []
-        while len(trajs) < cfg.batch_trajectories:
-            check_health(it)
-            try:
-                traj, ep = q.get(timeout=1.0)
-            except queue_lib.Empty:  # re-check actor health
-                continue
-            trajs.append(traj)
-            eps.append(ep)
-        if exec_lock is None:
-            batch = stack_trajectories(trajs)
-            state, metrics = learner_step(state, batch)
-        else:
-            with exec_lock:
-                batch = stack_trajectories(trajs)
-                state, metrics = learner_step(state, batch)
-                jax.block_until_ready(metrics)
-        env_steps = steps_done0 + (i + 1) * steps_per_batch
-        if (it + 1) % cfg.publish_interval == 0:
-            publish(state.params)
-        if (
-            checkpointer is not None
-            and checkpoint_interval
-            and (i + 1) % checkpoint_interval == 0
-        ):
-            checkpointer.save(env_steps, state)
-        if (i + 1) % log_interval == 0 or i == num_learner_steps - 1:
-            m = device_get_metrics(metrics)
-            done = jnp.concatenate(
-                [jnp.asarray(e["done_episode"]).reshape(-1) for e in eps]
-            )
-            rets = jnp.concatenate(
-                [jnp.asarray(e["episode_return"]).reshape(-1) for e in eps]
-            )
-            n_ep = float(jnp.sum(done))
-            if n_ep > 0:
-                m["avg_return"] = float(jnp.sum(rets * done) / n_ep)
-            now = time.perf_counter()
-            window = i + 1 - last_log_i
-            if window >= log_interval:
-                m["steps_per_sec"] = (
-                    window * steps_per_batch / max(now - last_log_t, 1e-9)
-                )
+    try:
+        for i in range(num_learner_steps):
+            it = iters_done0 + i
+            it_box[0] = it
+            if pipe is not None:
+                batch, eps, handle = pipe.get()
+                state, metrics = dispatch_step(state, lambda: batch)
+                pipe.mark_consumed(handle, metrics)
+                del batch  # donated or pipeline-owned; never reused here
             else:
-                # Short tail window: cumulative rate, not one-step noise.
-                m["steps_per_sec"] = (
-                    (i + 1) * steps_per_batch / max(now - t0, 1e-9)
+                trajs, eps = [], []
+                tq0 = time.perf_counter()
+                while len(trajs) < cfg.batch_trajectories:
+                    check_health(it)
+                    try:
+                        traj, ep = q.get(timeout=1.0)
+                    except queue_lib.Empty:  # re-check actor health
+                        continue
+                    trajs.append(traj)
+                    eps.append(ep)
+                split.add("queue_wait_s", time.perf_counter() - tq0)
+                state, metrics = dispatch_step(
+                    state, lambda: stack_trajectories(trajs)
                 )
-            last_log_i, last_log_t = i + 1, now
-            m.update(q.metrics())
-            m.update(extra_metrics())
-            history.append((env_steps, m))
-            if summary_writer is not None:
-                summary_writer.add_scalars(m, env_steps)
-            if log_fn is not None:
-                log_fn(env_steps, m)
-            else:
-                print(format_metrics(env_steps, m), flush=True)
+            env_steps = steps_done0 + (i + 1) * steps_per_batch
+            if (it + 1) % cfg.publish_interval == 0:
+                publish(state.params)
+            if (
+                checkpointer is not None
+                and checkpoint_interval
+                and (i + 1) % checkpoint_interval == 0
+            ):
+                checkpointer.save(env_steps, state)
+            if (i + 1) % log_interval == 0 or i == num_learner_steps - 1:
+                m = device_get_metrics(metrics)
+                m.update(_episode_stats(eps))
+                now = time.perf_counter()
+                window = i + 1 - last_log_i
+                if window >= log_interval:
+                    m["steps_per_sec"] = (
+                        window * steps_per_batch / max(now - last_log_t, 1e-9)
+                    )
+                else:
+                    # Short tail window: cumulative rate, not one-step noise.
+                    m["steps_per_sec"] = (
+                        (i + 1) * steps_per_batch / max(now - t0, 1e-9)
+                    )
+                last_log_i, last_log_t = i + 1, now
+                m.update(q.metrics())
+                m.update(split.window())
+                if pipe is not None:
+                    pm = pipe.metrics()
+                    # Overlap efficiency: the fraction of ingest work
+                    # (assemble + transfer) hidden under compute this
+                    # window. stall = learner blocked waiting for a
+                    # staged batch (ingest NOT hidden, or actors slow).
+                    ingest = pm.get("pipeline_assemble_s", 0.0) + pm.get(
+                        "pipeline_transfer_s", 0.0
+                    )
+                    stall = pm.get("pipeline_stall_s", 0.0)
+                    if ingest > 0:
+                        pm["pipeline_overlap_frac"] = round(
+                            max(0.0, 1.0 - stall / ingest), 4
+                        )
+                    m.update(pm)
+                m.update(extra_metrics())
+                history.append((env_steps, m))
+                if summary_writer is not None:
+                    summary_writer.add_scalars(m, env_steps)
+                if log_fn is not None:
+                    log_fn(env_steps, m)
+                else:
+                    print(format_metrics(env_steps, m), flush=True)
+    finally:
+        if pipe is not None:
+            pipe.close()
     return state, history
 
 
@@ -749,12 +932,16 @@ def run_impala(
     detection / elastic recovery"). ``inject_failure_at`` kills one
     actor at that learner step to exercise the path in tests.
     """
-    init, learner_step, make_actor_programs, mesh = make_impala(cfg)
+    from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
+        donation_supported,
+    )
+
+    programs = make_impala(cfg)
+    init, learner_step, make_actor_programs, mesh = programs
     state = (
         initial_state if initial_state is not None
         else init(jax.random.PRNGKey(cfg.seed))
     )
-    store = ParamStore(state.params)
     q = TrajectoryQueue(cfg.queue_size)
     stop = threading.Event()
     restarts = 0
@@ -764,6 +951,20 @@ def run_impala(
     # collectives, so all executions share one lock there (real TPU
     # meshes run lock-free).
     exec_lock = _cpu_mesh_exec_lock(mesh)
+    # Donation recycles the learner's device buffers in place. It
+    # requires publication to snapshot params (device-side copy) so
+    # actor snapshots never alias a donated buffer; the serialized
+    # CPU-mesh mode keeps the plain step (donation buys nothing there).
+    donate = (
+        cfg.donate_buffers and donation_supported() and exec_lock is None
+    )
+    if donate:
+        learner_step = programs.learner_step_donated
+        store = ParamStore(programs.copy_params(state.params))
+        publish = lambda p: store.publish(programs.copy_params(p))
+    else:
+        store = ParamStore(state.params)
+        publish = store.publish
 
     def spawn(i: int, generation: int) -> ImpalaActor:
         a = ImpalaActor(
@@ -801,7 +1002,7 @@ def run_impala(
     try:
         state, history = _learner_loop(
             cfg, state, learner_step, q,
-            publish=store.publish,
+            publish=publish,
             check_health=check_health,
             extra_metrics=lambda: {
                 "param_version": store.version,
@@ -936,11 +1137,18 @@ def run_impala_distributed(
     """
     import multiprocessing as mp
 
+    from actor_critic_algs_on_tensorflow_tpu.data.pipeline import (
+        AsyncParamPublisher,
+    )
     from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
         LearnerServer,
     )
+    from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
+        donation_supported,
+    )
 
-    init, learner_step, make_actor_programs, mesh = make_impala(cfg)
+    programs = make_impala(cfg)
+    init, learner_step, make_actor_programs, mesh = programs
     state = (
         initial_state if initial_state is not None
         else init(jax.random.PRNGKey(cfg.seed))
@@ -957,6 +1165,10 @@ def run_impala_distributed(
     )
     traj_def = jax.tree_util.tree_structure(traj_shape)
     ep_def = jax.tree_util.tree_structure(ep_shape)
+    # Host-arena ingest: wire trajectories (numpy leaves) are scattered
+    # into preallocated per-leaf buffers and device_put with the
+    # learner's shardings by the prefetch thread.
+    ingest_plan = programs.ingest_plan(traj_shape)
 
     q = TrajectoryQueue(cfg.queue_size)
     closing = threading.Event()
@@ -1020,8 +1232,30 @@ def run_impala_distributed(
             )
             procs[idx] = spawn(idx, restarts)
 
+    # No actor threads here, but a multi-device CPU learner must still
+    # retire each collective-bearing step before the next dispatch
+    # (run_loop's serialize rule).
+    exec_lock = _cpu_mesh_exec_lock(mesh)
+    donate = (
+        cfg.donate_buffers and donation_supported() and exec_lock is None
+    )
+    if donate:
+        learner_step = programs.learner_step_donated
+
+    # Weight broadcast off the critical path: the learner hands the
+    # publisher thread a params reference (a device-side COPY when the
+    # step donates its state buffers) and keeps training; the thread
+    # does the blocking device->host fetch + version bump.
+    publisher = AsyncParamPublisher(
+        lambda p: server.publish(
+            jax.tree_util.tree_leaves(jax.device_get(p))
+        )
+    )
+
     def publish(params):
-        server.publish(jax.tree_util.tree_leaves(jax.device_get(params)))
+        publisher.submit(
+            programs.copy_params(params) if donate else params
+        )
 
     try:
         state, history = _learner_loop(
@@ -1035,19 +1269,22 @@ def run_impala_distributed(
                 "param_version": server.version,
                 "actor_restarts": restarts,
                 **server.metrics(),
+                **publisher.metrics(),
             },
             log_interval=log_interval,
             log_fn=log_fn,
             summary_writer=summary_writer,
             checkpointer=checkpointer,
             checkpoint_interval=checkpoint_interval,
-            # No actor threads here, but a multi-device CPU learner
-            # must still retire each collective-bearing step before
-            # the next dispatch (run_loop's serialize rule).
-            exec_lock=_cpu_mesh_exec_lock(mesh),
+            exec_lock=exec_lock,
+            ingest_plan=ingest_plan,
         )
     finally:
         closing.set()
+        try:
+            publisher.close()
+        except Exception:
+            pass
         server.close()
         q.close()
         for p in procs:

@@ -1,1 +1,9 @@
-"""data subpackage."""
+"""data subpackage: rollout/replay storage and the learner ingest
+pipeline (host arena + prefetch + async publish)."""
+
+from actor_critic_algs_on_tensorflow_tpu.data.pipeline import (  # noqa: F401
+    AsyncParamPublisher,
+    HostArena,
+    LearnerPipeline,
+    TimeSplit,
+)

@@ -1,0 +1,410 @@
+"""Device-feed pipeline: overlap batch ingest with learner compute.
+
+The IMPALA learner is the single consumer for every actor, and its
+serial loop (drain queue -> host-assemble batch -> dispatch
+``learner_step``) leaves the accelerator idle for the whole host-side
+assemble + host->device transfer of every batch. This module hides
+that work under the previous step's compute:
+
+  - ``HostArena`` — a preallocated, reusable host buffer set: ONE
+    contiguous numpy buffer per batch leaf per slot, filled with
+    indexed writes (no N-way ``concatenate``, no per-batch
+    allocation). Two slots double-buffer: the next batch is assembled
+    while the previous one is still in flight.
+  - ``LearnerPipeline`` — a background prefetch thread that drains the
+    trajectory source, assembles the NEXT batch into an arena slot,
+    issues ``jax.device_put`` with the learner's ``NamedSharding`` so
+    the transfer rides under the current ``learner_step``, and hands
+    the device-resident batch to the learner through a depth-1 queue.
+    Slot reuse is token-gated: a slot is rewritten only after BOTH its
+    transfer completed AND the learner step that consumed the batch
+    retired (``mark_consumed``) — an arena slot can never alias a
+    batch still in flight, even when the device batch is donated.
+  - ``AsyncParamPublisher`` — parameter broadcast off the critical
+    path: the learner submits a weights reference (newest wins) and a
+    side thread performs the blocking device->host fetch + publish.
+
+Run-ahead is bounded (1 ready batch + 1 being assembled), so the
+pipeline adds at most 2 batches of off-policy lag on top of the
+trajectory queue — still inside what V-trace's rho/c clipping
+corrects.
+
+Trajectory leaves arriving as numpy (the cross-process/DCN mode) take
+the arena path; leaves already device-resident (in-process actor
+threads) are stacked on device instead — re-staging them through the
+host would add two copies, not remove one.
+"""
+
+from __future__ import annotations
+
+import queue as queue_lib
+import threading
+import time
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from actor_critic_algs_on_tensorflow_tpu.utils.metrics import TimeSplit
+
+__all__ = [
+    "AsyncParamPublisher",
+    "HostArena",
+    "LearnerPipeline",
+    "TimeSplit",
+]
+
+
+class HostArena:
+    """Preallocated host-side batch buffers: ``n_slots`` independent
+    copies of the stacked-batch leaf set, each leaf ONE contiguous
+    numpy buffer written with indexed slice assignment.
+
+    ``axes[i]`` is the concatenation axis of flat leaf ``i`` (1 for
+    time-major ``[T, B]`` trajectory fields, 0 for per-env fields like
+    ``last_obs``); ``n_parts`` trajectories of identical shape fill a
+    slot. Shapes/dtypes come from the first trajectory seen.
+    """
+
+    def __init__(self, axes: Sequence[int], n_parts: int, n_slots: int = 2):
+        if n_slots < 2:
+            raise ValueError(f"need >= 2 slots to double-buffer, got {n_slots}")
+        self.axes = list(axes)
+        self.n_parts = n_parts
+        self.n_slots = n_slots
+        self._slots: List[Optional[List[np.ndarray]]] = [None] * n_slots
+        self._part_shapes: Optional[List[tuple]] = None
+
+    def _ensure(self, slot: int, leaves: Sequence[np.ndarray]) -> List[np.ndarray]:
+        if len(leaves) != len(self.axes):
+            raise ValueError(
+                f"trajectory has {len(leaves)} leaves, arena expects "
+                f"{len(self.axes)}"
+            )
+        if self._part_shapes is None:
+            self._part_shapes = [tuple(np.shape(x)) for x in leaves]
+        bufs = self._slots[slot]
+        if bufs is None:
+            bufs = []
+            for x, ax in zip(leaves, self.axes):
+                shape = list(np.shape(x))
+                shape[ax] *= self.n_parts
+                bufs.append(np.empty(shape, dtype=np.asarray(x).dtype))
+            self._slots[slot] = bufs
+        return bufs
+
+    def write_part(
+        self, slot: int, part: int, leaves: Sequence[np.ndarray]
+    ) -> None:
+        """Scatter one trajectory's leaves into slot ``slot`` at part
+        index ``part`` — a strided write per leaf, no concatenation."""
+        bufs = self._ensure(slot, leaves)
+        for buf, x, ax, pshape in zip(
+            bufs, leaves, self.axes, self._part_shapes
+        ):
+            x = np.asarray(x)
+            if x.shape != pshape:
+                raise ValueError(
+                    f"trajectory leaf shape {x.shape} != arena part "
+                    f"shape {pshape} (all actors must share one config)"
+                )
+            w = x.shape[ax]
+            sl = [slice(None)] * x.ndim
+            sl[ax] = slice(part * w, (part + 1) * w)
+            buf[tuple(sl)] = x
+
+    def slot_leaves(self, slot: int) -> List[np.ndarray]:
+        bufs = self._slots[slot]
+        assert bufs is not None, "slot never written"
+        return bufs
+
+
+class LearnerPipeline:
+    """Background prefetch: assemble the next batch while the current
+    ``learner_step`` executes.
+
+    ``poll(n)`` (caller-supplied) returns up to ``n`` ``(traj, ep)``
+    items, or an empty list on timeout — it is where the caller runs
+    health checks; exceptions it raises abort the pipeline and
+    re-raise from ``get()``. ``assemble_device(parts)`` stacks
+    device-resident trajectories (the in-process path);
+    ``shardings``/``axes`` drive the arena + sharded ``device_put``
+    path for numpy trajectories (the wire path).
+
+    Contract with the consumer::
+
+        batch, eps, handle = pipeline.get()
+        state, metrics = learner_step(state, batch)   # may donate batch
+        pipeline.mark_consumed(handle, metrics)
+
+    ``mark_consumed``'s token gates arena-slot reuse: the prefetch
+    thread blocks on the token's readiness before rewriting the slot,
+    so donation can recycle the device buffers without the host arena
+    ever aliasing a batch still in flight. The token must be an output
+    of the consuming step (its readiness implies the step retired) —
+    the metrics pytree is ideal; it is never donated.
+    """
+
+    def __init__(
+        self,
+        *,
+        poll: Callable[[int], Sequence[Tuple[Any, Any]]],
+        batch_parts: int,
+        treedef: Any = None,
+        axes_leaves: Optional[Sequence[int]] = None,
+        shardings_leaves: Optional[Sequence[Any]] = None,
+        assemble_device: Optional[Callable[[List[Any]], Any]] = None,
+        n_slots: int = 2,
+        exec_lock: Optional[threading.Lock] = None,
+        name: str = "learner-pipeline",
+    ):
+        self._poll = poll
+        self._batch_parts = batch_parts
+        self._treedef = treedef
+        self._axes = axes_leaves
+        self._shardings = shardings_leaves
+        self._assemble_device = assemble_device
+        self._exec_lock = exec_lock
+        self._arena = (
+            HostArena(axes_leaves, batch_parts, n_slots)
+            if axes_leaves is not None
+            else None
+        )
+        self._n_slots = n_slots
+        # Slot-reuse tokens: slot k is rewritable once the token from
+        # the step that consumed its previous batch is device-ready.
+        self._tokens: List["queue_lib.Queue[Any]"] = [
+            queue_lib.Queue(1) for _ in range(n_slots)
+        ]
+        for tq in self._tokens:
+            tq.put(None)  # first use of each slot never blocks
+        self._ready: "queue_lib.Queue[tuple]" = queue_lib.Queue(1)
+        self._closed = threading.Event()
+        self._error: Optional[BaseException] = None
+        self.split = TimeSplit()
+        self.batches = 0
+        self._thread = threading.Thread(
+            target=self._run, name=name, daemon=True
+        )
+        self._thread.start()
+
+    # -- prefetch thread ------------------------------------------------
+
+    def _run(self) -> None:
+        slot = 0
+        try:
+            while not self._closed.is_set():
+                parts: List[Any] = []
+                eps: List[Any] = []
+                t0 = time.perf_counter()
+                while len(parts) < self._batch_parts:
+                    if self._closed.is_set():
+                        return
+                    for traj, ep in self._poll(self._batch_parts - len(parts)):
+                        parts.append(traj)
+                        eps.append(ep)
+                self.split.add("queue_wait_s", time.perf_counter() - t0)
+
+                # Episode stats to numpy HERE (prefetch thread), so the
+                # learner loop's logging never touches device arrays.
+                eps_np = [
+                    {k: np.asarray(v) for k, v in ep.items()} for ep in eps
+                ]
+
+                first_leaves = jax.tree_util.tree_leaves(parts[0])
+                use_arena = self._arena is not None and all(
+                    isinstance(x, np.ndarray) for x in first_leaves
+                )
+                if use_arena:
+                    batch, handle = self._assemble_arena(parts, slot)
+                    slot = (slot + 1) % self._n_slots
+                else:
+                    t0 = time.perf_counter()
+                    if self._exec_lock is not None:
+                        with self._exec_lock:
+                            batch = self._assemble_device(parts)
+                            jax.block_until_ready(batch)
+                    else:
+                        batch = self._assemble_device(parts)
+                    self.split.add("assemble_s", time.perf_counter() - t0)
+                    handle = None
+
+                item = (batch, eps_np, handle)
+                del batch, parts, eps, eps_np  # ready queue owns them now
+                while not self._closed.is_set():
+                    try:
+                        self._ready.put(item, timeout=0.2)
+                        self.batches += 1
+                        break
+                    except queue_lib.Full:
+                        continue
+        except _PipelineClosed:
+            pass  # ordered shutdown observed mid-assembly; not an error
+        except BaseException as e:
+            self._error = e
+            self._closed.set()
+
+    def _assemble_arena(self, parts: List[Any], slot: int):
+        # Wait until this slot's previous batch fully retired: its
+        # consumer step's token is device-ready (covers the transfer
+        # too — the step read the transferred buffers).
+        t0 = time.perf_counter()
+        token = None
+        while not self._closed.is_set():
+            try:
+                token = self._tokens[slot].get(timeout=0.2)
+                break
+            except queue_lib.Empty:
+                continue
+        if self._closed.is_set():
+            raise _PipelineClosed()
+        if token is not None:
+            jax.block_until_ready(token)
+        self.split.add("slot_wait_s", time.perf_counter() - t0)
+
+        t0 = time.perf_counter()
+        for j, traj in enumerate(parts):
+            self._arena.write_part(
+                slot, j, jax.tree_util.tree_leaves(traj)
+            )
+        self.split.add("assemble_s", time.perf_counter() - t0)
+
+        t0 = time.perf_counter()
+        dev_leaves = [
+            jax.device_put(buf, s)
+            for buf, s in zip(self._arena.slot_leaves(slot), self._shardings)
+        ]
+        # Block THIS thread (not the learner) until the host->device
+        # copies land — the transfer rides under the learner's compute,
+        # and once ready the slot's host memory is provably unread.
+        jax.block_until_ready(dev_leaves)
+        self.split.add("transfer_s", time.perf_counter() - t0)
+        batch = jax.tree_util.tree_unflatten(self._treedef, dev_leaves)
+        return batch, slot
+
+    # -- consumer side --------------------------------------------------
+
+    def get(self, timeout: float = 0.5):
+        """Next ``(batch, eps, handle)``; blocks until one is staged.
+        Raises whatever the prefetch thread raised (health-check
+        failures included)."""
+        t0 = time.perf_counter()
+        while True:
+            if self._error is not None:
+                raise self._error
+            try:
+                item = self._ready.get(timeout=timeout)
+                self.split.add("stall_s", time.perf_counter() - t0)
+                return item
+            except queue_lib.Empty:
+                if self._closed.is_set() and self._error is None:
+                    raise RuntimeError("pipeline closed while waiting")
+
+    def mark_consumed(self, handle, token) -> None:
+        """Release the arena slot behind ``handle`` once ``token`` (an
+        output of the consuming step) becomes device-ready. No-op for
+        device-stacked batches (``handle is None``)."""
+        if handle is None:
+            return
+        self._tokens[handle].put(token)
+
+    def metrics(self) -> dict:
+        m = self.split.window()
+        m["pipeline_batches"] = self.batches
+        m["pipeline_depth"] = self._ready.qsize()
+        return m
+
+    def close(self) -> None:
+        """Ordered shutdown: stop the prefetch thread, then drop any
+        staged batch so device buffers free promptly."""
+        self._closed.set()
+        self._thread.join(timeout=10.0)
+        while True:
+            try:
+                self._ready.get_nowait()
+            except queue_lib.Empty:
+                break
+        # Unblock nothing-in-particular: tokens queue is bounded per
+        # slot and the thread is gone; clear for idempotent close().
+        for tq in self._tokens:
+            try:
+                tq.get_nowait()
+            except queue_lib.Empty:
+                pass
+
+    @property
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
+
+class _PipelineClosed(Exception):
+    """Internal: prefetch observed close() mid-assembly."""
+
+
+class AsyncParamPublisher:
+    """Parameter broadcast off the learner's critical path.
+
+    ``submit(params)`` stores the newest weights reference and returns
+    immediately; a side thread performs ``publish_fn(params)`` (the
+    blocking device->host fetch + broadcast). Intermediate versions
+    are dropped (newest wins) — actors only ever want the latest.
+
+    With buffer donation active the caller must submit a COPY of the
+    params (the learner's own buffers are recycled next step); without
+    donation the live reference is safe — params are immutable.
+    """
+
+    def __init__(self, publish_fn: Callable[[Any], None]):
+        self._publish = publish_fn
+        self._cond = threading.Condition()
+        self._pending: Any = None
+        self._has_pending = False
+        self._closed = False
+        self._error: Optional[BaseException] = None
+        self.published = 0
+        self.publish_s = 0.0
+        self._thread = threading.Thread(
+            target=self._run, name="param-publisher", daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, params: Any) -> None:
+        if self._error is not None:
+            raise self._error
+        with self._cond:
+            self._pending = params
+            self._has_pending = True
+            self._cond.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._has_pending and not self._closed:
+                    self._cond.wait(timeout=0.5)
+                if self._closed and not self._has_pending:
+                    return
+                params, self._pending = self._pending, None
+                self._has_pending = False
+            try:
+                t0 = time.perf_counter()
+                self._publish(params)
+                self.publish_s += time.perf_counter() - t0
+                self.published += 1
+            except BaseException as e:
+                self._error = e
+                return
+
+    def metrics(self) -> dict:
+        return {
+            "publish_async": self.published,
+            "publish_s": round(self.publish_s, 4),
+        }
+
+    def close(self) -> None:
+        """Flush the pending publication (if any), then stop."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify()
+        self._thread.join(timeout=10.0)
+        if self._error is not None:
+            raise self._error

@@ -67,6 +67,25 @@ class TrajectoryQueue:
             self.stats.last_get_ts = time.monotonic()
         return item
 
+    def get_many(self, n: int, timeout: float | None = None) -> list:
+        """Batch drain: block for the FIRST item (up to ``timeout``,
+        raising ``queue.Empty`` like ``get``), then take whatever else
+        is immediately available, up to ``n`` total. One stats/lock
+        round-trip for the whole batch — the consumer-side analog of
+        the learner draining ``batch_trajectories`` items per step."""
+        t0 = time.monotonic()
+        items = [self._q.get(timeout=timeout)]
+        while len(items) < n:
+            try:
+                items.append(self._q.get_nowait())
+            except queue.Empty:
+                break
+        with self._lock:
+            self.stats.gets += len(items)
+            self.stats.get_blocked_s += time.monotonic() - t0
+            self.stats.last_get_ts = time.monotonic()
+        return items
+
     def depth(self) -> int:
         return self._q.qsize()
 

@@ -179,7 +179,11 @@ class ResilientActorClient:
         if client is not None:
             client.abort()  # no goodbye frame on a broken connection
 
-    def _op(self, fn: Callable[[ActorClient], object]) -> object:
+    def _op(
+        self,
+        fn: Callable[[ActorClient], object],
+        on_fault: Callable[[], None] | None = None,
+    ) -> object:
         def attempt():
             client = self._ensure_connected()
             try:
@@ -188,6 +192,8 @@ class ResilientActorClient:
                 raise  # orderly shutdown: terminal, not a fault
             except (ConnectionError, OSError):
                 self._drop()
+                if on_fault is not None:
+                    on_fault()
                 raise
 
         def note_retry(attempt_no, delay, err):
@@ -204,9 +210,30 @@ class ResilientActorClient:
         traj_leaves: Sequence[np.ndarray],
         ep_leaves: Sequence[np.ndarray] = (),
     ) -> int:
+        """Push with at-least-once delivery.
+
+        Zero-copy discipline: the happy path sends straight from the
+        caller's buffers (vectored writes, no serialization copy) — the
+        caller must not mutate them until this returns, which the
+        synchronous call structure already guarantees. On the FIRST
+        transport fault the leaves are snapshotted once, so every
+        re-push after a reconnect sends the same bytes even if the
+        caller's buffers are arena slots that get reused the moment a
+        (spurious) earlier delivery unblocks the flow — pay the copy
+        only when a fault already made the operation slow.
+        """
+        leaves = {"traj": traj_leaves, "ep": ep_leaves, "pinned": False}
+
+        def pin_if_needed():
+            if not leaves["pinned"]:
+                leaves["traj"] = [np.array(x) for x in leaves["traj"]]
+                leaves["ep"] = [np.array(x) for x in leaves["ep"]]
+                leaves["pinned"] = True
+
         with self._lock:
             return self._op(
-                lambda c: c.push_trajectory(traj_leaves, ep_leaves)
+                lambda c: c.push_trajectory(leaves["traj"], leaves["ep"]),
+                on_fault=pin_if_needed,
             )
 
     def fetch_params(self) -> Tuple[int, List[np.ndarray]]:

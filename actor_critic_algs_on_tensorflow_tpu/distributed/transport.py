@@ -84,33 +84,81 @@ class LearnerShutdown(ConnectionError):
     done — exit quietly" from a transport fault worth retrying."""
 
 
-def pack_arrays(kind: int, tag: int, arrays: Sequence[np.ndarray]) -> bytes:
-    parts = [_HEADER.pack(MAGIC, kind, tag, len(arrays))]
+def frame_views(kind: int, tag: int, arrays: Sequence[np.ndarray]) -> list:
+    """Frame as a scatter-gather list: small header ``bytes`` objects
+    interleaved with zero-copy ``memoryview``s of the array payloads.
+    Nothing is serialized with ``tobytes()`` and nothing is joined —
+    the kernel gathers the pieces straight off the caller's buffers
+    (vectored writes). The caller must not mutate the arrays until the
+    send completes."""
+    parts: list = [_HEADER.pack(MAGIC, kind, tag, len(arrays))]
     for a in arrays:
         a = np.asarray(a)
         shape = a.shape  # before ascontiguousarray, which promotes 0-d to 1-d
         a = np.ascontiguousarray(a)
         dtype = a.dtype.str.encode()
-        parts.append(_ARRAY_HEADER.pack(len(dtype)))
-        parts.append(dtype)
-        parts.append(struct_lib.pack(">B", len(shape)))
-        parts.append(struct_lib.pack(f">{len(shape)}Q", *shape))
-        payload = a.tobytes()
-        parts.append(struct_lib.pack(">Q", len(payload)))
-        parts.append(payload)
-    return b"".join(parts)
+        header = (
+            _ARRAY_HEADER.pack(len(dtype))
+            + dtype
+            + struct_lib.pack(">B", len(shape))
+            + struct_lib.pack(f">{len(shape)}Q", *shape)
+            + struct_lib.pack(">Q", a.nbytes)
+        )
+        parts.append(header)
+        if a.nbytes:  # 0-size views cannot cast; they carry no payload
+            parts.append(memoryview(a).cast("B"))
+    return parts
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray(n)
-    view = memoryview(buf)
-    got = 0
+def pack_arrays(kind: int, tag: int, arrays: Sequence[np.ndarray]) -> bytes:
+    """One contiguous frame (copies). Kept for tests/tools; the hot
+    send path is ``send_msg`` -> ``_sendmsg_all`` over ``frame_views``."""
+    return b"".join(frame_views(kind, tag, arrays))
+
+
+# sendmsg is bounded by IOV_MAX (1024 on Linux) buffers per call; stay
+# comfortably below it. Each chunk is one vectored write syscall.
+_SENDMSG_MAX_BUFFERS = 512
+
+
+def _sendmsg_all(sock: socket.socket, parts: Sequence) -> None:
+    """``sendall`` semantics over a scatter-gather buffer list.
+
+    Uses vectored ``sendmsg`` so array payloads go from the caller's
+    memory to the kernel with no intermediate ``b"".join`` copy;
+    resumes correctly after partial sends. Falls back to ``sendall``
+    where ``sendmsg`` is unavailable."""
+    if not hasattr(sock, "sendmsg"):
+        sock.sendall(b"".join(parts))
+        return
+    bufs = [memoryview(p) for p in parts if len(p)]
+    idx = 0
+    while idx < len(bufs):
+        sent = sock.sendmsg(bufs[idx : idx + _SENDMSG_MAX_BUFFERS])
+        while sent:
+            b = bufs[idx]
+            if sent >= len(b):
+                sent -= len(b)
+                idx += 1
+            else:
+                bufs[idx] = b[sent:]
+                sent = 0
+
+
+def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+    """Fill ``view`` completely from the socket (no intermediate copy)."""
+    got, n = 0, len(view)
     while got < n:
         r = sock.recv_into(view[got:], n - got)
         if r == 0:
             raise ConnectionError("peer closed mid-frame")
         got += r
-    return bytes(buf)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+    buf = bytearray(n)
+    _recv_exact_into(sock, memoryview(buf))
+    return buf
 
 
 def send_msg(
@@ -119,17 +167,26 @@ def send_msg(
     tag: int = 0,
     arrays: Sequence[np.ndarray] = (),
 ) -> None:
-    sock.sendall(pack_arrays(kind, tag, arrays))
+    _sendmsg_all(sock, frame_views(kind, tag, arrays))
 
 
 def recv_msg(
     sock: socket.socket,
     *,
     max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+    alloc: Callable[[int], np.ndarray] | None = None,
 ) -> Tuple[int, int, List[np.ndarray]]:
     """Read one frame, validating every header field against sane
     limits BEFORE allocating, so garbage on the wire surfaces as a
-    clean ``ConnectionError`` rather than a multi-GB allocation."""
+    clean ``ConnectionError`` rather than a multi-GB allocation.
+
+    Zero-copy ingest: each payload is ``recv_into``'d directly into the
+    destination array's memory — no intermediate ``bytes`` object and
+    no ``frombuffer`` re-wrap copy. ``alloc(nbytes)`` (optional)
+    supplies the backing byte buffer (a writable C-contiguous uint8
+    ndarray, e.g. an arena slice) instead of a fresh allocation; it is
+    only ever called with header-validated sizes within the frame
+    budget."""
     magic, kind, tag, n = _HEADER.unpack(_recv_exact(sock, _HEADER.size))
     if magic != MAGIC:
         raise ConnectionError(f"bad frame magic {magic!r}")
@@ -172,9 +229,14 @@ def recv_msg(
                 f"{nbytes}"
             )
         budget -= nbytes
-        payload = _recv_exact(sock, nbytes)
+        buf = (
+            alloc(nbytes) if alloc is not None
+            else np.empty(nbytes, dtype=np.uint8)
+        )
+        if nbytes:
+            _recv_exact_into(sock, memoryview(buf).cast("B")[:nbytes])
         try:
-            arrays.append(np.frombuffer(payload, dtype=dtype).reshape(shape))
+            arrays.append(buf[:nbytes].view(dtype).reshape(shape))
         except (ValueError, TypeError) as e:
             raise ConnectionError(f"undecodable frame array: {e}") from e
     return kind, tag, arrays

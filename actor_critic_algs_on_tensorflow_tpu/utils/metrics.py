@@ -8,6 +8,7 @@ array; host-side consumption converts to floats in one transfer.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Dict, Mapping
 
@@ -15,6 +16,46 @@ import jax
 import numpy as np
 
 Metrics = Dict[str, jax.Array]
+
+
+class TimeSplit:
+    """Thread-safe named wall-clock accounting with window deltas.
+
+    The learner's ingest pipeline attributes each second of an
+    iteration to a named bucket (queue-wait / assemble / transfer /
+    compute); ``add(name, s)`` accumulates, ``window()`` returns the
+    per-name seconds since the previous ``window()`` call (one window
+    per log interval), ``cumulative()`` returns lifetime totals. Keys
+    are emitted with ``prefix`` so they sort next to each other in the
+    log stream and TensorBoard.
+    """
+
+    def __init__(self, prefix: str = "pipeline_"):
+        self._prefix = prefix
+        self._lock = threading.Lock()
+        self._acc: Dict[str, float] = {}
+        self._last: Dict[str, float] = {}
+
+    def add(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._acc[name] = self._acc.get(name, 0.0) + seconds
+
+    def cumulative(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                f"{self._prefix}{k}": round(v, 4)
+                for k, v in self._acc.items()
+            }
+
+    def window(self) -> Dict[str, float]:
+        with self._lock:
+            out = {}
+            for k, v in self._acc.items():
+                out[f"{self._prefix}{k}"] = round(
+                    v - self._last.get(k, 0.0), 4
+                )
+                self._last[k] = v
+            return out
 
 
 def device_get_metrics(metrics: Mapping[str, jax.Array]) -> Dict[str, float]:

@@ -20,6 +20,14 @@ measured in a fresh SUBPROCESS, largest-first, and the first one that
 completes is reported (a smaller env count still measures the same
 fused-iteration program). Exactly ONE JSON line is printed on stdout:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Optional IMPALA ingest leg (``BENCH_IMPALA=1``): a second subprocess
+measures the async actor->learner loop with the prefetch pipeline on
+vs the serial fallback, and reports the assemble+transfer share of
+learner iteration time alongside steps/sec (the overlap the pipeline
+exists to hide). Merged into the same JSON line under
+``"impala_pipeline"``; off by default so the driver contract is
+unchanged.
 """
 
 from __future__ import annotations
@@ -101,9 +109,80 @@ def measure(num_envs: int, rollout: int, timed_iters: int) -> tuple:
     return best, med, (best - min(rates)) / med
 
 
+def measure_impala() -> dict:
+    """Pipelined vs serial IMPALA learner on this backend: steps/sec
+    plus the assemble+transfer share of iteration time (how much
+    ingest work there is to hide, and how much of it the pipeline
+    hides — ``overlap_frac``)."""
+    import statistics
+
+    from actor_critic_algs_on_tensorflow_tpu.algos.impala import (
+        ImpalaConfig,
+        run_impala,
+    )
+
+    iters = int(os.environ.get("BENCH_IMPALA_ITERS", 60))
+    base = dict(
+        env="CartPole-v1",
+        num_actors=int(os.environ.get("BENCH_IMPALA_ACTORS", 4)),
+        envs_per_actor=64,
+        rollout_length=32,
+        batch_trajectories=4,
+        queue_size=8,
+        lr_decay=False,
+    )
+    steps_per_batch = (
+        base["batch_trajectories"] * base["envs_per_actor"]
+        * base["rollout_length"]
+    )
+    out = {}
+    for mode, pipelined in (("pipelined", True), ("serial", False)):
+        cfg = ImpalaConfig(
+            **base,
+            pipeline=pipelined,
+            total_env_steps=iters * steps_per_batch,
+        )
+        hist_rates, ingest_s, stall_s, t0 = [], 0.0, 0.0, time.perf_counter()
+        _, history = run_impala(
+            cfg, log_interval=10, log_fn=lambda s, m: None
+        )
+        wall = time.perf_counter() - t0
+        # Window 0 pays compilation; keep it only when it is the sole
+        # window (tiny BENCH_IMPALA_ITERS) so the median is never empty.
+        windows = history[1:] if len(history) > 1 else history
+        for _, m in windows:
+            hist_rates.append(m["steps_per_sec"])
+            ingest_s += m.get("pipeline_assemble_s", 0.0) + m.get(
+                "pipeline_transfer_s", 0.0
+            ) + m.get("pipeline_queue_wait_s", 0.0)
+            stall_s += m.get("pipeline_stall_s", 0.0)
+        out[mode] = {
+            "steps_per_sec": round(statistics.median(hist_rates), 1),
+            # Share of wall time spent assembling/transferring/waiting
+            # for batches (serial: all on the critical path; pipelined:
+            # only the stall remainder is).
+            "ingest_share": round(ingest_s / max(wall, 1e-9), 4),
+        }
+        if pipelined:
+            out[mode]["stall_share"] = round(stall_s / max(wall, 1e-9), 4)
+    p, s = out["pipelined"], out["serial"]
+    out["speedup"] = round(
+        p["steps_per_sec"] / max(s["steps_per_sec"], 1e-9), 4
+    )
+    return out
+
+
 def main() -> int:
     rollout = int(os.environ.get("BENCH_ROLLOUT", 128))
     timed_iters = int(os.environ.get("BENCH_ITERS", 10))
+
+    if len(sys.argv) > 1 and sys.argv[1] == "--measure-impala":
+        try:
+            print(json.dumps(measure_impala()))
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+            return 1
+        return 0
 
     if len(sys.argv) > 1 and sys.argv[1] == "--measure":
         # Child mode: measure one config, print "best median spread".
@@ -185,20 +264,34 @@ def main() -> int:
         )
         return 1
     best, med, spread = result
-    print(
-        json.dumps(
-            {
-                "metric": "ppo_atari_env_steps_per_sec_per_chip",
-                # value = best-of-N windows (the machine's capability);
-                # median/spread expose tunnel noise vs real regression.
-                "value": round(best, 1),
-                "median": round(med, 1),
-                "spread": round(spread, 4),
-                "unit": "env-steps/sec/chip",
-                "vs_baseline": round(best / PER_CHIP_TARGET, 3),
-            }
-        )
-    )
+    payload = {
+        "metric": "ppo_atari_env_steps_per_sec_per_chip",
+        # value = best-of-N windows (the machine's capability);
+        # median/spread expose tunnel noise vs real regression.
+        "value": round(best, 1),
+        "median": round(med, 1),
+        "spread": round(spread, 4),
+        "unit": "env-steps/sec/chip",
+        "vs_baseline": round(best / PER_CHIP_TARGET, 3),
+    }
+    if os.environ.get("BENCH_IMPALA"):
+        try:
+            child = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--measure-impala"],
+                capture_output=True,
+                text=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                timeout=int(os.environ.get("BENCH_CHILD_TIMEOUT", 900)),
+            )
+            payload["impala_pipeline"] = json.loads(
+                child.stdout.strip().splitlines()[-1]
+            )
+        except Exception:
+            sys.stderr.write(
+                "[bench] impala pipeline leg failed\n"
+                + (child.stderr[-2000:] if "child" in dir() else "")
+            )
+    print(json.dumps(payload))
     return 0
 
 

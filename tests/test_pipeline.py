@@ -1,0 +1,537 @@
+"""Learner ingest pipeline: host arena, prefetch overlap, buffer
+donation, async publish — and the numerics guarantee that the
+pipelined path is bit-identical to the serial one."""
+
+import queue as queue_lib
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from actor_critic_algs_on_tensorflow_tpu.algos import impala
+from actor_critic_algs_on_tensorflow_tpu.data.pipeline import (
+    AsyncParamPublisher,
+    HostArena,
+    LearnerPipeline,
+    TimeSplit,
+)
+from actor_critic_algs_on_tensorflow_tpu.distributed.queue import (
+    TrajectoryQueue,
+)
+from helpers import time_limit
+
+
+def _sharding1():
+    return NamedSharding(Mesh(np.asarray(jax.devices()[:1]), ("data",)), P())
+
+
+# ---- HostArena ----------------------------------------------------------
+
+
+def test_arena_indexed_writes_match_concatenate():
+    rng = np.random.default_rng(0)
+    parts = [
+        [rng.random((4, 3)).astype(np.float32), rng.random((3, 2))]
+        for _ in range(3)
+    ]
+    arena = HostArena(axes=[1, 0], n_parts=3, n_slots=2)
+    for j, leaves in enumerate(parts):
+        arena.write_part(0, j, leaves)
+    got = arena.slot_leaves(0)
+    want = [
+        np.concatenate([p[0] for p in parts], axis=1),
+        np.concatenate([p[1] for p in parts], axis=0),
+    ]
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+        assert g.dtype == w.dtype
+    # Slots are independent buffers.
+    arena.write_part(1, 0, parts[0])
+    assert arena.slot_leaves(1)[0] is not arena.slot_leaves(0)[0]
+
+
+def test_arena_rejects_shape_drift():
+    arena = HostArena(axes=[1], n_parts=2, n_slots=2)
+    arena.write_part(0, 0, [np.zeros((4, 3))])
+    with pytest.raises(ValueError, match="arena part"):
+        arena.write_part(0, 1, [np.zeros((4, 5))])
+
+
+# ---- LearnerPipeline ----------------------------------------------------
+
+
+def _items(n, T=4, B=2, base=0):
+    out = []
+    for i in range(n):
+        traj = {
+            "x": np.full((T, B), base + i, dtype=np.float32),
+            "last": np.full((B,), base + i, dtype=np.float32),
+        }
+        ep = {"done_episode": np.ones((B,)), "episode_return": np.ones((B,))}
+        out.append((traj, ep))
+    return out
+
+
+def _make_pipe(source, batch_parts=2, n_slots=2):
+    treedef = jax.tree_util.tree_structure(source[0][0])
+    lock = threading.Lock()
+
+    def poll(n):
+        got = []
+        with lock:
+            for _ in range(min(n, len(source))):
+                got.append(source.pop(0))
+        if not got:
+            time.sleep(0.01)
+        return got
+
+    sh = _sharding1()
+    return LearnerPipeline(
+        poll=poll,
+        batch_parts=batch_parts,
+        treedef=treedef,
+        axes_leaves=[0, 0],  # flat order of the dict: last, x (sorted keys)
+        shardings_leaves=[sh, sh],
+        n_slots=n_slots,
+    )
+
+
+def test_pipeline_arena_slot_reuse_waits_for_consumption():
+    """An arena slot must not be rewritten while the batch assembled
+    from it has not been marked consumed — even if more source data is
+    waiting (the 'never alias a batch still in flight' contract)."""
+    with time_limit(30):
+        source = _items(6)  # 3 batches of 2
+        pipe = _make_pipe(source, batch_parts=2, n_slots=2)
+        try:
+            b0, eps0, h0 = pipe.get()
+            assert h0 == 0
+            v0 = {k: np.asarray(v) for k, v in b0.items()}
+            # batch1 stages into slot 1; batch2 needs slot 0 and must
+            # block: without mark_consumed its token never arrives.
+            deadline = time.monotonic() + 5
+            while pipe.batches < 2 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert pipe.batches == 2
+            time.sleep(0.3)  # would-be window for an aliasing rewrite
+            assert pipe.batches == 2, "slot reused before consumption"
+            # Slot 0's host buffers still hold batch0's data.
+            for got, want in zip(
+                pipe._arena.slot_leaves(0),
+                [v0["last"], v0["x"]],
+            ):
+                np.testing.assert_array_equal(got, want)
+            # Consume -> slot released -> batch2 assembles.
+            pipe.mark_consumed(h0, jnp.zeros(()))
+            b1, _, h1 = pipe.get()
+            b2, _, h2 = pipe.get()
+            assert h2 == 0  # slot 0 recycled
+            assert set(np.unique(np.asarray(b2["x"]))) == {4.0, 5.0}
+            # batch0's device values were never corrupted.
+            np.testing.assert_array_equal(np.asarray(b0["x"]), v0["x"])
+        finally:
+            pipe.close()
+
+
+def test_pipeline_ordered_shutdown_drains_cleanly():
+    """close() while the source still produces: prefetch exits, staged
+    batches are dropped, close is idempotent, no error surfaces."""
+    with time_limit(30):
+        feeding = threading.Event()
+        feeding.set()
+
+        def poll(n):
+            if feeding.is_set():
+                return _items(min(n, 2))
+            time.sleep(0.01)
+            return ()
+
+        sh = _sharding1()
+        treedef = jax.tree_util.tree_structure(_items(1)[0][0])
+        pipe = LearnerPipeline(
+            poll=poll, batch_parts=2, treedef=treedef,
+            axes_leaves=[0, 0], shardings_leaves=[sh, sh],
+        )
+        pipe.get()  # at least one batch flowed
+        pipe.close()
+        assert not pipe.alive
+        pipe.close()  # idempotent
+        assert pipe._error is None
+
+
+def test_pipeline_poll_exception_surfaces_in_get():
+    with time_limit(30):
+        def poll(n):
+            raise RuntimeError("actor died and budget exhausted")
+
+        sh = _sharding1()
+        pipe = LearnerPipeline(
+            poll=poll, batch_parts=1,
+            treedef=jax.tree_util.tree_structure({"x": 0}),
+            axes_leaves=[0], shardings_leaves=[sh],
+        )
+        try:
+            with pytest.raises(RuntimeError, match="budget exhausted"):
+                pipe.get()
+        finally:
+            pipe.close()
+
+
+def test_pipeline_device_stack_path():
+    """Device-resident trajectories (in-process mode) bypass the arena
+    and stack on device; handle is None and mark_consumed is a no-op."""
+    with time_limit(30):
+        source = [
+            ({"x": jnp.full((2, 2), i, jnp.float32)}, {"e": np.ones(2)})
+            for i in range(2)
+        ]
+
+        def poll(n):
+            got = source[:n]
+            del source[: len(got)]
+            if not got:
+                time.sleep(0.01)
+            return got
+
+        pipe = LearnerPipeline(
+            poll=poll, batch_parts=2,
+            assemble_device=lambda parts: jnp.concatenate(
+                [p["x"] for p in parts], axis=1
+            ),
+        )
+        try:
+            batch, eps, handle = pipe.get()
+            assert handle is None
+            pipe.mark_consumed(handle, batch)  # no-op
+            assert batch.shape == (2, 4)
+            assert isinstance(eps[0]["e"], np.ndarray)
+        finally:
+            pipe.close()
+
+
+# ---- queue batch drain --------------------------------------------------
+
+
+def test_queue_get_many_batches_stats():
+    q = TrajectoryQueue(maxsize=8, watchdog_timeout_s=60)
+    for i in range(5):
+        q.put(i)
+    got = q.get_many(3, timeout=1.0)
+    assert got == [0, 1, 2]
+    assert q.get_many(10, timeout=1.0) == [3, 4]
+    assert q.metrics()["queue_gets"] == 5
+    with pytest.raises(queue_lib.Empty):
+        q.get_many(1, timeout=0.05)
+    q.close()
+
+
+# ---- donation -----------------------------------------------------------
+
+
+def _impala_cfg(**kw):
+    base = dict(
+        env="CartPole-v1",
+        num_actors=1,
+        envs_per_actor=4,
+        rollout_length=8,
+        batch_trajectories=2,
+        total_env_steps=2 * 4 * 8 * 4,
+        num_devices=1,
+    )
+    base.update(kw)
+    return impala.ImpalaConfig(**base)
+
+
+def _rollout_batches(programs, state, n_batches, batch_trajectories):
+    """Deterministic trajectory stream from fixed params/keys."""
+    rollout, env_reset = programs.make_actor_programs(0)
+    env_state, obs, carry = env_reset(jax.random.PRNGKey(1))
+    batches = []
+    k = 0
+    for _ in range(n_batches):
+        trajs = []
+        for _ in range(batch_trajectories):
+            env_state, obs, carry, traj, _ = rollout(
+                state.params, env_state, obs, carry, jax.random.PRNGKey(k)
+            )
+            trajs.append(traj)
+            k += 1
+        batches.append(trajs)
+    return batches
+
+
+def test_donated_step_keeps_retained_outputs_valid():
+    """donate_argnums recycles INPUT buffers; every retained OUTPUT
+    (previous metrics, published param copies) must stay intact across
+    subsequent donated steps."""
+    cfg = _impala_cfg()
+    programs = impala.make_impala(cfg)
+    state = programs.init(jax.random.PRNGKey(0))
+    batches = _rollout_batches(programs, state, 3, cfg.batch_trajectories)
+    published = programs.copy_params(state.params)
+    pub_before = np.asarray(
+        jax.tree_util.tree_leaves(published)[0]
+    ).copy()
+    retained = []
+    for trajs in batches:
+        batch = impala.stack_trajectories(trajs)
+        state, metrics = programs.learner_step_donated(state, batch)
+        retained.append(metrics)
+    # Metrics from every step readable after later donations.
+    for m in retained:
+        vals = [float(v) for v in m.values()]
+        assert np.isfinite(vals).all(), vals
+    # The published snapshot never aliased the donated state buffers.
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree_util.tree_leaves(published)[0]), pub_before
+    )
+
+
+def test_pipelined_donated_matches_serial_bit_identical():
+    """Fixed trajectory stream on CPU: arena assembly + sharded
+    device_put + donated learner step produces bit-identical params to
+    the serial stack_trajectories + plain step path."""
+    with time_limit(120):
+        cfg = _impala_cfg()
+        programs = impala.make_impala(cfg)
+        state0 = programs.init(jax.random.PRNGKey(0))
+        n_batches = 4
+        batches = _rollout_batches(
+            programs, state0, n_batches, cfg.batch_trajectories
+        )
+
+        # Serial reference: device concat + non-donating step.
+        state_s = programs.init(jax.random.PRNGKey(0))
+        for trajs in batches:
+            batch = impala.stack_trajectories(trajs)
+            state_s, _ = programs.learner_step(state_s, batch)
+
+        # Pipelined: numpy wire leaves -> arena -> sharded device_put
+        # -> donated step, driven through the real LearnerPipeline.
+        wire = [
+            (
+                jax.tree_util.tree_map(np.asarray, traj),
+                {"done_episode": np.zeros(1), "episode_return": np.zeros(1)},
+            )
+            for trajs in batches
+            for traj in trajs
+        ]
+        treedef, axes, shardings = programs.ingest_plan(wire[0][0])
+        lock = threading.Lock()
+
+        def poll(n):
+            got = []
+            with lock:
+                for _ in range(min(n, len(wire))):
+                    got.append(wire.pop(0))
+            if not got:
+                time.sleep(0.005)
+            return got
+
+        pipe = LearnerPipeline(
+            poll=poll, batch_parts=cfg.batch_trajectories,
+            treedef=treedef, axes_leaves=axes, shardings_leaves=shardings,
+        )
+        try:
+            state_p = programs.init(jax.random.PRNGKey(0))
+            for _ in range(n_batches):
+                batch, _, handle = pipe.get()
+                state_p, metrics = programs.learner_step_donated(
+                    state_p, batch
+                )
+                pipe.mark_consumed(handle, metrics)
+        finally:
+            pipe.close()
+
+        for a, b in zip(
+            jax.tree_util.tree_leaves(jax.device_get(state_s.params)),
+            jax.tree_util.tree_leaves(jax.device_get(state_p.params)),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---- run_impala integration --------------------------------------------
+
+
+def test_run_impala_serial_fallback_flag():
+    """cfg.pipeline=False keeps the serial drain->assemble->dispatch
+    loop alive and training completes."""
+    cfg = impala.ImpalaConfig(
+        env="CartPole-v1", num_actors=2, envs_per_actor=4,
+        rollout_length=8, batch_trajectories=2, queue_size=4,
+        total_env_steps=2 * 4 * 8 * 3, pipeline=False,
+    )
+    state, history = impala.run_impala(
+        cfg, log_interval=1, log_fn=lambda s, m: None
+    )
+    assert int(state.step) == 3
+    assert "pipeline_batches" not in history[-1][1]
+    assert "pipeline_compute_s" in history[-1][1]
+
+
+def test_run_impala_pipelined_smoke_metrics():
+    """A few pipelined learner iterations on CPU (tier-1 exercises the
+    new default path); pipeline_* metrics ride the log stream."""
+    cfg = impala.ImpalaConfig(
+        env="CartPole-v1", num_actors=2, envs_per_actor=4,
+        rollout_length=8, batch_trajectories=2, queue_size=4,
+        total_env_steps=2 * 4 * 8 * 3,
+    )
+    state, history = impala.run_impala(
+        cfg, log_interval=1, log_fn=lambda s, m: None
+    )
+    assert int(state.step) == 3
+    final = history[-1][1]
+    assert final["pipeline_batches"] >= 3
+    assert "pipeline_compute_s" in final
+    assert np.isfinite(final["loss"])
+    assert not any(
+        t.name == "learner-pipeline" and t.is_alive()
+        for t in threading.enumerate()
+    )
+
+
+# ---- chaos: reconnect mid-prefetch --------------------------------------
+
+
+@pytest.mark.chaos
+def test_chaos_reconnect_mid_prefetch_delivers_untorn_batches():
+    """Transport faults (mid-frame truncation + resets) while the
+    prefetch pipeline is live, with the actor REUSING its send buffer
+    after every acked push (the arena-reuse-across-reconnects case):
+    every trajectory the pipeline assembles must be internally
+    consistent — all payload elements equal to the frame id, never a
+    mix of two generations of the reused buffer."""
+    from actor_critic_algs_on_tensorflow_tpu.distributed.resilience import (
+        ChaosProxy,
+        ResilientActorClient,
+        RetryPolicy,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+        LearnerServer,
+    )
+
+    with time_limit(60, "chaos mid-prefetch"):
+        n_traj = 24
+        q = TrajectoryQueue(maxsize=8, watchdog_timeout_s=60.0)
+
+        def sink(traj_leaves, ep_leaves):
+            q.put(
+                (
+                    {"id": traj_leaves[0], "x": traj_leaves[1]},
+                    {"done_episode": np.zeros(1),
+                     "episode_return": np.zeros(1)},
+                ),
+                timeout=30.0,
+            )
+
+        server = LearnerServer(sink, idle_timeout_s=30.0, log=lambda m: None)
+        proxy = ChaosProxy("127.0.0.1", server.port)
+
+        def poll(n):
+            try:
+                return q.get_many(n, timeout=0.1)
+            except queue_lib.Empty:
+                return ()
+
+        sh = _sharding1()
+        pipe = LearnerPipeline(
+            poll=poll, batch_parts=2,
+            treedef=jax.tree_util.tree_structure({"id": 0, "x": 0}),
+            axes_leaves=[0, 0], shardings_leaves=[sh, sh],
+        )
+
+        errors: list = []
+
+        def actor():
+            try:
+                client = ResilientActorClient(
+                    "127.0.0.1", proxy.port,
+                    retry=RetryPolicy(
+                        base_delay_s=0.01, max_delay_s=0.05, deadline_s=15.0
+                    ),
+                    heartbeat_interval_s=0.1, idle_timeout_s=3.0,
+                )
+                arena = np.empty(512, np.float32)  # ONE reused buffer
+                for i in range(n_traj):
+                    arena.fill(float(i))
+                    client.push_trajectory(
+                        [np.array([i], np.int64), arena]
+                    )
+                    time.sleep(0.005)
+                reconnects.append(client.stats()["reconnects"])
+                client.close()
+            except BaseException as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+        reconnects: list = []
+        t = threading.Thread(target=actor, daemon=True)
+        t.start()
+
+        # Faults while the pipeline is actively prefetching.
+        time.sleep(0.05)
+        proxy.set_truncate_after(700)   # next link dies mid-frame
+        time.sleep(0.05)
+        proxy.reset_all()
+
+        seen = 0
+        try:
+            while seen < n_traj - 4:  # duplicates possible, gaps not
+                batch, _, handle = pipe.get()
+                ids = np.asarray(batch["id"]).reshape(-1)
+                xs = np.asarray(batch["x"]).reshape(2, -1)
+                for j, fid in enumerate(ids):
+                    np.testing.assert_array_equal(
+                        xs[j], np.full(512, float(fid), np.float32),
+                        err_msg="torn frame: payload mixes generations",
+                    )
+                seen += len(ids)
+                pipe.mark_consumed(handle, jnp.zeros(()))
+        finally:
+            t.join(timeout=30.0)
+            pipe.close()
+            proxy.close()
+            server.close()
+            q.close()
+        assert not errors, errors
+        assert reconnects and reconnects[0] >= 1, reconnects
+
+
+# ---- async publisher ----------------------------------------------------
+
+
+def test_async_publisher_coalesces_and_flushes_on_close():
+    with time_limit(30):
+        published = []
+        gate = threading.Event()
+
+        def slow_publish(p):
+            gate.wait(5.0)
+            published.append(p)
+
+        pub = AsyncParamPublisher(slow_publish)
+        pub.submit(1)
+        time.sleep(0.2)  # thread is now blocked inside slow_publish(1)
+        pub.submit(2)
+        pub.submit(3)  # coalesces over 2 (newest wins)
+        gate.set()
+        pub.close()  # flushes the pending newest
+        assert published[0] == 1
+        assert published[-1] == 3
+        assert 2 not in published
+        assert pub.metrics()["publish_async"] == len(published)
+
+
+def test_timesplit_windows():
+    ts = TimeSplit(prefix="p_")
+    ts.add("a", 1.0)
+    assert ts.window() == {"p_a": 1.0}
+    ts.add("a", 0.5)
+    ts.add("b", 2.0)
+    w = ts.window()
+    assert w["p_a"] == 0.5 and w["p_b"] == 2.0
+    assert ts.cumulative()["p_a"] == 1.5

@@ -139,6 +139,37 @@ def test_wire_hardening_rejects_garbage_before_allocating():
     b.close()
 
 
+def test_recv_msg_alloc_hook_receives_into_caller_buffers():
+    """``recv_msg(alloc=...)`` lands every payload inside the
+    caller-supplied backing store (arena-style preallocation): the
+    returned arrays are views of the alloc'd buffers, values
+    round-trip, and the hook sees only header-validated sizes."""
+    arrays = [
+        np.arange(12, dtype=np.float32).reshape(3, 4),
+        np.array(7, dtype=np.int64),                      # 0-d
+        np.zeros((2, 0, 5), dtype=np.uint8),              # empty dim
+    ]
+    handed: list = []
+
+    def alloc(nbytes):
+        buf = np.empty(max(nbytes, 1), dtype=np.uint8)
+        handed.append((nbytes, buf))
+        return buf
+
+    a, b = socket.socketpair()
+    send_msg(a, KIND_TRAJ, 5, arrays)
+    kind, tag, got = recv_msg(b, alloc=alloc)
+    assert kind == KIND_TRAJ and tag == 5
+    assert [n for n, _ in handed] == [x.nbytes for x in arrays]
+    for want, have, (_, buf) in zip(arrays, got, handed):
+        np.testing.assert_array_equal(want, have)
+        assert have.dtype == want.dtype and have.shape == want.shape
+        if want.nbytes:
+            assert np.shares_memory(have, buf), "copied, not received into"
+    a.close()
+    b.close()
+
+
 def test_max_frame_bytes_is_configurable():
     a, b = socket.socketpair()
     a.sendall(pack_arrays(KIND_TRAJ, 1, [np.zeros(1024, np.float32)]))
