@@ -142,7 +142,7 @@ def _make_init(algo: str, cfg):
             make_impala,
         )
 
-        return make_impala(cfg)[0]
+        return make_impala(cfg).init
     raise ValueError(f"unknown algo {algo!r}")
 
 

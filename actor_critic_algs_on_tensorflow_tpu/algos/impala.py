@@ -57,6 +57,7 @@ from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
     make_mesh,
     shard_map,
 )
+from actor_critic_algs_on_tensorflow_tpu.utils import health as health_lib
 
 TIME_AXIS = "time"
 
@@ -108,6 +109,37 @@ class ImpalaConfig:
     # Dead actors are restarted (stateless recovery) up to this many
     # times before the failure is surfaced (SURVEY.md §5).
     max_actor_restarts: int = 2
+    # --- training-health sentinel (utils.health) --------------------
+    # In-graph all-finite guard over loss/grads/params folded into
+    # learner_step (one fused reduction; surfaced as the
+    # ``health_finite`` metric) + host-side rollback to the newest
+    # last-good state snapshot when it trips. guard_check_interval
+    # amortizes the per-step scalar fetch; snapshot_interval spaces the
+    # last-good ring pushes (in PASSING checks), so a rollback loses at
+    # most check*snapshot iterations of progress.
+    numerics_guards: bool = True
+    guard_check_interval: int = 1
+    snapshot_interval: int = 20
+    snapshot_ring: int = 2
+    max_rollbacks: int = 3
+    # Host-side divergence tripwires for finite-but-exploding runs:
+    # trip when |loss| (resp. grad norm) exceeds factor x its EWMA
+    # after a warmup. 0 disables (default: the finite guard alone).
+    loss_spike_factor: float = 0.0
+    grad_norm_spike_factor: float = 0.0
+    spike_warmup_checks: int = 20
+    # Pre-arena trajectory validation (finite obs/rewards, bounded
+    # behaviour log-probs, per-actor provenance): wire-path (numpy)
+    # trajectories are always validated when enabled; device-resident
+    # in-process trajectories only with validate_device_trajectories
+    # (the check forces a device->host transfer per rollout). An actor
+    # whose trajectories fail quarantine_threshold times in a row is
+    # quarantined and respawned via the generation mechanism, counted
+    # against max_actor_restarts.
+    validate_trajectories: bool = True
+    validate_device_trajectories: bool = False
+    quarantine_threshold: int = 3
+    traj_logit_bound: float = 1e4
     # --- transport fault tolerance (run_impala_distributed) ---------
     # Actor-side heartbeat cadence while waiting on the learner, the
     # silence window after which either side declares the peer wedged
@@ -192,6 +224,7 @@ class ImpalaPrograms:
     mesh: Any
     learner_step_donated: Any
     copy_params: Any            # jitted pytree copy (donation-safe publish)
+    copy_state: Any             # jitted FULL-state copy (sentinel snapshots)
     batch_time_axis: Any        # TIME_AXIS or None (the t-axis spec name)
 
     def __iter__(self):
@@ -324,6 +357,7 @@ class ImpalaActor(threading.Thread):
         self.rollouts = 0
         self.error: BaseException | None = None
         self._inject_fault = threading.Event()
+        self._inject_poison = threading.Event()
 
     def _run_serialized(self, fn, *args):
         if self._exec_lock is None:
@@ -337,6 +371,13 @@ class ImpalaActor(threading.Thread):
         """Make the next rollout raise (fault-injection testing,
         SURVEY.md §5 failure-detection row)."""
         self._inject_fault.set()
+
+    def inject_poison(self) -> None:
+        """Corrupt every subsequent rollout's rewards to NaN until the
+        actor is recycled — the numerics analog of ``inject_fault``,
+        exercising the quarantine path. The fresh generation spawned
+        after quarantine starts clean (new ImpalaActor, event unset)."""
+        self._inject_poison.set()
 
     def run(self) -> None:
         try:
@@ -352,6 +393,13 @@ class ImpalaActor(threading.Thread):
                 env_state, obs, carry, traj, ep = self._run_serialized(
                     self._rollout, params, env_state, obs, carry, k
                 )
+                if self._inject_poison.is_set():
+                    traj = self._run_serialized(
+                        lambda t: t.replace(
+                            rewards=jnp.full_like(t.rewards, jnp.nan)
+                        ),
+                        traj,
+                    )
                 while not self._halt.is_set():
                     try:
                         self._queue.put((traj, ep), timeout=0.5)
@@ -509,6 +557,11 @@ def make_impala(cfg: ImpalaConfig):
                 entry_prev_done=entry_prev_done,
             )
             ep = {
+                # Provenance for the poison-batch quarantine: which
+                # actor produced this rollout (a compile-time constant
+                # per actor program; rides the wire with the episode
+                # stats, costs one scalar).
+                "actor_id": jnp.full((), actor_id, jnp.int32),
                 "episode_return": ep_info["episode_return"],
                 "done_episode": ep_info["done_episode"],
             }
@@ -625,6 +678,20 @@ def make_impala(cfg: ImpalaConfig):
         grads = jax.lax.pmean(grads, mesh_axes)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
+        guard_metrics = {}
+        if cfg.numerics_guards:
+            # In-graph numerics guard: one fused all-finite reduction
+            # over loss/grads/updated params (no host sync per leaf);
+            # the host-side sentinel reads the single scalar and rolls
+            # back on 0.
+            guard_metrics["health_finite"] = health_lib.all_finite(
+                (loss, grads, params)
+            ).astype(jnp.float32)
+        if cfg.numerics_guards or cfg.grad_norm_spike_factor > 0:
+            # grad_norm feeds the divergence tripwire, so it must be
+            # emitted whenever that tripwire is armed — even with the
+            # finite guard itself disabled.
+            guard_metrics["grad_norm"] = optax.global_norm(grads)
         metrics = jax.lax.pmean(
             {
                 "loss": loss,
@@ -632,6 +699,7 @@ def make_impala(cfg: ImpalaConfig):
                 "value_loss": vf,
                 "entropy": ent,
                 "mean_rho": rho,
+                **guard_metrics,
             },
             mesh_axes,
         )
@@ -676,8 +744,13 @@ def make_impala(cfg: ImpalaConfig):
     #     so ParamStore / actor snapshots never alias donated buffers.
     learner_step = jax.jit(sharded_step)
     learner_step_donated = jax.jit(sharded_step, donate_argnums=(0, 1))
-    copy_params = jax.jit(
-        lambda p: jax.tree_util.tree_map(jnp.copy, p)
+    # One jitted tree-copy serves both roles (jit re-specializes per
+    # pytree structure): `copy_params` for donation-safe publication,
+    # `copy_state` for the sentinel's last-good ring — snapshots and
+    # rollback restores must never alias buffers a donated step will
+    # recycle.
+    copy_tree = jax.jit(
+        lambda t: jax.tree_util.tree_map(jnp.copy, t)
     )
     return ImpalaPrograms(
         init=init,
@@ -685,8 +758,35 @@ def make_impala(cfg: ImpalaConfig):
         make_actor_programs=make_actor_programs,
         mesh=mesh,
         learner_step_donated=learner_step_donated,
-        copy_params=copy_params,
+        copy_params=copy_tree,
+        copy_state=copy_tree,
         batch_time_axis=t_axis,
+    )
+
+
+def _make_sentinel(cfg: ImpalaConfig, programs: ImpalaPrograms, publish,
+                   exec_lock):
+    """Config -> TrainingHealthSentinel (or None when every guard is
+    off) — shared by both run loops so the wiring cannot drift."""
+    if not (
+        cfg.numerics_guards
+        or cfg.loss_spike_factor > 0
+        or cfg.grad_norm_spike_factor > 0
+    ):
+        return None
+    return health_lib.TrainingHealthSentinel(
+        copy_state=programs.copy_state,
+        publish=publish,
+        max_rollbacks=cfg.max_rollbacks,
+        ring_capacity=cfg.snapshot_ring,
+        snapshot_interval=cfg.snapshot_interval,
+        check_interval=cfg.guard_check_interval,
+        detector=health_lib.DivergenceDetector(
+            loss_spike_factor=cfg.loss_spike_factor,
+            grad_norm_spike_factor=cfg.grad_norm_spike_factor,
+            warmup_checks=cfg.spike_warmup_checks,
+        ),
+        exec_lock=exec_lock,
     )
 
 
@@ -748,6 +848,10 @@ def _learner_loop(
     checkpoint_interval: int = 200,
     exec_lock: threading.Lock | None = None,
     ingest_plan=None,
+    sentinel=None,
+    validate=None,
+    stop_event: threading.Event | None = None,
+    corrupt_batch=None,
 ) -> Tuple[LearnerState, List[Tuple[int, Dict[str, float]]]]:
     """Shared learner loop of the in-process and cross-process modes.
 
@@ -756,6 +860,15 @@ def _learner_loop(
     faults); ``extra_metrics()`` contributes mode-specific scalars.
     ``exec_lock`` (CPU-mesh mode only) serializes the learner's
     dispatches against the actor threads' — see ImpalaActor.
+
+    Training health: ``sentinel`` (utils.health.TrainingHealthSentinel)
+    checks each step's in-graph guard scalars and rolls the state back
+    to the last-good snapshot on a trip; ``validate(traj, ep)`` is the
+    pre-arena poison-batch filter applied to every trajectory before it
+    joins a batch. ``stop_event`` (preemption-safe shutdown) breaks the
+    loop at the next iteration boundary and saves one final checkpoint
+    at the interrupted step. ``corrupt_batch(it, batch) -> batch`` is a
+    test-only fault-injection hook.
 
     With ``cfg.pipeline`` a ``LearnerPipeline`` prefetch thread drains
     the queue and assembles/transfers the NEXT batch while the current
@@ -814,6 +927,7 @@ def _learner_loop(
             assemble_device=stack_trajectories,
             n_slots=max(2, cfg.pipeline_slots),
             exec_lock=exec_lock,
+            validate=validate,
         )
 
     def dispatch_step(state, make_batch):
@@ -830,33 +944,71 @@ def _learner_loop(
         split.add("compute_s", time.perf_counter() - tc)
         return state, metrics
 
+    if sentinel is not None:
+        # The pre-loop state is the first rollback target: a guard
+        # tripping before any periodic snapshot still recovers.
+        sentinel.seed(state, iters_done0 - 1)
+
+    def poison(it, make_batch):
+        if corrupt_batch is None:
+            return make_batch
+        return lambda: corrupt_batch(it, make_batch())
+
     history: List[Tuple[int, Dict[str, float]]] = []
     t0 = time.perf_counter()
     last_log_i, last_log_t = 0, t0
+    iters_completed = 0
+    interrupted = False
     try:
         for i in range(num_learner_steps):
+            if stop_event is not None and stop_event.is_set():
+                interrupted = True
+                break
             it = iters_done0 + i
             it_box[0] = it
             if pipe is not None:
-                batch, eps, handle = pipe.get()
-                state, metrics = dispatch_step(state, lambda: batch)
+                got = pipe.get(stop=stop_event)
+                if got is None:
+                    # Preemption while waiting for a batch (the actors
+                    # likely died of the same signal): save and exit
+                    # instead of waiting forever for data that will
+                    # never come.
+                    interrupted = True
+                    break
+                batch, eps, handle = got
+                state, metrics = dispatch_step(
+                    state, poison(it, lambda: batch)
+                )
                 pipe.mark_consumed(handle, metrics)
                 del batch  # donated or pipeline-owned; never reused here
             else:
                 trajs, eps = [], []
                 tq0 = time.perf_counter()
                 while len(trajs) < cfg.batch_trajectories:
+                    if stop_event is not None and stop_event.is_set():
+                        interrupted = True
+                        break
                     check_health(it)
                     try:
                         traj, ep = q.get(timeout=1.0)
                     except queue_lib.Empty:  # re-check actor health
                         continue
+                    if validate is not None and not validate(traj, ep):
+                        continue  # dropped-and-recorded by the validator
                     trajs.append(traj)
                     eps.append(ep)
                 split.add("queue_wait_s", time.perf_counter() - tq0)
+                if interrupted:
+                    break
                 state, metrics = dispatch_step(
-                    state, lambda: stack_trajectories(trajs)
+                    state, poison(it, lambda: stack_trajectories(trajs))
                 )
+            if sentinel is not None:
+                # Guard check on the step that just ran; on a trip this
+                # returns the restored last-good state (and re-publishes
+                # params); on budget exhaustion it raises.
+                state = sentinel.after_step(it, state, metrics)
+            iters_completed = i + 1
             env_steps = steps_done0 + (i + 1) * steps_per_batch
             if (it + 1) % cfg.publish_interval == 0:
                 publish(state.params)
@@ -865,7 +1017,18 @@ def _learner_loop(
                 and checkpoint_interval
                 and (i + 1) % checkpoint_interval == 0
             ):
-                checkpointer.save(env_steps, state)
+                # Checkpoint ids derive from state.step, NOT the loop
+                # counter: a sentinel rollback rewinds state.step while
+                # i marches on, and an id inflated past the state
+                # inside it would shadow newer progress when the
+                # resumed run counts back up through it. Ids at or
+                # below the newest retained step are skipped — orbax
+                # silently refuses non-monotonic saves anyway, and the
+                # retained save there was a verified-good state.
+                ckpt_id = int(jax.device_get(state.step)) * steps_per_batch
+                latest = checkpointer.latest_step()
+                if latest is None or ckpt_id > latest:
+                    checkpointer.save(ckpt_id, state)
             if (i + 1) % log_interval == 0 or i == num_learner_steps - 1:
                 m = device_get_metrics(metrics)
                 m.update(_episode_stats(eps))
@@ -898,6 +1061,8 @@ def _learner_loop(
                             max(0.0, 1.0 - stall / ingest), 4
                         )
                     m.update(pm)
+                if sentinel is not None:
+                    m.update(sentinel.metrics())
                 m.update(extra_metrics())
                 history.append((env_steps, m))
                 if summary_writer is not None:
@@ -906,6 +1071,30 @@ def _learner_loop(
                     log_fn(env_steps, m)
                 else:
                     print(format_metrics(env_steps, m), flush=True)
+        if interrupted:
+            # Preemption-safe shutdown: one final atomic checkpoint at
+            # the interrupted step, durable before the teardown in the
+            # callers' finally blocks broadcasts KIND_CLOSE and exits.
+            # Id from state.step (see the periodic save above).
+            env_steps_done = (
+                int(jax.device_get(state.step)) * steps_per_batch
+            )
+            saved = (
+                checkpointer.save_interrupted(env_steps_done, state)
+                if checkpointer is not None
+                else False
+            )
+            tail = ""
+            if saved:
+                tail = "; final checkpoint saved"
+            elif checkpointer is not None:
+                tail = "; an equal-or-newer retained checkpoint covers it"
+            print(
+                f"[impala] shutdown signal: stopped after "
+                f"{iters_completed} iterations this run "
+                f"(env steps {env_steps_done}){tail}",
+                flush=True,
+            )
     finally:
         if pipe is not None:
             pipe.close()
@@ -918,10 +1107,13 @@ def run_impala(
     log_interval: int = 20,
     log_fn=None,
     inject_failure_at: int | None = None,
+    inject_nan_at: int | None = None,
+    inject_poison_at: int | None = None,
     summary_writer=None,
     checkpointer=None,
     checkpoint_interval: int = 200,
     initial_state: LearnerState | None = None,
+    stop_event: threading.Event | None = None,
 ) -> Tuple[LearnerState, List[Tuple[int, Dict[str, float]]]]:
     """Drive actors + learner until the env-step budget is consumed.
 
@@ -930,7 +1122,13 @@ def run_impala(
     ``cfg.max_actor_restarts`` times — the reference-era analog is
     restarting a crashed A3C worker process (SURVEY.md §5 "failure
     detection / elastic recovery"). ``inject_failure_at`` kills one
-    actor at that learner step to exercise the path in tests.
+    actor at that learner step to exercise the path in tests;
+    ``inject_nan_at`` poisons that step's BATCH with NaN rewards (the
+    sentinel's guard-trip + rollback path); ``inject_poison_at`` makes
+    actor 0 emit NaN trajectories from that step on (the quarantine +
+    respawn path — pair with ``cfg.validate_device_trajectories``).
+    ``stop_event`` set (e.g. by utils.health.ShutdownSignal on SIGTERM)
+    stops at the next iteration boundary with a final checkpoint.
     """
     from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
         donation_supported,
@@ -972,16 +1170,65 @@ def run_impala(
             seed=cfg.seed * 10_000 + generation * 1_000 + i,
             exec_lock=exec_lock,
         )
+        # inject_poison_at=0 poisons actor 0 from its very first rollout
+        # (deterministic for tests — no race against the clean backlog
+        # actors enqueue before the learner's health check first runs).
+        if (
+            inject_poison_at is not None
+            and inject_poison_at <= 0
+            and i == 0
+            and generation == 0
+        ):
+            a.inject_poison()
         a.start()
         return a
 
     actors = [spawn(i, 0) for i in range(cfg.num_actors)]
 
+    # Pre-arena quarantine: in-process trajectories are device-resident,
+    # so validation (a device->host transfer per rollout) is opt-in —
+    # the wire path in run_impala_distributed validates unconditionally.
+    validator = None
+    if cfg.validate_trajectories and cfg.validate_device_trajectories:
+        validator = health_lib.TrajectoryValidator(
+            logit_bound=cfg.traj_logit_bound,
+            quarantine_threshold=cfg.quarantine_threshold,
+        )
+    poisoned = False
+
     def check_health(it: int):
-        nonlocal restarts, injected
+        nonlocal restarts, injected, poisoned
+        if stop_event is not None and stop_event.is_set():
+            # Shutting down (e.g. SIGTERM to the whole process group):
+            # dead actors are expected, and respawning them — or worse,
+            # exhausting the restart budget and raising — must not race
+            # the final checkpoint.
+            return
         if inject_failure_at is not None and it == inject_failure_at and not injected:
             injected = True
             actors[0].inject_fault()
+        if inject_poison_at is not None and it >= inject_poison_at and not poisoned:
+            poisoned = True
+            actors[0].inject_poison()
+        if validator is not None:
+            # Quarantined actors are recycled through the SAME restart
+            # path as crashed ones: inject_fault makes the next rollout
+            # raise, the dead-actor branch below respawns a fresh
+            # generation, and the quarantine lifts when it does.
+            for aid in validator.take_respawns():
+                if not 0 <= aid < len(actors):
+                    print(
+                        f"[impala] quarantined actor id {aid} maps to "
+                        f"no live actor; dropping its pushes only",
+                        flush=True,
+                    )
+                    continue
+                print(
+                    f"[impala] actor {aid} quarantined by the trajectory "
+                    f"validator; recycling via the restart path",
+                    flush=True,
+                )
+                actors[aid].inject_fault()
         for idx, a in enumerate(actors):
             if a.error is None:
                 continue
@@ -998,6 +1245,22 @@ def run_impala(
                 flush=True,
             )
             actors[idx] = spawn(a.actor_id, restarts)
+            if validator is not None:
+                validator.reset_actor(a.actor_id)
+
+    sentinel = _make_sentinel(cfg, programs, publish, exec_lock)
+
+    corrupt_batch = None
+    if inject_nan_at is not None:
+        nan_injected = [False]
+
+        def corrupt_batch(it, batch):
+            if it == inject_nan_at and not nan_injected[0]:
+                nan_injected[0] = True
+                return batch.replace(
+                    rewards=jnp.full_like(batch.rewards, jnp.nan)
+                )
+            return batch
 
     try:
         state, history = _learner_loop(
@@ -1007,6 +1270,7 @@ def run_impala(
             extra_metrics=lambda: {
                 "param_version": store.version,
                 "actor_restarts": restarts,
+                **(validator.metrics() if validator is not None else {}),
             },
             log_interval=log_interval,
             log_fn=log_fn,
@@ -1014,6 +1278,10 @@ def run_impala(
             checkpointer=checkpointer,
             checkpoint_interval=checkpoint_interval,
             exec_lock=exec_lock,
+            sentinel=sentinel,
+            validate=validator.admit if validator is not None else None,
+            stop_event=stop_event,
+            corrupt_batch=corrupt_batch,
         )
     finally:
         stop.set()
@@ -1120,6 +1388,7 @@ def run_impala_distributed(
     initial_state: LearnerState | None = None,
     host: str = "127.0.0.1",
     port: int = 0,
+    stop_event: threading.Event | None = None,
 ) -> Tuple[LearnerState, List[Tuple[int, Dict[str, float]]]]:
     """IMPALA with actors in separate PROCESSES streaming trajectories
     through ``distributed.transport`` — the same topology that spans
@@ -1173,17 +1442,33 @@ def run_impala_distributed(
     q = TrajectoryQueue(cfg.queue_size)
     closing = threading.Event()
 
+    # Pre-arena quarantine: wire trajectories are numpy leaves already
+    # on the host, so validation is free of device syncs and runs on
+    # the server's connection threads — poison never reaches the queue,
+    # the arena, or the learner. Rejected frames are still ACKed (the
+    # resilient client would otherwise re-push the same poison forever)
+    # and counted by the server as transport_rejected.
+    validator = None
+    if cfg.validate_trajectories:
+        validator = health_lib.TrajectoryValidator(
+            logit_bound=cfg.traj_logit_bound,
+            quarantine_threshold=cfg.quarantine_threshold,
+        )
+
     def on_trajectory(traj_leaves, ep_leaves):
         item = (
             jax.tree_util.tree_unflatten(traj_def, traj_leaves),
             jax.tree_util.tree_unflatten(ep_def, ep_leaves),
         )
+        if validator is not None and not validator.admit(*item):
+            return False
         while not closing.is_set():
             try:
                 q.put(item, timeout=0.5)
-                return
+                return True
             except queue_lib.Full:
                 continue
+        return True
 
     server = LearnerServer(
         on_trajectory,
@@ -1214,6 +1499,45 @@ def run_impala_distributed(
 
     def check_health(it: int):
         nonlocal restarts
+        if stop_event is not None and stop_event.is_set():
+            # See run_impala.check_health: during shutdown a dead actor
+            # process (it likely received the same SIGTERM) is expected;
+            # respawning or raising here would race the final save.
+            return
+        if validator is not None:
+            # Quarantined actor processes are terminated and respawned
+            # through the same generation mechanism as crashed ones
+            # (and against the same restart budget); the quarantine
+            # lifts once the fresh generation is up.
+            for aid in validator.take_respawns():
+                if not 0 <= aid < len(procs):
+                    # Provenance came off the wire — the very data the
+                    # validator distrusts. An unmappable id still has
+                    # its pushes dropped (quarantined); just don't let
+                    # it terminate some healthy process or crash here.
+                    print(
+                        f"[impala] quarantined actor id {aid} maps to "
+                        f"no live process; dropping its pushes only",
+                        flush=True,
+                    )
+                    continue
+                if restarts >= cfg.max_actor_restarts:
+                    raise RuntimeError(
+                        f"actor process {aid} quarantined (poison "
+                        f"trajectories) and restart budget "
+                        f"({cfg.max_actor_restarts}) is exhausted"
+                    )
+                restarts += 1
+                print(
+                    f"[impala] actor process {aid} quarantined by the "
+                    f"trajectory validator; terminate + respawn "
+                    f"{restarts}/{cfg.max_actor_restarts}",
+                    flush=True,
+                )
+                procs[aid].terminate()
+                procs[aid].join(timeout=5.0)
+                procs[aid] = spawn(aid, restarts)
+                validator.reset_actor(aid)
         for idx, p in enumerate(procs):
             if p.is_alive():
                 continue
@@ -1257,6 +1581,8 @@ def run_impala_distributed(
             programs.copy_params(params) if donate else params
         )
 
+    sentinel = _make_sentinel(cfg, programs, publish, exec_lock)
+
     try:
         state, history = _learner_loop(
             cfg, state, learner_step, q,
@@ -1270,6 +1596,7 @@ def run_impala_distributed(
                 "actor_restarts": restarts,
                 **server.metrics(),
                 **publisher.metrics(),
+                **(validator.metrics() if validator is not None else {}),
             },
             log_interval=log_interval,
             log_fn=log_fn,
@@ -1278,6 +1605,8 @@ def run_impala_distributed(
             checkpoint_interval=checkpoint_interval,
             exec_lock=exec_lock,
             ingest_plan=ingest_plan,
+            sentinel=sentinel,
+            stop_event=stop_event,
         )
     finally:
         closing.set()

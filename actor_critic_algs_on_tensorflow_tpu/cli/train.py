@@ -359,6 +359,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="iterations between checkpoints")
     p.add_argument("--resume", action="store_true",
                    help="restore latest checkpoint from --checkpoint-dir")
+    p.add_argument("--preempt-save", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="impala: catch SIGTERM/SIGINT (pod preemption), "
+                        "finish the current step, write one final atomic "
+                        "checkpoint to --checkpoint-dir, broadcast the "
+                        "shutdown frame to actors, and exit 0; signal "
+                        "twice to force the old behavior. Sentinel knobs "
+                        "are config fields: --set numerics_guards= "
+                        "max_rollbacks= snapshot_interval= "
+                        "loss_spike_factor= quarantine_threshold= ...")
     p.add_argument("--log-interval", type=int, default=20)
     p.add_argument("--tensorboard-dir", default=None,
                    help="write TensorBoard scalar event files here")
@@ -527,11 +537,13 @@ def _open_checkpointer(args, make_template, cfg=None):
 
 
 def _finalize_checkpointer(checkpointer, env_steps: int, state) -> None:
-    """Save the final state (unless the loop just saved this step id),
-    flush async saves, and close."""
+    """Save the final state (unless an equal-or-newer step is already
+    retained — orbax silently refuses non-monotonic ids, which a
+    sentinel rollback can produce), flush async saves, and close."""
     if checkpointer is None:
         return
-    if checkpointer.latest_step() != int(env_steps):
+    latest = checkpointer.latest_step()
+    if latest is None or int(env_steps) > latest:
         checkpointer.save(int(env_steps), state)
     checkpointer.wait()
     checkpointer.close()
@@ -616,7 +628,7 @@ def _run(args, algo, cfg, writer) -> int:
 
             # Structure only — restore converts to shape/dtype structs.
             return jax.eval_shape(
-                make_impala(cfg)[0], jax.random.PRNGKey(cfg.seed)
+                make_impala(cfg).init, jax.random.PRNGKey(cfg.seed)
             )
 
         checkpointer, initial_state = _open_checkpointer(args, make_template)
@@ -626,22 +638,44 @@ def _run(args, algo, cfg, writer) -> int:
             kwargs["host"], kwargs["port"] = parse_bind(args.learner_bind)
         else:
             runner = run_impala
-        state, _ = runner(
-            cfg,
-            log_interval=args.log_interval,
-            summary_writer=writer,
-            checkpointer=checkpointer,
-            checkpoint_interval=args.checkpoint_interval,
-            initial_state=initial_state,
-            **kwargs,
-        )
+        # Preemption-safe shutdown: SIGTERM/SIGINT set an event the
+        # learner loop polls; it saves a final atomic checkpoint at the
+        # interrupted step and tears down cleanly (KIND_CLOSE broadcast
+        # to actor processes — no ConnectionError tail), exit code 0.
+        shutdown = None
+        if args.preempt_save:
+            from actor_critic_algs_on_tensorflow_tpu.utils.health import (
+                ShutdownSignal,
+            )
+
+            shutdown = ShutdownSignal().install()
+            kwargs["stop_event"] = shutdown.event
+        try:
+            state, _ = runner(
+                cfg,
+                log_interval=args.log_interval,
+                summary_writer=writer,
+                checkpointer=checkpointer,
+                checkpoint_interval=args.checkpoint_interval,
+                initial_state=initial_state,
+                **kwargs,
+            )
+        finally:
+            if shutdown is not None:
+                shutdown.uninstall()
         steps_per_batch = (
             cfg.batch_trajectories * cfg.envs_per_actor * cfg.rollout_length
         )
         _finalize_checkpointer(
             checkpointer, int(state.step) * steps_per_batch, state
         )
-        print(f"[train] done: learner steps={int(state.step)}")
+        if shutdown is not None and shutdown.event.is_set():
+            print(
+                f"[train] preempted: clean shutdown at learner "
+                f"steps={int(state.step)} (resume with --resume)"
+            )
+        else:
+            print(f"[train] done: learner steps={int(state.step)}")
         return 0
 
     from actor_critic_algs_on_tensorflow_tpu.algos import common

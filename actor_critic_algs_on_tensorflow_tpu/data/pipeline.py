@@ -129,7 +129,10 @@ class LearnerPipeline:
     re-raise from ``get()``. ``assemble_device(parts)`` stacks
     device-resident trajectories (the in-process path);
     ``shardings``/``axes`` drive the arena + sharded ``device_put``
-    path for numpy trajectories (the wire path).
+    path for numpy trajectories (the wire path). ``validate(traj, ep)``
+    (optional — the training-health sentinel's pre-arena quarantine)
+    filters each polled trajectory BEFORE it joins a batch; rejected
+    items are simply skipped (the validator records them).
 
     Contract with the consumer::
 
@@ -156,9 +159,11 @@ class LearnerPipeline:
         assemble_device: Optional[Callable[[List[Any]], Any]] = None,
         n_slots: int = 2,
         exec_lock: Optional[threading.Lock] = None,
+        validate: Optional[Callable[[Any, Any], bool]] = None,
         name: str = "learner-pipeline",
     ):
         self._poll = poll
+        self._validate = validate
         self._batch_parts = batch_parts
         self._treedef = treedef
         self._axes = axes_leaves
@@ -201,6 +206,14 @@ class LearnerPipeline:
                     if self._closed.is_set():
                         return
                     for traj, ep in self._poll(self._batch_parts - len(parts)):
+                        # Pre-arena validation hook: a trajectory the
+                        # health validator rejects never touches an
+                        # arena slot (dropped-and-recorded by the
+                        # validator itself).
+                        if self._validate is not None and not self._validate(
+                            traj, ep
+                        ):
+                            continue
                         parts.append(traj)
                         eps.append(ep)
                 self.split.add("queue_wait_s", time.perf_counter() - t0)
@@ -284,10 +297,13 @@ class LearnerPipeline:
 
     # -- consumer side --------------------------------------------------
 
-    def get(self, timeout: float = 0.5):
+    def get(self, timeout: float = 0.5, stop: Optional[threading.Event] = None):
         """Next ``(batch, eps, handle)``; blocks until one is staged.
         Raises whatever the prefetch thread raised (health-check
-        failures included)."""
+        failures included). With ``stop`` given, returns ``None`` once
+        it is set and nothing is staged — a preemption mid-batch-wait
+        (actors likely killed by the same signal) must not hang the
+        shutdown path forever."""
         t0 = time.perf_counter()
         while True:
             if self._error is not None:
@@ -297,6 +313,8 @@ class LearnerPipeline:
                 self.split.add("stall_s", time.perf_counter() - t0)
                 return item
             except queue_lib.Empty:
+                if stop is not None and stop.is_set():
+                    return None
                 if self._closed.is_set() and self._error is None:
                     raise RuntimeError("pipeline closed while waiting")
 

@@ -312,6 +312,11 @@ class ChaosProxy:
       - ``set_refuse(flag)``       — refuse new connections (learner
         down / restarting).
       - ``set_target(host, port)`` — re-point at a restarted learner.
+      - ``set_corrupt_payload(n)`` — overwrite the middle bytes of the
+        next ``n`` LARGE client→learner chunks with ``0xFF`` (all-ones
+        float32/float64 bit patterns are NaN): garbage *data* that
+        parses as a valid frame — the corruption class wire hardening
+        cannot catch and the trajectory validator must.
     """
 
     def __init__(self, target_host: str, target_port: int,
@@ -321,8 +326,12 @@ class ChaosProxy:
         self._delay = 0.0
         self._refuse = False
         self._truncate_after: int | None = None
+        self._corrupt_chunks = 0
+        self._corrupt_min_bytes = 4096
+        self._corrupt_len = 64
         self._links: List[_Link] = []
         self.connections_total = 0
+        self.corrupted_chunks = 0
         self._stop = threading.Event()
         self._listener = socket.create_server((host, 0))
         self._listener.settimeout(0.1)
@@ -351,6 +360,24 @@ class ChaosProxy:
         """Arm a one-shot mid-stream truncation for the next link."""
         with self._lock:
             self._truncate_after = n_bytes
+
+    def set_corrupt_payload(
+        self, n_chunks: int = 1, *, min_chunk_bytes: int = 4096,
+        n_bytes: int = 64,
+    ) -> None:
+        """Arm payload corruption: the next ``n_chunks`` client→learner
+        chunks of at least ``min_chunk_bytes`` get ``n_bytes``
+        overwritten with ``0xFF`` a quarter of the way in. Large
+        upstream chunks are trajectory payloads and the first (largest)
+        leaf leads the frame, so the damage lands in array data —
+        NaN-valued floats behind an entirely valid frame. (If it ever
+        straddles a header the receiver just sees a clean
+        ``ConnectionError`` and the resilient client re-pushes —
+        either way no poison reaches training unvalidated.)"""
+        with self._lock:
+            self._corrupt_chunks = n_chunks
+            self._corrupt_min_bytes = min_chunk_bytes
+            self._corrupt_len = n_bytes
 
     def reset_all(self) -> int:
         """Hard-reset every live link; returns how many were reset."""
@@ -414,8 +441,24 @@ class ChaosProxy:
                     break
                 with self._lock:
                     delay = self._delay
+                    corrupt = (
+                        upstream
+                        and self._corrupt_chunks > 0
+                        and len(data) >= self._corrupt_min_bytes
+                    )
+                    if corrupt:
+                        self._corrupt_chunks -= 1
+                        self.corrupted_chunks += 1
+                        clen = self._corrupt_len
                 if delay:
                     time.sleep(delay)
+                if corrupt:
+                    # A quarter into the chunk: comfortably past the
+                    # frame/array headers at the front, inside the
+                    # first (largest) payload — for trajectory frames,
+                    # the float observations.
+                    at = len(data) // 4
+                    data = data[:at] + b"\xff" * clen + data[at + clen:]
                 if upstream and link.truncate_after is not None:
                     if len(data) >= link.truncate_after:
                         dst.sendall(data[: link.truncate_after])

@@ -261,6 +261,7 @@ class _Conn:
     frames_in: int = 0
     bytes_in: int = 0
     trajectories: int = 0
+    rejected: int = 0
     send_lock: threading.Lock = dataclasses.field(
         default_factory=threading.Lock
     )
@@ -273,7 +274,11 @@ class LearnerServer:
     ``on_trajectory(traj_leaves, ep_leaves)`` runs on the connection's
     thread — typically a bounded ``TrajectoryQueue.put`` so the queue's
     backpressure and starvation watchdog apply unchanged to remote
-    actors.
+    actors. It may return ``False`` to REJECT the frame (the
+    training-health validator quarantining a poison trajectory): the
+    server still ACKs — an unacked frame would just be re-pushed, and
+    re-pushing poison is pointless — but counts it under
+    ``transport_rejected`` / the per-connection registry.
 
     Fault tolerance: each connection lives in a registry with liveness
     and byte/frame counters (``metrics()``/``connections()``); a peer
@@ -318,6 +323,7 @@ class LearnerServer:
         self._frames_in = 0
         self._bytes_in = 0
         self._trajectories = 0
+        self._rejected = 0
         self._pings = 0
         self._listener = socket.create_server((host, port))
         self._listener.settimeout(0.2)
@@ -350,6 +356,7 @@ class LearnerServer:
                 "transport_frames_in": self._frames_in,
                 "transport_mb_in": round(self._bytes_in / 1e6, 6),
                 "transport_trajectories": self._trajectories,
+                "transport_rejected": self._rejected,
                 "transport_pings": self._pings,
             }
 
@@ -366,6 +373,7 @@ class LearnerServer:
                     "frames_in": c.frames_in,
                     "bytes_in": c.bytes_in,
                     "trajectories": c.trajectories,
+                    "rejected": c.rejected,
                 }
                 for c in self._conns.values()
             ]
@@ -462,7 +470,11 @@ class LearnerServer:
                     elif kind == KIND_PING:
                         self._pings += 1
                 if kind == KIND_TRAJ:
-                    self._on_trajectory(arrays[:tag], arrays[tag:])
+                    ok = self._on_trajectory(arrays[:tag], arrays[tag:])
+                    if ok is False:
+                        with self._reg_lock:
+                            c.rejected += 1
+                            self._rejected += 1
                     self._send(c, KIND_ACK, self._version)
                 elif kind == KIND_GET_PARAMS:
                     with self._params_lock:

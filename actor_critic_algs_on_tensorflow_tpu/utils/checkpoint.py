@@ -72,6 +72,32 @@ class Checkpointer:
     def save(self, step: int, state: Any) -> None:
         self._mgr.save(int(step), args=ocp.args.StandardSave(state))
 
+    def save_interrupted(self, step: int, state: Any) -> bool:
+        """Preemption-path save: one final checkpoint at the
+        interrupted step, blocked until DURABLE (the process is about
+        to exit — an async save left in flight would be the very
+        partial-write the crash-safe restore exists to clean up).
+        Skips the write when ``step`` is already retained (a periodic
+        save just landed on the same id); returns whether a new
+        checkpoint was written. Orbax saves are atomic (tmp dir +
+        finalize), so a second preemption mid-save leaves only a
+        ``*.orbax-checkpoint-tmp`` dropping, never a corrupt step."""
+        step = int(step)
+        latest = self.latest_step()
+        if latest is not None and step <= latest:
+            # A retained checkpoint already covers this id or a newer
+            # one (e.g. a sentinel rollback rewound state.step below
+            # the last periodic save). Orbax silently refuses
+            # non-monotonic step ids, so attempting the save would
+            # no-op while we report success — skip explicitly instead;
+            # the newer retained step is a verified save to resume
+            # from.
+            self.wait()
+            return False
+        self.save(step, state)
+        self.wait()
+        return True
+
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 

@@ -1,0 +1,572 @@
+"""Training-health sentinel: numerics guards, rollback, quarantine.
+
+PR 1 made the actor⇄learner runtime survive *infrastructure* faults
+(dropped sockets, wedged peers, learner restarts); this module makes
+the run survive bad *numerics* the same way. The failure model: one
+NaN gradient step silently poisons the params every actor then rolls
+out from; one corrupt trajectory (a flaky DCN link flipping payload
+bits, a buggy env) can diverge the learner; a TPU-pod preemption
+(SIGTERM) kills the run mid-step with up to ``checkpoint_interval``
+steps of work lost. The same algorithmic fact PR 1 leaned on — V-trace
+rho/c clipping corrects stale/duplicated trajectories — makes
+rollback-and-replay semantically cheap: resuming from a last-good
+snapshot just replays slightly-staler data.
+
+Layers, bottom to top:
+
+  - ``all_finite`` — an IN-GRAPH all-finite reduction over
+    loss/grads/params folded into ``learner_step`` (one fused
+    reduction per step, no host sync per leaf); the host reads the
+    single ``health_finite`` scalar off the step's metrics.
+  - ``DivergenceDetector`` — host-side loss-spike / grad-norm-EWMA
+    tripwires for runs that go bad while staying finite (opt-in via
+    ``loss_spike_factor``/``grad_norm_spike_factor``).
+  - ``SnapshotRing`` + ``TrainingHealthSentinel`` — a small device-side
+    ring of last-good state snapshots; a tripped guard restores the
+    newest good state, re-publishes params to actors, and resumes,
+    budgeted by ``max_rollbacks`` (the rollback analog of
+    ``max_actor_restarts``).
+  - ``TrajectoryValidator`` — pre-arena poison-batch quarantine:
+    incoming trajectories are validated (finite obs/rewards, bounded
+    behaviour log-probs) with per-actor provenance; offenders are
+    dropped-and-recorded (``health_*`` metrics beside
+    ``queue_*``/``transport_*``/``pipeline_*``), and an actor whose
+    trajectories repeatedly fail is quarantined — its pushes stop
+    entering the queue and it is respawned through the existing
+    actor-generation mechanism.
+  - ``ShutdownSignal`` — preemption-safe SIGTERM/SIGINT handling: the
+    first signal sets an event the learner loop polls (final atomic
+    checkpoint + orderly ``KIND_CLOSE`` broadcast + clean exit); a
+    second signal restores the previous handlers so a third kills the
+    process the old-fashioned way.
+"""
+
+from __future__ import annotations
+
+import collections
+import signal
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from actor_critic_algs_on_tensorflow_tpu.utils.metrics import Ewma
+
+__all__ = [
+    "DivergenceDetector",
+    "ShutdownSignal",
+    "SnapshotRing",
+    "TrainingHealthSentinel",
+    "TrajectoryValidator",
+    "all_finite",
+]
+
+
+def all_finite(tree: Any) -> jax.Array:
+    """Scalar ``bool`` array: every inexact leaf of ``tree`` is finite.
+
+    Traceable (use inside jit/shard_map): per-leaf ``isfinite`` reduces
+    on device and one final ``all`` folds the per-leaf bits — XLA fuses
+    the whole thing into the step program, so the guard costs a fused
+    reduction, not a host sync per leaf. Integer/bool leaves are
+    finite by construction and skipped.
+    """
+    bits = [
+        jnp.isfinite(x).all()
+        for x in jax.tree_util.tree_leaves(tree)
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact)
+    ]
+    if not bits:
+        return jnp.asarray(True)
+    return jnp.stack(bits).all()
+
+
+class DivergenceDetector:
+    """Host-side tripwires for finite-but-diverging training.
+
+    Tracks bias-corrected EWMAs of ``|loss|`` and the gradient norm;
+    after ``warmup_checks`` samples, a sample exceeding
+    ``factor * ewma`` trips the guard. A factor of 0 disables that
+    tripwire (the default — the all-finite guard alone). Tripping
+    samples do NOT update the EWMAs, so one spike cannot drag the
+    baseline up and mask the next.
+    """
+
+    def __init__(
+        self,
+        *,
+        loss_spike_factor: float = 0.0,
+        grad_norm_spike_factor: float = 0.0,
+        warmup_checks: int = 20,
+        beta: float = 0.98,
+    ):
+        self.loss_spike_factor = loss_spike_factor
+        self.grad_norm_spike_factor = grad_norm_spike_factor
+        self.warmup_checks = warmup_checks
+        self._loss = Ewma(beta)
+        self._gnorm = Ewma(beta)
+
+    @property
+    def enabled(self) -> bool:
+        return self.loss_spike_factor > 0 or self.grad_norm_spike_factor > 0
+
+    def observe(
+        self, loss: float | None, grad_norm: float | None
+    ) -> Optional[str]:
+        """Fold in one check's scalars; returns a trip reason or None.
+
+        A NON-FINITE sample is the limit case of a spike and trips the
+        armed tripwire immediately — without this, running the
+        host-side detectors alone (``numerics_guards=False``, no
+        ``health_finite`` metric) would sail straight past a NaN loss.
+        """
+        if self.loss_spike_factor > 0 and loss is not None and not (
+            np.isfinite(loss)
+        ):
+            return f"non-finite loss ({loss})"
+        if self.grad_norm_spike_factor > 0 and grad_norm is not None and not (
+            np.isfinite(grad_norm)
+        ):
+            return f"non-finite grad norm ({grad_norm})"
+        reason = None
+        if loss is not None and np.isfinite(loss):
+            a = abs(float(loss))
+            base = self._loss.value
+            if (
+                self.loss_spike_factor > 0
+                and self._loss.n >= self.warmup_checks
+                and base is not None
+                and a > self.loss_spike_factor * max(base, 1e-8)
+            ):
+                reason = (
+                    f"loss spike: |loss|={a:.4g} > "
+                    f"{self.loss_spike_factor:g}x EWMA {base:.4g}"
+                )
+            else:
+                self._loss.update(a)
+        if grad_norm is not None and np.isfinite(grad_norm) and reason is None:
+            g = float(grad_norm)
+            base = self._gnorm.value
+            if (
+                self.grad_norm_spike_factor > 0
+                and self._gnorm.n >= self.warmup_checks
+                and base is not None
+                and g > self.grad_norm_spike_factor * max(base, 1e-8)
+            ):
+                reason = (
+                    f"grad-norm spike: {g:.4g} > "
+                    f"{self.grad_norm_spike_factor:g}x EWMA {base:.4g}"
+                )
+            else:
+                self._gnorm.update(g)
+        return reason
+
+
+class SnapshotRing:
+    """Small ring of last-good ``(tag, state)`` snapshots (device pytrees).
+
+    The sentinel pushes a COPY of the train state each time a guard
+    check passes (so ring entries never alias buffers a donated step
+    will recycle) and rolls back to ``newest()`` when a guard trips.
+    Capacity stays small (2 by default): snapshots cost device memory
+    equal to the full train state.
+    """
+
+    def __init__(self, capacity: int = 2):
+        if capacity < 1:
+            raise ValueError(f"ring needs capacity >= 1, got {capacity}")
+        self._ring: "collections.deque[Tuple[int, Any]]" = collections.deque(
+            maxlen=capacity
+        )
+
+    def push(self, tag: int, state: Any) -> None:
+        self._ring.append((int(tag), state))
+
+    def newest(self) -> Tuple[int, Any]:
+        if not self._ring:
+            raise LookupError("snapshot ring is empty")
+        return self._ring[-1]
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+
+class TrainingHealthSentinel:
+    """Guard → rollback orchestration for the learner loop.
+
+    The loop calls ``after_step(it, state, metrics)`` once per learner
+    iteration. Every ``check_interval`` iterations the sentinel fetches
+    the guard scalars (``health_finite``, ``loss``, ``grad_norm`` — one
+    small transfer) off the step's metrics:
+
+      - check passes → every ``snapshot_interval`` passing checks, a
+        device-side COPY of the state is pushed to the last-good ring;
+      - check trips (non-finite, or a divergence tripwire) → the newest
+        good snapshot is restored (again as a copy, so the ring keeps
+        its own), params are re-published to the actors, and training
+        resumes — counted against ``max_rollbacks``, after which the
+        trip is re-raised as a hard ``RuntimeError``.
+
+    ``copy_state`` must be the jitted full-state copy
+    (``ImpalaPrograms.copy_state``); with buffer donation active the
+    copies are what keep ring entries/restores from aliasing donated
+    buffers. ``exec_lock`` (CPU-mesh mode) serializes the copy
+    dispatches against other executions, same rule as the learner loop.
+    """
+
+    def __init__(
+        self,
+        *,
+        copy_state: Callable[[Any], Any],
+        publish: Callable[[Any], None],
+        max_rollbacks: int = 3,
+        ring_capacity: int = 2,
+        snapshot_interval: int = 20,
+        check_interval: int = 1,
+        detector: DivergenceDetector | None = None,
+        exec_lock: threading.Lock | None = None,
+        log: Callable[[str], None] | None = None,
+    ):
+        self._copy_state = copy_state
+        self._publish = publish
+        self.max_rollbacks = max_rollbacks
+        self.snapshot_interval = max(1, snapshot_interval)
+        self.check_interval = max(1, check_interval)
+        self._detector = detector
+        self._exec_lock = exec_lock
+        self._log = log if log is not None else (
+            lambda msg: print(f"[sentinel] {msg}", flush=True)
+        )
+        self._ring = SnapshotRing(ring_capacity)
+        self.checks = 0
+        self.trips = 0
+        self.rollbacks = 0
+        self.snapshots = 0
+        self._ok_checks = 0
+        self.last_good_step = -1
+
+    def _copy(self, state: Any) -> Any:
+        if self._exec_lock is None:
+            return self._copy_state(state)
+        with self._exec_lock:
+            out = self._copy_state(state)
+            jax.block_until_ready(out)
+            return out
+
+    def seed(self, state: Any, it: int = -1) -> None:
+        """Snapshot the pre-training (or pre-loop resumed) state so a
+        guard tripping before the first periodic snapshot still has a
+        rollback target."""
+        self._ring.push(it, self._copy(state))
+        self.snapshots += 1
+        self.last_good_step = it
+
+    def after_step(self, it: int, state: Any, metrics) -> Any:
+        """Check the guard scalars of the step that just ran; returns
+        the (possibly rolled-back) state to continue from."""
+        if (it + 1) % self.check_interval:
+            return state
+        # With the divergence tripwires off (the default), only the one
+        # guard bit leaves the device.
+        if self._detector is not None and self._detector.enabled:
+            wanted = ("health_finite", "loss", "grad_norm")
+        else:
+            wanted = ("health_finite",)
+        vals = jax.device_get(
+            {k: metrics[k] for k in wanted if k in metrics}
+        )
+        vals = {k: float(v) for k, v in vals.items()}
+        self.checks += 1
+        reason = None
+        if vals.get("health_finite", 1.0) < 0.5:
+            reason = "non-finite loss/grads/params"
+        elif self._detector is not None and self._detector.enabled:
+            reason = self._detector.observe(
+                vals.get("loss"), vals.get("grad_norm")
+            )
+        if reason is None:
+            self._ok_checks += 1
+            if self._ok_checks % self.snapshot_interval == 0:
+                self._ring.push(it, self._copy(state))
+                self.snapshots += 1
+                self.last_good_step = it
+            return state
+        self.trips += 1
+        if self.rollbacks >= self.max_rollbacks:
+            raise RuntimeError(
+                f"training-health guard tripped at iteration {it} "
+                f"({reason}) and the rollback budget "
+                f"({self.max_rollbacks}) is exhausted"
+            )
+        self.rollbacks += 1
+        tag, good = self._ring.newest()
+        state = self._copy(good)
+        self._log(
+            f"guard tripped at iteration {it} ({reason}); rolled back to "
+            f"last-good snapshot from iteration {tag} "
+            f"(rollback {self.rollbacks}/{self.max_rollbacks}); "
+            f"re-publishing params"
+        )
+        self._publish(state.params)
+        return state
+
+    def metrics(self) -> Dict[str, float]:
+        return {
+            "health_checks": self.checks,
+            "health_guard_trips": self.trips,
+            "health_rollbacks": self.rollbacks,
+            "health_snapshots": self.snapshots,
+            "health_last_good_step": self.last_good_step,
+        }
+
+
+class TrajectoryValidator:
+    """Pre-arena poison-batch quarantine with per-actor provenance.
+
+    ``admit(traj, ep)`` returns True to let a trajectory into the
+    queue/arena. A trajectory fails when any float leaf of
+    obs/rewards/last_obs/dones is non-finite or the behaviour
+    log-probs exceed ``logit_bound`` in magnitude. Failures are
+    dropped-and-recorded; ``quarantine_threshold`` CONSECUTIVE failures
+    from one actor (provenance = the ``actor_id`` leaf each rollout
+    carries in its episode-info) quarantine that actor: every further
+    push from it is dropped and it is flagged for respawn through the
+    existing actor-generation mechanism (``take_respawns`` →
+    ``reset_actor`` once the fresh generation is up).
+
+    ``reset_actor`` lifts the quarantine ON PROBATION: provenance is
+    actor id only (not generation), so poison the DEAD generation left
+    in the queue/socket buffers can still drain through validation
+    attributed to the respawned actor. Probation failures are dropped
+    as usual but do not rebuild the quarantine streak; the fresh
+    generation's first CLEAN trajectory (which follows the stale
+    backlog in per-actor FIFO order) ends probation. A persistently
+    poisonous source therefore never respawn-churns the budget — its
+    pushes just keep getting dropped, which ``health_traj_dropped``
+    surfaces.
+
+    Works on numpy leaves (the wire path — where corruption actually
+    enters) without touching the device; device-resident leaves are
+    converted with ``np.asarray``, which is a sync + transfer — that is
+    why in-process validation is opt-in
+    (``ImpalaConfig.validate_device_trajectories``).
+
+    Thread-safe: admission runs on server connection threads or the
+    prefetch thread while ``take_respawns``/``metrics`` run on the
+    learner thread.
+    """
+
+    def __init__(
+        self,
+        *,
+        logit_bound: float = 1e4,
+        quarantine_threshold: int = 3,
+        log: Callable[[str], None] | None = None,
+    ):
+        self.logit_bound = logit_bound
+        self.quarantine_threshold = max(1, quarantine_threshold)
+        self._log = log if log is not None else (
+            lambda msg: print(f"[sentinel] {msg}", flush=True)
+        )
+        self._lock = threading.Lock()
+        self._consecutive: Dict[int, int] = {}
+        self._quarantined: set[int] = set()
+        self._probation: set[int] = set()
+        self._pending_respawn: List[int] = []
+        self.ok = 0
+        self.dropped = 0
+        self.quarantines = 0
+
+    @staticmethod
+    def _actor_id(ep: Any) -> int:
+        if isinstance(ep, dict) and "actor_id" in ep:
+            try:
+                return int(np.asarray(ep["actor_id"]).reshape(-1)[0])
+            except (TypeError, ValueError, IndexError):
+                return -1
+        return -1
+
+    def validate(self, traj: Any) -> Optional[str]:
+        """Reason the trajectory is poison, or None if it is clean."""
+
+        def finite(tree, what) -> Optional[str]:
+            for leaf in jax.tree_util.tree_leaves(tree):
+                a = np.asarray(leaf)
+                if np.issubdtype(a.dtype, np.inexact) and not np.isfinite(
+                    a
+                ).all():
+                    return f"non-finite {what}"
+            return None
+
+        for field in ("obs", "rewards", "dones", "last_obs"):
+            reason = finite(getattr(traj, field, None), field)
+            if reason is not None:
+                return reason
+        lp = getattr(traj, "behaviour_log_probs", None)
+        if lp is not None:
+            a = np.asarray(lp)
+            if not np.isfinite(a).all():
+                return "non-finite behaviour_log_probs"
+            if np.abs(a).max(initial=0.0) > self.logit_bound:
+                return (
+                    f"behaviour_log_probs out of bounds "
+                    f"(|x| > {self.logit_bound:g})"
+                )
+        return None
+
+    def admit(self, traj: Any, ep: Any) -> bool:
+        aid = self._actor_id(ep)
+        with self._lock:
+            if aid in self._quarantined:
+                self.dropped += 1
+                return False
+        reason = self.validate(traj)
+        with self._lock:
+            if reason is None:
+                self.ok += 1
+                self._consecutive[aid] = 0
+                self._probation.discard(aid)
+                return True
+            self.dropped += 1
+            if aid in self._probation:
+                # Stale poison from the actor's DEAD generation draining
+                # out of the queue after a respawn: drop it, but don't
+                # rebuild the streak against the fresh (not yet heard
+                # from) generation.
+                msg = (
+                    f"dropped stale poison trajectory from actor {aid} "
+                    f"(pre-respawn backlog): {reason}"
+                )
+            else:
+                self._consecutive[aid] = self._consecutive.get(aid, 0) + 1
+                msg = (
+                    f"dropped poison trajectory from actor {aid}: {reason}"
+                )
+                if (
+                    self._consecutive[aid] >= self.quarantine_threshold
+                    and aid not in self._quarantined
+                ):
+                    self._quarantined.add(aid)
+                    self._pending_respawn.append(aid)
+                    self.quarantines += 1
+                    msg += (
+                        f"; actor {aid} quarantined after "
+                        f"{self._consecutive[aid]} consecutive failures "
+                        f"(respawn pending)"
+                    )
+        self._log(msg)
+        return False
+
+    def take_respawns(self) -> List[int]:
+        """Actors newly quarantined since the last call — the learner's
+        health check consumes this and respawns each through the
+        existing generation mechanism."""
+        with self._lock:
+            out, self._pending_respawn = self._pending_respawn, []
+            return out
+
+    def reset_actor(self, actor_id: int) -> None:
+        """A fresh generation of ``actor_id`` is up: lift the quarantine
+        ON PROBATION — stale poison the dead generation left behind is
+        still dropped but cannot re-quarantine (and re-respawn) the new
+        one; its first clean trajectory ends the probation."""
+        with self._lock:
+            self._quarantined.discard(actor_id)
+            self._consecutive[actor_id] = 0
+            self._probation.add(actor_id)
+
+    def metrics(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "health_traj_ok": self.ok,
+                "health_traj_dropped": self.dropped,
+                "health_quarantines": self.quarantines,
+                "health_quarantined_actors": len(self._quarantined),
+            }
+
+
+class ShutdownSignal:
+    """Preemption-safe SIGTERM/SIGINT → ``threading.Event``.
+
+    ``install()`` swaps in a handler that sets ``event`` on the first
+    signal (the learner loop polls it, saves one final atomic
+    checkpoint, broadcasts ``KIND_CLOSE``, and exits cleanly); a second
+    signal arriving more than ``force_after_s`` later restores the
+    PREVIOUS handlers and re-delivers itself, so a stuck teardown can
+    still be killed with exactly two signals. The debounce window
+    exists because group-signaling wrappers (``timeout``, some pod
+    supervisors) deliver the SAME preemption as near-simultaneous
+    duplicate signals — the kernel coalesces them only sometimes —
+    and an instant escalation would randomly kill the graceful save.
+    Installation is a no-op off the main thread (signal API
+    restriction) — the event remains usable either way. Use as a
+    context manager to guarantee the previous handlers come back.
+    """
+
+    def __init__(
+        self,
+        signals: Tuple[int, ...] = (signal.SIGTERM, signal.SIGINT),
+        *,
+        force_after_s: float = 1.0,
+    ):
+        self.signals = signals
+        self.force_after_s = force_after_s
+        self.event = threading.Event()
+        self._prev: Dict[int, Any] = {}
+        self._first_t: float | None = None
+        self.installed = False
+
+    def _handle(self, signum, frame) -> None:
+        if self.event.is_set():
+            if (
+                self._first_t is not None
+                and time.monotonic() - self._first_t < self.force_after_s
+            ):
+                # Duplicate delivery of the SAME preemption (a wrapper
+                # signaled both the process and its group): not an
+                # escalation request.
+                return
+            # A genuinely later second signal: the operator (or the
+            # supervisor's escalation sequence) means it — restore the
+            # previous handlers and re-deliver so the old behavior
+            # applies immediately, not on some third signal.
+            self.uninstall()
+            signal.raise_signal(signum)
+            return
+        self._first_t = time.monotonic()
+        self.event.set()
+        print(
+            f"[train] received {signal.Signals(signum).name}: finishing "
+            f"the current step, saving a final checkpoint, and shutting "
+            f"down cleanly (signal again to force)",
+            flush=True,
+        )
+
+    def install(self) -> "ShutdownSignal":
+        try:
+            for s in self.signals:
+                self._prev[s] = signal.signal(s, self._handle)
+            self.installed = True
+        except ValueError:
+            # Not the main thread: handlers cannot be installed; the
+            # event can still be set programmatically.
+            self._prev.clear()
+        return self
+
+    def uninstall(self) -> None:
+        for s, prev in self._prev.items():
+            try:
+                signal.signal(s, prev)
+            except (ValueError, OSError, TypeError):
+                pass
+        self._prev.clear()
+        self.installed = False
+
+    def __enter__(self) -> "ShutdownSignal":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()

@@ -58,6 +58,34 @@ class TimeSplit:
             return out
 
 
+class Ewma:
+    """Bias-corrected exponential moving average (host-side scalar).
+
+    The training-health sentinel's divergence detectors track the loss
+    and gradient-norm trend with this: ``update(x)`` folds in a sample
+    and returns the corrected mean, ``value`` reads it without
+    updating (``None`` until the first sample).
+    """
+
+    def __init__(self, beta: float = 0.98):
+        if not 0.0 < beta < 1.0:
+            raise ValueError(f"beta must be in (0, 1), got {beta}")
+        self.beta = beta
+        self._acc = 0.0
+        self.n = 0
+
+    def update(self, x: float) -> float:
+        self._acc = self.beta * self._acc + (1.0 - self.beta) * float(x)
+        self.n += 1
+        return self.value
+
+    @property
+    def value(self) -> float | None:
+        if self.n == 0:
+            return None
+        return self._acc / (1.0 - self.beta**self.n)
+
+
 def device_get_metrics(metrics: Mapping[str, jax.Array]) -> Dict[str, float]:
     """One host transfer for the whole metric dict."""
     flat = jax.device_get(dict(metrics))

@@ -10,8 +10,12 @@ is off, as is standard for Atari PPO (and it would double the rollout
 obs buffer).
 
 Baseline: the driver target is >= 1M env-steps/sec on a TPU v4-32
-(BASELINE.json:5), i.e. 31,250 env-steps/sec/chip; ``vs_baseline`` is
-measured steps/sec/chip over that per-chip target.
+(BASELINE.json:5), i.e. 31,250 env-steps/sec/chip. ``vs_baseline`` is
+the MEDIAN-of-N-windows steps/sec/chip over that per-chip target
+(median compares cleanly against the pre-r5 single-window history;
+best-of-N — reported as ``value`` and ``vs_baseline_best`` — measures
+the machine's capability but biased the headline upward vs prior
+rounds).
 
 Robustness: the driver runs this unattended. A config that exceeds HBM
 fails at RUNTIME on the single-chip axon backend and wedges the whole
@@ -272,7 +276,13 @@ def main() -> int:
         "median": round(med, 1),
         "spread": round(spread, 4),
         "unit": "env-steps/sec/chip",
-        "vs_baseline": round(best / PER_CHIP_TARGET, 3),
+        # Headline ratio uses the MEDIAN window: pre-r5 rounds measured
+        # a single timed window (~a median draw), so best-of-N would
+        # bias the headline upward vs that history. Best-of-N remains
+        # available as vs_baseline_best (the machine's capability).
+        # Discipline recorded in BASELINE.json "bench_discipline".
+        "vs_baseline": round(med / PER_CHIP_TARGET, 3),
+        "vs_baseline_best": round(best / PER_CHIP_TARGET, 3),
     }
     if os.environ.get("BENCH_IMPALA"):
         try:

@@ -23,3 +23,49 @@ _spec.loader.exec_module(audit_artifacts)
 def test_artifact_ledger_consistent():
     problems = audit_artifacts.audit()
     assert not problems, "\n".join(problems)
+
+
+def _mini_repo(tmp_path, perf_text):
+    (tmp_path / "runs").mkdir()
+    (tmp_path / "PERF.md").write_text(perf_text)
+    return tmp_path
+
+
+def test_rule1_strips_trailing_sentence_period(tmp_path):
+    """``runs/foo.`` ending a sentence is the artifact ``foo``, not a
+    dotted filename to skip — and it must resolve or be marked."""
+    repo = _mini_repo(tmp_path, "The checkpoint lives in runs/foo.\n")
+    problems = audit_artifacts.audit(repo)
+    assert problems and "`runs/foo`" in problems[0]
+    (repo / "runs" / "foo").mkdir()
+    assert not audit_artifacts.audit(repo)
+
+
+def test_rule2_footnote_window_carries_cycled_marker(tmp_path):
+    """A row marked only with ``*`` whose legend below the table says
+    cycled is consistent; an unmarked missing row still fails."""
+    repo = _mini_repo(
+        tmp_path,
+        "| artifact | eval |\n"
+        "|---|---|\n"
+        "| gone-run* | 9,001 |\n"
+        "| other-gone | 1 |\n"
+        "\n"
+        "*cycled = checkpoint dir no longer on disk.\n",
+    )
+    problems = audit_artifacts.audit(repo)
+    assert len(problems) == 1 and "`other-gone`" in problems[0]
+
+
+def test_rule3_only_flags_stale_interrupted_saves(tmp_path):
+    """A young *.orbax-checkpoint-tmp is a healthy in-flight async
+    save; only one older than the mtime threshold fails the audit."""
+    repo = _mini_repo(tmp_path, "")
+    tmp = repo / "runs" / "ck" / "5.orbax-checkpoint-tmp"
+    tmp.mkdir(parents=True)
+    now = tmp.stat().st_mtime
+    assert not audit_artifacts.audit(repo, now=now + 30)
+    stale = audit_artifacts.audit(
+        repo, now=now + audit_artifacts.TMP_STALE_AFTER_S + 1
+    )
+    assert stale and "stale interrupted save" in stale[0]

@@ -42,6 +42,23 @@ def test_make_config_flicker_preset():
     assert cfg.lr_decay is True
 
 
+def test_preempt_save_flag_and_sentinel_overrides():
+    """--preempt-save defaults on (pod preemptions are the steady
+    state), --no-preempt-save opts out; sentinel knobs ride --set."""
+    args = cli.build_parser().parse_args(["--preset", "impala-cartpole"])
+    assert args.preempt_save is True
+    args = cli.build_parser().parse_args(
+        ["--preset", "impala-cartpole", "--no-preempt-save",
+         "--set", "max_rollbacks=5", "--set", "numerics_guards=false",
+         "--set", "quarantine_threshold=2"]
+    )
+    assert args.preempt_save is False
+    _, cfg = cli.make_config(args)
+    assert cfg.max_rollbacks == 5
+    assert cfg.numerics_guards is False
+    assert cfg.quarantine_threshold == 2
+
+
 def test_unknown_override_rejected():
     args = cli.build_parser().parse_args(
         ["--algo", "a2c", "--set", "nope=1"]

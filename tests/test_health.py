@@ -1,0 +1,485 @@
+"""Training-health sentinel: in-graph numerics guards, rollback to the
+last-good snapshot, poison-batch quarantine with per-actor provenance,
+and preemption-safe shutdown (ISSUE 3).
+
+The e2e tests drive the REAL run_impala loop with the fault-injection
+hooks (``inject_nan_at`` poisons one batch; ``inject_poison_at`` makes
+an actor emit NaN trajectories) and assert the run self-heals: rollback
+/ quarantine metrics increment, training continues, final params are
+finite.
+"""
+
+import os
+import signal
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from actor_critic_algs_on_tensorflow_tpu.algos import impala
+from actor_critic_algs_on_tensorflow_tpu.utils import health
+from actor_critic_algs_on_tensorflow_tpu.utils.metrics import Ewma
+from tests.helpers import time_limit
+
+
+def _cfg(**kw):
+    base = dict(
+        env="CartPole-v1",
+        num_actors=2,
+        envs_per_actor=4,
+        rollout_length=8,
+        batch_trajectories=2,
+        queue_size=4,
+        total_env_steps=2 * 4 * 8 * 5,  # 5 learner steps
+        num_devices=1,
+    )
+    base.update(kw)
+    return impala.ImpalaConfig(**base)
+
+
+def _params_finite(state) -> bool:
+    return all(
+        np.isfinite(x).all()
+        for x in jax.tree_util.tree_leaves(jax.device_get(state.params))
+    )
+
+
+# ---------------------------------------------------------------------
+# In-graph guard + host-side detector units.
+# ---------------------------------------------------------------------
+
+def test_all_finite_detects_nan_and_inf():
+    clean = {"a": jnp.ones((3,)), "b": (jnp.zeros((2, 2)), jnp.arange(4))}
+    assert bool(health.all_finite(clean))
+    assert not bool(health.all_finite({"x": jnp.array([1.0, jnp.nan])}))
+    assert not bool(health.all_finite({"x": jnp.array([jnp.inf])}))
+    # Integer leaves are finite by construction; empty trees pass.
+    assert bool(health.all_finite({"i": jnp.arange(3)}))
+    assert bool(health.all_finite({}))
+
+
+def test_all_finite_is_jittable():
+    f = jax.jit(lambda t: health.all_finite(t))
+    assert bool(f({"a": jnp.ones((4,))}))
+    assert not bool(f({"a": jnp.array([jnp.nan])}))
+
+
+def test_ewma_bias_correction():
+    e = Ewma(beta=0.9)
+    assert e.value is None
+    assert e.update(10.0) == pytest.approx(10.0)  # corrected first sample
+    for _ in range(200):
+        e.update(10.0)
+    assert e.value == pytest.approx(10.0)
+
+
+def test_divergence_detector_loss_spike_trips_after_warmup():
+    det = health.DivergenceDetector(
+        loss_spike_factor=10.0, warmup_checks=5
+    )
+    for _ in range(10):
+        assert det.observe(1.0, None) is None
+    reason = det.observe(100.0, None)
+    assert reason is not None and "loss spike" in reason
+    # The spike did NOT drag the EWMA up: a normal sample still passes.
+    assert det.observe(1.0, None) is None
+
+
+def test_divergence_detector_grad_norm_and_disabled_by_default():
+    det = health.DivergenceDetector()  # factors 0 = disabled
+    assert not det.enabled
+    assert det.observe(1e9, 1e9) is None
+    det = health.DivergenceDetector(
+        grad_norm_spike_factor=5.0, warmup_checks=3
+    )
+    for _ in range(5):
+        assert det.observe(None, 2.0) is None
+    assert "grad-norm spike" in det.observe(None, 1000.0)
+
+
+def test_divergence_detector_trips_on_nonfinite_sample():
+    """Host-side tripwires alone (numerics_guards off) must treat a
+    NaN sample as the limit case of a spike, not skip it."""
+    det = health.DivergenceDetector(loss_spike_factor=10.0, warmup_checks=5)
+    assert "non-finite loss" in det.observe(float("nan"), None)
+    det = health.DivergenceDetector(
+        grad_norm_spike_factor=5.0, warmup_checks=5
+    )
+    assert "non-finite grad norm" in det.observe(None, float("inf"))
+    # Disarmed detectors still ignore non-finite inputs (the in-graph
+    # guard owns that case).
+    assert health.DivergenceDetector().observe(float("nan"), None) is None
+
+
+def test_pipeline_get_returns_none_on_stop_when_starved():
+    """Preemption while the pipeline waits for actors that died of the
+    same signal: get(stop=...) must return None, not hang."""
+    from actor_critic_algs_on_tensorflow_tpu.data.pipeline import (
+        LearnerPipeline,
+    )
+
+    stop = threading.Event()
+    pipe = LearnerPipeline(
+        poll=lambda n: (time.sleep(0.01), ())[1],  # starved forever
+        batch_parts=1,
+        assemble_device=lambda parts: parts[0],
+    )
+    try:
+        stop.set()
+        with time_limit(10, "stop-aware pipeline get"):
+            assert pipe.get(timeout=0.05, stop=stop) is None
+    finally:
+        pipe.close()
+
+
+def test_snapshot_ring_capacity_and_newest():
+    ring = health.SnapshotRing(capacity=2)
+    with pytest.raises(LookupError):
+        ring.newest()
+    ring.push(1, "s1")
+    ring.push(2, "s2")
+    ring.push(3, "s3")  # evicts s1
+    assert len(ring) == 2
+    assert ring.newest() == (3, "s3")
+
+
+# ---------------------------------------------------------------------
+# Guards do not change the training numerics.
+# ---------------------------------------------------------------------
+
+def test_guarded_step_params_bit_identical_to_unguarded():
+    """numerics_guards adds metrics only: the updated params must be
+    bit-identical with guards on vs off for the same state/batch."""
+    cfg_on = _cfg(numerics_guards=True)
+    cfg_off = _cfg(numerics_guards=False)
+    prog_on = impala.make_impala(cfg_on)
+    prog_off = impala.make_impala(cfg_off)
+    state = prog_on.init(jax.random.PRNGKey(0))
+    rollout, env_reset = prog_on.make_actor_programs(0)
+    env_state, obs, carry = env_reset(jax.random.PRNGKey(1))
+    trajs = []
+    for i in range(cfg_on.batch_trajectories):
+        env_state, obs, carry, traj, _ = rollout(
+            state.params, env_state, obs, carry, jax.random.PRNGKey(i)
+        )
+        trajs.append(traj)
+    batch = impala.stack_trajectories(trajs)
+    s_on, m_on = prog_on.learner_step(state, batch)
+    s_off, m_off = prog_off.learner_step(
+        prog_off.init(jax.random.PRNGKey(0)), batch
+    )
+    assert "health_finite" in m_on and "grad_norm" in m_on
+    assert "health_finite" not in m_off
+    assert float(m_on["health_finite"]) == 1.0
+    for a, b in zip(
+        jax.tree_util.tree_leaves(jax.device_get(s_on.params)),
+        jax.tree_util.tree_leaves(jax.device_get(s_off.params)),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------
+# Rollback e2e: injected NaN gradient recovers automatically.
+# ---------------------------------------------------------------------
+
+def test_run_impala_recovers_from_injected_nan_gradient():
+    """A NaN-poisoned batch trips the in-graph guard; the sentinel
+    rolls back to the last-good snapshot, re-publishes, and training
+    runs to the end of the budget with finite params."""
+    cfg = _cfg(snapshot_interval=1)
+    logs = []
+    state, history = impala.run_impala(
+        cfg, log_interval=1,
+        log_fn=lambda s, m: logs.append(m),
+        inject_nan_at=2,
+    )
+    final = logs[-1]
+    assert final["health_guard_trips"] == 1
+    assert final["health_rollbacks"] == 1
+    assert final["health_snapshots"] >= 2
+    # The rollback rewound the step counter by at least the lost step,
+    # but training CONTINUED afterwards.
+    assert int(state.step) >= 3
+    assert _params_finite(state)
+    # The post-rollback losses are finite again.
+    assert np.isfinite(final["loss"]), final
+
+
+def test_run_impala_rollback_budget_exhaustion_raises():
+    """The sole actor emits NaN rewards from the start and nothing
+    validates them away (validate_device_trajectories off): every
+    batch trips the guard, rollback can't outrun the poison, and the
+    budget surfaces as a hard error (the analog of max_actor_restarts
+    exhaustion)."""
+    cfg = _cfg(
+        num_actors=1,
+        batch_trajectories=1,
+        queue_size=2,
+        total_env_steps=1 * 4 * 8 * 8,
+        max_rollbacks=1,
+        snapshot_interval=1,
+    )
+    with pytest.raises(RuntimeError, match="rollback budget"):
+        impala.run_impala(
+            cfg, log_interval=10**9, log_fn=lambda s, m: None,
+            inject_poison_at=0,
+        )
+
+
+def test_sentinel_unit_rollback_and_publish():
+    """Sentinel unit semantics without a run: trip -> state restored
+    from the ring COPY, params re-published, counters advance."""
+    published = []
+    copies = lambda s: jax.tree_util.tree_map(jnp.copy, s)
+
+    class S:  # minimal state pytree stand-in
+        def __init__(self, v):
+            self.params = {"w": jnp.full((2,), v)}
+
+    sent = health.TrainingHealthSentinel(
+        copy_state=lambda s: S(float(s.params["w"][0])),
+        publish=lambda p: published.append(float(p["w"][0])),
+        max_rollbacks=2,
+        snapshot_interval=1,
+        log=lambda m: None,
+    )
+    sent.seed(S(1.0), -1)
+    good = {"health_finite": jnp.array(1.0), "loss": jnp.array(0.5)}
+    bad = {"health_finite": jnp.array(0.0), "loss": jnp.array(jnp.nan)}
+    s = sent.after_step(0, S(2.0), good)
+    assert float(s.params["w"][0]) == 2.0 and sent.snapshots == 2
+    s = sent.after_step(1, S(jnp.nan), bad)
+    assert float(s.params["w"][0]) == 2.0  # restored the newest good
+    assert sent.rollbacks == 1 and published == [2.0]
+    s = sent.after_step(2, S(jnp.nan), bad)
+    assert sent.rollbacks == 2
+    with pytest.raises(RuntimeError, match="rollback budget"):
+        sent.after_step(3, S(jnp.nan), bad)
+
+
+# ---------------------------------------------------------------------
+# Poison-batch quarantine with per-actor provenance.
+# ---------------------------------------------------------------------
+
+def _np_traj(T=4, B=2, obs_nan=False, lp_big=False, rew_nan=False):
+    obs = np.zeros((T, B, 4), np.float32)
+    if obs_nan:
+        obs[1, 0, 2] = np.nan
+    lp = -np.ones((T, B), np.float32)
+    if lp_big:
+        lp[0, 0] = -1e9
+    rew = np.ones((T, B), np.float32)
+    if rew_nan:
+        rew[2, 1] = np.nan
+    return impala.ActorTrajectory(
+        obs=obs,
+        actions=np.zeros((T, B), np.int32),
+        rewards=rew,
+        dones=np.zeros((T, B), np.float32),
+        behaviour_log_probs=lp,
+        last_obs=np.zeros((B, 4), np.float32),
+    )
+
+
+def _ep(aid):
+    return {
+        "actor_id": np.asarray(aid, np.int32),
+        "episode_return": np.zeros(2, np.float32),
+        "done_episode": np.zeros(2, np.float32),
+    }
+
+
+def test_validator_accepts_clean_and_drops_poison():
+    v = health.TrajectoryValidator(quarantine_threshold=10, log=lambda m: None)
+    assert v.admit(_np_traj(), _ep(0))
+    assert not v.admit(_np_traj(obs_nan=True), _ep(0))
+    assert not v.admit(_np_traj(rew_nan=True), _ep(0))
+    assert not v.admit(_np_traj(lp_big=True), _ep(0))
+    m = v.metrics()
+    assert m["health_traj_ok"] == 1
+    assert m["health_traj_dropped"] == 3
+    assert m["health_quarantines"] == 0
+
+
+def test_validator_quarantines_after_consecutive_failures():
+    v = health.TrajectoryValidator(quarantine_threshold=2, log=lambda m: None)
+    assert not v.admit(_np_traj(obs_nan=True), _ep(3))
+    # A clean trajectory in between resets the streak.
+    assert v.admit(_np_traj(), _ep(3))
+    assert not v.admit(_np_traj(obs_nan=True), _ep(3))
+    assert v.metrics()["health_quarantines"] == 0
+    assert not v.admit(_np_traj(obs_nan=True), _ep(3))
+    assert v.metrics()["health_quarantines"] == 1
+    assert v.take_respawns() == [3]
+    assert v.take_respawns() == []  # consumed
+    # Quarantined: even CLEAN pushes are dropped until the respawn.
+    assert not v.admit(_np_traj(), _ep(3))
+    # Another actor is unaffected.
+    assert v.admit(_np_traj(), _ep(1))
+    v.reset_actor(3)
+    assert v.admit(_np_traj(), _ep(3))
+    assert v.metrics()["health_quarantined_actors"] == 0
+
+
+def test_validator_probation_ignores_stale_poison_after_respawn():
+    """Poison the dead generation left in the queue must not
+    re-quarantine (and re-respawn) the fresh actor; its first clean
+    trajectory ends the probation."""
+    v = health.TrajectoryValidator(quarantine_threshold=2, log=lambda m: None)
+    assert not v.admit(_np_traj(obs_nan=True), _ep(0))
+    assert not v.admit(_np_traj(obs_nan=True), _ep(0))
+    assert v.take_respawns() == [0]
+    v.reset_actor(0)
+    # Stale backlog drains: dropped, but no new quarantine.
+    for _ in range(5):
+        assert not v.admit(_np_traj(obs_nan=True), _ep(0))
+    assert v.metrics()["health_quarantines"] == 1
+    assert v.take_respawns() == []
+    # First clean trajectory ends probation; fresh poison counts again.
+    assert v.admit(_np_traj(), _ep(0))
+    assert not v.admit(_np_traj(obs_nan=True), _ep(0))
+    assert not v.admit(_np_traj(obs_nan=True), _ep(0))
+    assert v.metrics()["health_quarantines"] == 2
+
+
+def test_shutdown_signal_second_signal_escalates_to_previous_handler():
+    """A second signal AFTER the debounce window restores the previous
+    handlers and RE-DELIVERS itself, so 'signal twice to force' holds
+    (a wedged teardown doesn't need a third signal)."""
+    hits = []
+    prev = signal.signal(signal.SIGUSR1, lambda s, f: hits.append(s))
+    try:
+        s = health.ShutdownSignal(signals=(signal.SIGUSR1,), force_after_s=0.0)
+        s.install()
+        os.kill(os.getpid(), signal.SIGUSR1)
+        assert s.event.is_set() and hits == []
+        time.sleep(0.01)  # past the (zero) debounce window
+        os.kill(os.getpid(), signal.SIGUSR1)
+        assert hits == [signal.SIGUSR1]  # old handler got the 2nd signal
+        assert not s.installed
+    finally:
+        signal.signal(signal.SIGUSR1, prev)
+
+
+def test_shutdown_signal_debounces_duplicate_group_delivery():
+    """Group-signaling wrappers (timeout, pod supervisors) deliver the
+    SAME preemption twice nearly simultaneously; within the debounce
+    window the duplicate must NOT escalate past the graceful save."""
+    hits = []
+    prev = signal.signal(signal.SIGUSR1, lambda s, f: hits.append(s))
+    try:
+        s = health.ShutdownSignal(signals=(signal.SIGUSR1,), force_after_s=5.0)
+        s.install()
+        os.kill(os.getpid(), signal.SIGUSR1)
+        os.kill(os.getpid(), signal.SIGUSR1)  # duplicate, same event
+        assert s.event.is_set()
+        assert hits == []           # never escalated
+        assert s.installed          # handlers still ours
+    finally:
+        s.uninstall()
+        signal.signal(signal.SIGUSR1, prev)
+
+
+def test_run_impala_quarantines_poison_actor_and_recovers():
+    """E2E: actor 0 starts emitting NaN trajectories mid-run; the
+    validator drops them pre-arena, quarantines the actor after the
+    threshold, and the restart path respawns a clean generation —
+    training completes with finite params and zero guard trips."""
+    with time_limit(120, "quarantine e2e"):
+        cfg = _cfg(
+            total_env_steps=2 * 4 * 8 * 8,
+            queue_size=2,
+            validate_device_trajectories=True,
+            quarantine_threshold=2,
+            max_actor_restarts=2,
+        )
+        logs = []
+        state, history = impala.run_impala(
+            cfg, log_interval=1,
+            log_fn=lambda s, m: logs.append(m),
+            inject_poison_at=0,  # poisoned from its first rollout
+        )
+        final = logs[-1]
+        assert final["health_traj_dropped"] >= 2
+        assert final["health_quarantines"] == 1
+        assert final["actor_restarts"] >= 1
+        # Poison never reached the learner: no guard trips, no NaNs.
+        assert final["health_guard_trips"] == 0
+        assert int(state.step) == 8
+        assert _params_finite(state)
+
+
+# ---------------------------------------------------------------------
+# Preemption-safe shutdown.
+# ---------------------------------------------------------------------
+
+def test_shutdown_signal_sets_event_and_restores_handlers():
+    prev_term = signal.getsignal(signal.SIGTERM)
+    s = health.ShutdownSignal(signals=(signal.SIGTERM,))
+    with s:
+        assert s.installed
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 5.0
+        while not s.event.is_set() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert s.event.is_set()
+    assert signal.getsignal(signal.SIGTERM) is prev_term
+
+
+def test_sigterm_checkpoints_at_interrupted_step_and_resumes(tmp_path):
+    """The acceptance scenario: a REAL SIGTERM mid-training produces a
+    restorable checkpoint at the interrupted step and a clean return;
+    restarting from it trains exactly the remaining budget."""
+    from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
+        Checkpointer,
+    )
+
+    with time_limit(180, "sigterm checkpoint/resume"):
+        n_total = 12
+        cfg = _cfg(total_env_steps=2 * 4 * 8 * n_total)
+        steps_per_batch = (
+            cfg.batch_trajectories * cfg.envs_per_actor * cfg.rollout_length
+        )
+        shutdown = health.ShutdownSignal(signals=(signal.SIGTERM,))
+        fired = []
+
+        def log_fn(s, m):
+            # After two logged steps, deliver a real SIGTERM from a side
+            # thread (the handler runs on the main thread; run_impala is
+            # blocking it, exactly like a pod preemption mid-run).
+            if len(fired) == 0 and s >= 2 * steps_per_batch:
+                fired.append(s)
+                threading.Thread(
+                    target=lambda: os.kill(os.getpid(), signal.SIGTERM),
+                    daemon=True,
+                ).start()
+
+        ckpt = Checkpointer(tmp_path / "ck", async_save=False)
+        with shutdown:
+            state, _ = impala.run_impala(
+                cfg, log_interval=1, log_fn=log_fn,
+                checkpointer=ckpt, checkpoint_interval=10**9,
+                stop_event=shutdown.event,
+            )
+        assert shutdown.event.is_set()
+        done = int(state.step)
+        assert 2 <= done < n_total, done
+        # The final atomic checkpoint is AT the interrupted step.
+        assert ckpt.latest_step() == done * steps_per_batch
+        restored = ckpt.restore(
+            jax.eval_shape(
+                impala.make_impala(cfg).init, jax.random.PRNGKey(cfg.seed)
+            )
+        )
+        ckpt.close()
+        assert int(restored.step) == done
+        # Restart-and-resume: the resumed run trains only the remainder.
+        state2, _ = impala.run_impala(
+            cfg, log_interval=10**9, log_fn=lambda s, m: None,
+            initial_state=restored,
+        )
+        assert int(state2.step) == n_total
+        assert _params_finite(state2)

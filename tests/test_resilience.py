@@ -459,6 +459,130 @@ def test_chaos_end_to_end_delivery():
         q.close()
 
 
+@pytest.mark.chaos
+def test_chaos_corrupt_payload_quarantine_and_rollback():
+    """ISSUE 3 acceptance: garbage *data* on the wire (ChaosProxy
+    ``corrupt_payload`` — bytes that parse as a valid frame but decode
+    to NaN floats, the class wire hardening cannot catch) is dropped by
+    the pre-arena validator (quarantine: ``health_traj_dropped`` +
+    ``transport_rejected`` increment); and a poison batch that reaches
+    the learner anyway trips the in-graph guard, the sentinel rolls
+    back to the last-good snapshot, and the final params are finite."""
+    import jax
+    import jax.numpy as jnp
+
+    from actor_critic_algs_on_tensorflow_tpu.algos import impala
+    from actor_critic_algs_on_tensorflow_tpu.utils import health
+
+    with time_limit(120, "corrupt-payload quarantine"):
+        T, B = 64, 16
+        clean = impala.ActorTrajectory(
+            obs=np.zeros((T, B, 4), np.float32),        # 16 KiB payload
+            actions=np.zeros((T, B), np.int32),
+            rewards=np.ones((T, B), np.float32),
+            dones=np.zeros((T, B), np.float32),
+            behaviour_log_probs=-np.ones((T, B), np.float32),
+            last_obs=np.zeros((B, 4), np.float32),
+        )
+        traj_leaves, traj_def = jax.tree_util.tree_flatten(clean)
+        ep = {
+            "actor_id": np.asarray(0, np.int32),
+            "episode_return": np.zeros(B, np.float32),
+            "done_episode": np.zeros(B, np.float32),
+        }
+        ep_leaves, ep_def = jax.tree_util.tree_flatten(ep)
+
+        validator = health.TrajectoryValidator(
+            quarantine_threshold=3, log=lambda m: None
+        )
+        received = []
+
+        def on_trajectory(tl, el):
+            item = (
+                jax.tree_util.tree_unflatten(traj_def, tl),
+                jax.tree_util.tree_unflatten(ep_def, el),
+            )
+            if not validator.admit(*item):
+                return False
+            received.append(item[0])
+            return True
+
+        server = LearnerServer(on_trajectory, log=lambda m: None)
+        proxy = ChaosProxy("127.0.0.1", server.port)
+        try:
+            client = ResilientActorClient(
+                "127.0.0.1", proxy.port,
+                retry=_mk_policy(),
+                heartbeat_interval_s=0.1, idle_timeout_s=2.0,
+            )
+            # Clean push delivers.
+            client.push_trajectory(traj_leaves, ep_leaves)
+            assert validator.metrics()["health_traj_ok"] == 1
+
+            # Corrupted pushes: each armed chunk either lands in the
+            # float payload (validator drops NaN obs — the common case
+            # with a 16 KiB obs leaf) or clips a header (clean
+            # ConnectionError -> reconnect + re-push). Push until the
+            # validator has dropped one AND quarantined the actor.
+            for _ in range(30):
+                proxy.set_corrupt_payload(1)
+                client.push_trajectory(traj_leaves, ep_leaves)
+                if validator.metrics()["health_quarantines"] >= 1:
+                    break
+            m = validator.metrics()
+            assert m["health_traj_dropped"] >= 1, m
+            assert m["health_quarantines"] == 1, m
+            assert proxy.corrupted_chunks >= 1
+            assert server.metrics()["transport_rejected"] >= 1
+            assert validator.take_respawns() == [0]
+            # Everything that DID reach the queue side is clean.
+            for traj in received:
+                for leaf in jax.tree_util.tree_leaves(traj):
+                    assert np.isfinite(leaf).all()
+            client.close()
+        finally:
+            proxy.close()
+            server.close()
+
+        # Defense in depth: a poison batch reaching the learner anyway
+        # trips the in-graph guard and the sentinel rolls back.
+        cfg = impala.ImpalaConfig(
+            env="CartPole-v1", num_actors=1, envs_per_actor=B,
+            rollout_length=T, batch_trajectories=1,
+            total_env_steps=T * B, num_devices=1,
+        )
+        programs = impala.make_impala(cfg)
+        state = programs.init(jax.random.PRNGKey(0))
+        published = []
+        sentinel = health.TrainingHealthSentinel(
+            copy_state=programs.copy_state,
+            publish=published.append,
+            snapshot_interval=1,
+            log=lambda msg: None,
+        )
+        sentinel.seed(state, -1)
+        batch = impala.stack_trajectories(
+            [jax.tree_util.tree_map(jnp.asarray, clean)]
+        )
+        state, metrics = programs.learner_step(state, batch)
+        state = sentinel.after_step(0, state, metrics)
+        assert sentinel.rollbacks == 0
+        good = np.asarray(
+            jax.tree_util.tree_leaves(jax.device_get(state.params))[0]
+        ).copy()
+        poison = batch.replace(
+            rewards=jnp.full_like(batch.rewards, jnp.nan)
+        )
+        state, metrics = programs.learner_step(state, poison)
+        state = sentinel.after_step(1, state, metrics)
+        assert sentinel.rollbacks == 1 and published, (
+            "sentinel did not roll back on the poisoned batch"
+        )
+        restored = jax.tree_util.tree_leaves(jax.device_get(state.params))
+        assert all(np.isfinite(x).all() for x in restored)
+        np.testing.assert_array_equal(np.asarray(restored[0]), good)
+
+
 def test_chaos_proxy_truncate_mid_frame():
     """A frame cut mid-payload surfaces as a clean ConnectionError on
     the server (wire hardening), and the resilient client re-pushes."""
