@@ -75,6 +75,11 @@ class A2CConfig:
     time_limit_bootstrap: bool = True
     compute_dtype: str = "float32"  # "bfloat16" runs torsos on the MXU in bf16
     use_pallas_scan: bool = False   # fused Pallas VMEM kernel for GAE
+    # In-graph all-finite guard over loss/grads/params folded into the
+    # iteration (one fused reduction, surfaced as ``health_finite``) —
+    # the same guard the IMPALA learner carries; ``common.run_loop``'s
+    # sentinel reads it and rolls back to a last-good snapshot.
+    numerics_guards: bool = True
     seed: int = 0
     num_devices: int = 0            # 0 = all visible devices
 
@@ -209,7 +214,13 @@ def make_a2c(cfg: A2CConfig) -> common.IterationFns:
         params = optax.apply_updates(state.params, updates)
 
         metrics = jax.lax.pmean(
-            {"loss": loss, "policy_loss": pg, "value_loss": vf, "entropy": ent},
+            {
+                "loss": loss, "policy_loss": pg, "value_loss": vf,
+                "entropy": ent,
+                **common.guard_metrics(
+                    cfg.numerics_guards, (loss, grads, params)
+                ),
+            },
             DATA_AXIS,
         )
         metrics.update(common.episode_metrics(ep_info))
@@ -273,7 +284,13 @@ def make_a2c(cfg: A2CConfig) -> common.IterationFns:
         params = optax.apply_updates(state.params, updates)
 
         metrics = jax.lax.pmean(
-            {"loss": loss, "policy_loss": pg, "value_loss": vf, "entropy": ent},
+            {
+                "loss": loss, "policy_loss": pg, "value_loss": vf,
+                "entropy": ent,
+                **common.guard_metrics(
+                    cfg.numerics_guards, (loss, grads, params)
+                ),
+            },
             DATA_AXIS,
         )
         metrics.update(common.episode_metrics(ep_info))

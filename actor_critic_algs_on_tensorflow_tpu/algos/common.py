@@ -340,6 +340,25 @@ def collect_rollout(
     return env_state, obs, traj, ep_info
 
 
+def guard_metrics(enabled: bool, guarded_tree) -> Dict[str, jax.Array]:
+    """``{"health_finite": 0/1}`` when ``enabled``, else ``{}``.
+
+    The in-graph all-finite guard the IMPALA learner carries (PR 3),
+    shared by the on-policy and off-policy update programs: one fused
+    reduction over whatever the trainer stakes its health on (loss,
+    grads, updated params), read host-side by the run loop's sentinel.
+    Metrics-only — the params math is untouched."""
+    if not enabled:
+        return {}
+    from actor_critic_algs_on_tensorflow_tpu.utils import health as health_lib
+
+    return {
+        "health_finite": health_lib.all_finite(guarded_tree).astype(
+            jnp.float32
+        )
+    }
+
+
 def global_normalize_advantages(
     adv: jax.Array,
     axis_name: str | Tuple[str, ...] | None = DATA_AXIS,
@@ -538,6 +557,7 @@ def run_loop(
     checkpoint_interval_iters: int = 0,
     state: OnPolicyState | None = None,
     summary_writer=None,
+    sentinel=None,
 ):
     """Host-side training loop: dispatch iterations, surface metrics.
 
@@ -545,6 +565,13 @@ def run_loop(
     (env_steps, metrics-dict) tuples fetched at log intervals.
     ``summary_writer`` (utils.tensorboard.SummaryWriter) additionally
     receives every logged metric dict.
+
+    ``sentinel`` (utils.health.TrainingHealthSentinel) reads each
+    iteration's ``health_finite`` guard bit (emitted when the trainer's
+    ``numerics_guards`` is on) and rolls the FULL train state back to a
+    last-good snapshot on a trip — the PR-3 IMPALA sentinel glue,
+    shared by every checkpointed trainer: these loops could already
+    persist a poisoned state; now they refuse to keep one.
     """
     from actor_critic_algs_on_tensorflow_tpu.utils.metrics import (
         device_get_metrics,
@@ -580,9 +607,14 @@ def run_loop(
     # episodes in only ~1 of every ep_len/steps_per_iter iterations,
     # so a sampled boundary iteration usually reports episodes=0.
     ep_count = ret_sum = None
+    if sentinel is not None:
+        # The pre-loop (or resumed) state is the first rollback target.
+        sentinel.seed(state, iters_done0 - 1)
     for it in range(num_iters):
         state, metrics = fns.iteration(state)
         last_metrics = metrics
+        if sentinel is not None:
+            state = sentinel.after_step(iters_done0 + it, state, metrics)
         if "episodes" in metrics:
             n = metrics["episodes"]
             r = metrics["avg_return"] * n
@@ -612,8 +644,27 @@ def run_loop(
             and checkpoint_interval_iters
             and (it + 1) % checkpoint_interval_iters == 0
         ):
-            checkpointer.save(
-                steps_done0 + (it + 1) * fns.steps_per_iteration, state
+            # Resolve any pending delayed-guard verdict first — a
+            # checkpoint must never capture a state whose own step
+            # went unchecked (the monotonic guard below would pin a
+            # poisoned save as latest forever).
+            if sentinel is not None:
+                state = sentinel.flush(state)
+            # Id from state.step, not the loop counter: a sentinel
+            # rollback rewinds state.step while ``it`` marches on, and
+            # orbax silently refuses non-monotonic ids anyway (same
+            # hardening as the IMPALA loop). Without a rollback the two
+            # derivations are identical.
+            ckpt_id = (
+                int(jax.device_get(state.step)) * fns.steps_per_iteration
             )
+            latest = checkpointer.latest_step()
+            if latest is None or ckpt_id > latest:
+                checkpointer.save(ckpt_id, state)
+    if sentinel is not None:
+        # Delayed guard mode: resolve the last pending verdict so the
+        # caller never checkpoints a state whose final step went
+        # unchecked.
+        state = sentinel.flush(state)
     profiling.sync(last_metrics)
     return state, history

@@ -38,7 +38,7 @@ from __future__ import annotations
 
 import functools
 import time
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -58,6 +58,16 @@ def host_async_supported(cfg) -> bool:
     return str(cfg.env).startswith(("gym:", "native:")) and (
         cfg.num_devices in (0, 1)
     )
+
+
+class _GuardedPair(NamedTuple):
+    """What the async loop's health sentinel snapshots and restores:
+    the learner-side state a bad update can poison. The replay ring is
+    NOT rolled back — its contents are data, not derived state, and
+    stay valid across a rollback."""
+
+    params: Any
+    opt_state: Any
 
 
 def _build_update(parts, accel) -> Any:
@@ -83,6 +93,18 @@ def _build_update(parts, accel) -> Any:
                 jnp.sum(did), 1.0
             )
             out["actor_updates"] = jnp.mean(did)
+        # Same in-graph guard as the fused path's finalize_iteration:
+        # the async loop's sentinel reads health_finite off these
+        # metrics once the dispatched update retires.
+        from actor_critic_algs_on_tensorflow_tpu.algos.common import (
+            guard_metrics,
+        )
+
+        out.update(
+            guard_metrics(
+                getattr(cfg, "numerics_guards", False), (m, params)
+            )
+        )
         return params, opt_state, out
 
     mesh = Mesh(np.asarray([accel]), (DATA_AXIS,))
@@ -128,11 +150,20 @@ def run_host_async(
     checkpoint_interval_iters: int = 0,
     initial_state: offpolicy.OffPolicyState | None = None,
     snapshot_interval: int = 0,
+    sentinel=None,
 ) -> Tuple[offpolicy.OffPolicyState, list]:
     """Train with host-side env stepping and accelerator-side updates.
 
     Mirrors ``common.run_loop``'s interface/logging; returns
     ``(final OffPolicyState, history)``.
+
+    ``sentinel`` (utils.health.TrainingHealthSentinel) guards the
+    learner-side ``(params, opt_state)`` pair against the
+    ``health_finite`` bit the update program emits (the trainer's
+    ``numerics_guards``): a NaN update rolls both back to a last-good
+    snapshot instead of poisoning every later iteration. Use the
+    sentinel's ``delayed`` mode here — an immediate check would stall
+    the host loop on the in-flight accelerator update every iteration.
     """
     from actor_critic_algs_on_tensorflow_tpu.algos.common import (
         RateClock,
@@ -246,6 +277,9 @@ def run_host_async(
     m_dev: Dict[str, jax.Array] = {}
     ep_returns: list = []
 
+    if sentinel is not None:
+        sentinel.seed(_GuardedPair(params, opt_state), iters_done0 - 1)
+
     for it_off in range(num_iters):
         it = iters_done0 + it_off
         it_key = jax.random.fold_in(k_loop, it)
@@ -265,6 +299,13 @@ def run_host_async(
             params, opt_state, m_dev = update(
                 params, opt_state, replay, upd_keys
             )
+            if sentinel is not None:
+                # Delayed mode checks the PREVIOUS update's (long
+                # retired) guard bit — no stall on the dispatch above.
+                pair = sentinel.after_step(
+                    it, _GuardedPair(params, opt_state), m_dev
+                )
+                params, opt_state = pair.params, pair.opt_state
 
         # 2. Step envs on the host with the bounded-stale snapshot,
         #    writing transitions straight into this iteration's arena
@@ -351,12 +392,20 @@ def run_host_async(
             and checkpoint_interval_iters
             and (it_off + 1) % checkpoint_interval_iters == 0
         ):
+            if sentinel is not None:
+                # A checkpoint must never capture a state whose own
+                # update went unchecked (delayed guard mode).
+                pair = sentinel.flush(_GuardedPair(params, opt_state))
+                params, opt_state = pair.params, pair.opt_state
             flush_staged()
             state = _pack_state(
                 params, opt_state, obs, noise, replay, key, it + 1
             )
             checkpointer.save((it + 1) * steps_per_iteration, state)
 
+    if sentinel is not None:
+        pair = sentinel.flush(_GuardedPair(params, opt_state))
+        params, opt_state = pair.params, pair.opt_state
     flush_staged()
     state = _pack_state(
         params, opt_state, obs, noise, replay, key, iters_done0 + num_iters

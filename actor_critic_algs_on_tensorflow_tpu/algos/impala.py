@@ -119,6 +119,13 @@ class ImpalaConfig:
     # most check*snapshot iterations of progress.
     numerics_guards: bool = True
     guard_check_interval: int = 1
+    # Check step i-1's guard scalars at step i: the metrics fetch then
+    # never stalls on the step still executing, hiding the guard's
+    # device round-trip (~8% of a 12 ms CPU step, PERF.md) behind
+    # dispatch run-ahead. Costs ONE extra step of rollback lag (the
+    # trip is seen a step late, discarding the bad step and the one
+    # dispatched after it). False = the PR-3 same-step check.
+    guard_delayed_check: bool = True
     snapshot_interval: int = 20
     snapshot_ring: int = 2
     max_rollbacks: int = 3
@@ -140,6 +147,11 @@ class ImpalaConfig:
     validate_device_trajectories: bool = False
     quarantine_threshold: int = 3
     traj_logit_bound: float = 1e4
+    # Observation magnitude bound for the validator (0 = disabled).
+    # Set it when observations are normalized/bounded by construction
+    # (e.g. ±10-clipped normalized obs): values far outside the bound
+    # are then corruption, not data. Raw unbounded obs: leave 0.
+    traj_obs_bound: float = 0.0
     # --- transport fault tolerance (run_impala_distributed) ---------
     # Actor-side heartbeat cadence while waiting on the learner, the
     # silence window after which either side declares the peer wedged
@@ -226,6 +238,7 @@ class ImpalaPrograms:
     copy_params: Any            # jitted pytree copy (donation-safe publish)
     copy_state: Any             # jitted FULL-state copy (sentinel snapshots)
     batch_time_axis: Any        # TIME_AXIS or None (the t-axis spec name)
+    num_actions: Any = None     # discrete action count (validator bounds)
 
     def __iter__(self):
         return iter(
@@ -761,6 +774,7 @@ def make_impala(cfg: ImpalaConfig):
         copy_params=copy_tree,
         copy_state=copy_tree,
         batch_time_axis=t_axis,
+        num_actions=getattr(action_space, "n", None),
     )
 
 
@@ -781,12 +795,24 @@ def _make_sentinel(cfg: ImpalaConfig, programs: ImpalaPrograms, publish,
         ring_capacity=cfg.snapshot_ring,
         snapshot_interval=cfg.snapshot_interval,
         check_interval=cfg.guard_check_interval,
+        delayed=cfg.guard_delayed_check,
         detector=health_lib.DivergenceDetector(
             loss_spike_factor=cfg.loss_spike_factor,
             grad_norm_spike_factor=cfg.grad_norm_spike_factor,
             warmup_checks=cfg.spike_warmup_checks,
         ),
         exec_lock=exec_lock,
+    )
+
+
+def _make_validator(cfg: ImpalaConfig, programs: "ImpalaPrograms"):
+    """Config -> TrajectoryValidator with the action/obs bounds wired
+    from the compiled programs — shared by both run loops."""
+    return health_lib.TrajectoryValidator(
+        logit_bound=cfg.traj_logit_bound,
+        num_actions=programs.num_actions,
+        obs_bound=cfg.traj_obs_bound,
+        quarantine_threshold=cfg.quarantine_threshold,
     )
 
 
@@ -851,6 +877,8 @@ def _learner_loop(
     sentinel=None,
     validate=None,
     stop_event: threading.Event | None = None,
+    coordinator=None,
+    catchup_deadline_s: float = 15.0,
     corrupt_batch=None,
 ) -> Tuple[LearnerState, List[Tuple[int, Dict[str, float]]]]:
     """Shared learner loop of the in-process and cross-process modes.
@@ -867,8 +895,15 @@ def _learner_loop(
     pre-arena poison-batch filter applied to every trajectory before it
     joins a batch. ``stop_event`` (preemption-safe shutdown) breaks the
     loop at the next iteration boundary and saves one final checkpoint
-    at the interrupted step. ``corrupt_batch(it, batch) -> batch`` is a
-    test-only fault-injection hook.
+    at the interrupted step. ``coordinator``
+    (``distributed.controlplane.PreemptionLeader``/``Follower``) turns
+    that save into a multi-host consensus: the hosts agree on ONE stop
+    step (the max reported), each trains up to it (bounded by
+    ``catchup_deadline_s`` so dead actors cannot hang the preemption
+    countdown), saves exactly there, and a barrier holds everyone
+    until all saves are durable — a restore never mixes steps across
+    hosts. ``corrupt_batch(it, batch) -> batch`` is a test-only
+    fault-injection hook.
 
     With ``cfg.pipeline`` a ``LearnerPipeline`` prefetch thread drains
     the queue and assembles/transfers the NEXT batch while the current
@@ -954,6 +989,44 @@ def _learner_loop(
             return make_batch
         return lambda: corrupt_batch(it, make_batch())
 
+    def collect_and_step(state, stop_evt, it, *, q_timeout=1.0):
+        """Collect one batch (pipelined or serial queue drain) and
+        dispatch the learner step — the ONLY batch-collect machinery;
+        the preemption catch-up reuses it so the two paths cannot
+        drift. Returns ``(state, metrics, eps)``, or ``None`` when
+        ``stop_evt`` fired before a full batch arrived. (During
+        catch-up ``check_health`` is a no-op — stop_event is set — and
+        the poison hook simply keeps firing on the catch-up iteration
+        ids, consistent with guards staying armed.)"""
+        if pipe is not None:
+            got = pipe.get(stop=stop_evt)
+            if got is None:
+                return None
+            batch, eps, handle = got
+            state, metrics = dispatch_step(state, poison(it, lambda: batch))
+            pipe.mark_consumed(handle, metrics)
+            del batch  # donated or pipeline-owned; never reused here
+            return state, metrics, eps
+        trajs, eps = [], []
+        tq0 = time.perf_counter()
+        while len(trajs) < cfg.batch_trajectories:
+            if stop_evt is not None and stop_evt.is_set():
+                return None
+            check_health(it)
+            try:
+                traj, ep = q.get(timeout=q_timeout)
+            except queue_lib.Empty:  # re-check actor health
+                continue
+            if validate is not None and not validate(traj, ep):
+                continue  # dropped-and-recorded by the validator
+            trajs.append(traj)
+            eps.append(ep)
+        split.add("queue_wait_s", time.perf_counter() - tq0)
+        state, metrics = dispatch_step(
+            state, poison(it, lambda: stack_trajectories(trajs))
+        )
+        return state, metrics, eps
+
     history: List[Tuple[int, Dict[str, float]]] = []
     t0 = time.perf_counter()
     last_log_i, last_log_t = 0, t0
@@ -966,43 +1039,15 @@ def _learner_loop(
                 break
             it = iters_done0 + i
             it_box[0] = it
-            if pipe is not None:
-                got = pipe.get(stop=stop_event)
-                if got is None:
-                    # Preemption while waiting for a batch (the actors
-                    # likely died of the same signal): save and exit
-                    # instead of waiting forever for data that will
-                    # never come.
-                    interrupted = True
-                    break
-                batch, eps, handle = got
-                state, metrics = dispatch_step(
-                    state, poison(it, lambda: batch)
-                )
-                pipe.mark_consumed(handle, metrics)
-                del batch  # donated or pipeline-owned; never reused here
-            else:
-                trajs, eps = [], []
-                tq0 = time.perf_counter()
-                while len(trajs) < cfg.batch_trajectories:
-                    if stop_event is not None and stop_event.is_set():
-                        interrupted = True
-                        break
-                    check_health(it)
-                    try:
-                        traj, ep = q.get(timeout=1.0)
-                    except queue_lib.Empty:  # re-check actor health
-                        continue
-                    if validate is not None and not validate(traj, ep):
-                        continue  # dropped-and-recorded by the validator
-                    trajs.append(traj)
-                    eps.append(ep)
-                split.add("queue_wait_s", time.perf_counter() - tq0)
-                if interrupted:
-                    break
-                state, metrics = dispatch_step(
-                    state, poison(it, lambda: stack_trajectories(trajs))
-                )
+            got = collect_and_step(state, stop_event, it)
+            if got is None:
+                # Preemption while waiting for a batch (the actors
+                # likely died of the same signal): save and exit
+                # instead of waiting forever for data that will
+                # never come.
+                interrupted = True
+                break
+            state, metrics, eps = got
             if sentinel is not None:
                 # Guard check on the step that just ran; on a trip this
                 # returns the restored last-good state (and re-publishes
@@ -1017,6 +1062,14 @@ def _learner_loop(
                 and checkpoint_interval
                 and (i + 1) % checkpoint_interval == 0
             ):
+                # Resolve any pending delayed-guard verdict FIRST: a
+                # checkpoint must never capture a state whose own step
+                # went unchecked (the monotonic-id guard below would
+                # then pin a poisoned save as latest forever — the
+                # rollback rewinds state.step, so the clean state
+                # re-reaching this id could never overwrite it).
+                if sentinel is not None:
+                    state = sentinel.flush(state)
                 # Checkpoint ids derive from state.step, NOT the loop
                 # counter: a sentinel rollback rewinds state.step while
                 # i marches on, and an id inflated past the state
@@ -1071,6 +1124,54 @@ def _learner_loop(
                     log_fn(env_steps, m)
                 else:
                     print(format_metrics(env_steps, m), flush=True)
+        if interrupted and coordinator is not None:
+            # Multi-host stop-step consensus: agree on ONE final step,
+            # train up to it (the pipe/queue is still live here), so
+            # every host's final checkpoint carries the same id.
+            local_it = int(jax.device_get(state.step))
+            agreed = coordinator.decide(local_it)
+            if agreed > local_it:
+                print(
+                    f"[impala] preemption consensus: training "
+                    f"{agreed - local_it} more step(s) to the agreed "
+                    f"stop step {agreed}",
+                    flush=True,
+                )
+            give_up = threading.Event()
+            timer = threading.Timer(catchup_deadline_s, give_up.set)
+            timer.daemon = True
+            timer.start()
+            cu_it = iters_done0 + iters_completed
+            try:
+                while (
+                    int(jax.device_get(state.step)) < agreed
+                    and not give_up.is_set()
+                ):
+                    got = collect_and_step(
+                        state, give_up, cu_it, q_timeout=0.25
+                    )
+                    if got is None:
+                        break
+                    state, metrics, _ = got
+                    if sentinel is not None:
+                        # Guards stay armed during catch-up: a rollback
+                        # rewinds state.step and the while re-trains.
+                        state = sentinel.after_step(cu_it, state, metrics)
+                    cu_it += 1
+            finally:
+                timer.cancel()
+            final_it = int(jax.device_get(state.step))
+            if final_it < agreed:
+                print(
+                    f"[impala] WARNING: reached step {final_it}, not the "
+                    f"agreed {agreed} (actors likely preempted too); "
+                    f"saving locally — the restore may mix steps",
+                    flush=True,
+                )
+        if sentinel is not None:
+            # Delayed guard mode: resolve the final pending verdict so
+            # no checkpoint below ever captures an unchecked last step.
+            state = sentinel.flush(state)
         if interrupted:
             # Preemption-safe shutdown: one final atomic checkpoint at
             # the interrupted step, durable before the teardown in the
@@ -1084,6 +1185,10 @@ def _learner_loop(
                 if checkpointer is not None
                 else False
             )
+            if coordinator is not None:
+                # Hold until every host's save is durable — only then
+                # may anyone exit (and tear down shared infrastructure).
+                coordinator.barrier()
             tail = ""
             if saved:
                 tail = "; final checkpoint saved"
@@ -1114,6 +1219,7 @@ def run_impala(
     checkpoint_interval: int = 200,
     initial_state: LearnerState | None = None,
     stop_event: threading.Event | None = None,
+    coordinator=None,
 ) -> Tuple[LearnerState, List[Tuple[int, Dict[str, float]]]]:
     """Drive actors + learner until the env-step budget is consumed.
 
@@ -1190,10 +1296,7 @@ def run_impala(
     # the wire path in run_impala_distributed validates unconditionally.
     validator = None
     if cfg.validate_trajectories and cfg.validate_device_trajectories:
-        validator = health_lib.TrajectoryValidator(
-            logit_bound=cfg.traj_logit_bound,
-            quarantine_threshold=cfg.quarantine_threshold,
-        )
+        validator = _make_validator(cfg, programs)
     poisoned = False
 
     def check_health(it: int):
@@ -1281,6 +1384,7 @@ def run_impala(
             sentinel=sentinel,
             validate=validator.admit if validator is not None else None,
             stop_event=stop_event,
+            coordinator=coordinator,
             corrupt_batch=corrupt_batch,
         )
     finally:
@@ -1294,7 +1398,8 @@ def run_impala(
 # ---- cross-process mode: actors over the socket transport (DCN leg) ----
 
 def _actor_process_main(
-    cfg: ImpalaConfig, actor_id: int, host: str, port: int, seed: int
+    cfg: ImpalaConfig, actor_id: int, host: str, port: int, seed: int,
+    generation: int = 0,
 ) -> None:
     """Entry point of one spawned actor PROCESS.
 
@@ -1311,6 +1416,7 @@ def _actor_process_main(
         RetryPolicy,
     )
     from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+        ROLE_ACTOR,
         LearnerShutdown,
     )
 
@@ -1324,13 +1430,17 @@ def _actor_process_main(
     )
     # Transparent reconnect + re-push on transport faults: V-trace makes
     # the resulting duplicate/stale trajectories benign, so a flaky DCN
-    # link or a learner restart costs retries, not an actor.
+    # link or a learner restart costs retries, not an actor. The hello
+    # identity is re-announced on every reconnect, so the learner's
+    # connection registry keeps provenance through link churn AND
+    # through a failover to a different learner.
     client = ResilientActorClient(
         host, port,
         retry=RetryPolicy(deadline_s=cfg.transport_retry_deadline_s),
         heartbeat_interval_s=cfg.transport_heartbeat_s,
         idle_timeout_s=cfg.transport_idle_timeout_s,
         max_frame_bytes=cfg.transport_max_frame_mb << 20,
+        hello=(actor_id, generation, ROLE_ACTOR),
     )
     try:
         version, leaves = client.fetch_params()
@@ -1350,7 +1460,13 @@ def _actor_process_main(
                 [np.asarray(x) for x in jax.tree_util.tree_leaves(traj)],
                 [np.asarray(x) for x in jax.tree_util.tree_leaves(ep)],
             )
-            if server_version > version:
+            # ANY version change triggers a re-fetch — not just a
+            # larger one: a failover lands the actor on a standby
+            # whose version counter restarted at 1, and a ">" check
+            # would leave it pushing under stale weights forever.
+            # (0 = a learner that has not published yet: keep the
+            # current weights and let the next ack trigger the fetch.)
+            if server_version != version and server_version > 0:
                 version, leaves = client.fetch_params()
                 params = jax.tree_util.tree_unflatten(params_def, leaves)
     except LearnerShutdown:
@@ -1377,6 +1493,28 @@ def _actor_process_main(
             pass
 
 
+def _derive_wire_plan(programs: "ImpalaPrograms", params):
+    """(traj treedef, ep treedef, ingest plan) for rebuilding pytrees
+    from wire leaves — leaf ORDER is tree_flatten order on both sides;
+    structures match because both sides build them from one config.
+
+    Costs two ``eval_shape`` traces of the actor programs; the warm
+    standby derives it BEFORE takeover so the failover gap does not
+    pay for tracing."""
+    rollout_fn, env_reset_fn = programs.make_actor_programs(0)
+    k0 = jax.random.PRNGKey(0)
+    es_shape, obs_shape, carry_shape = jax.eval_shape(env_reset_fn, k0)
+    _, _, _, traj_shape, ep_shape = jax.eval_shape(
+        rollout_fn, params, es_shape, obs_shape, carry_shape, k0
+    )
+    return (
+        jax.tree_util.tree_structure(traj_shape),
+        jax.tree_util.tree_structure(ep_shape),
+        programs.ingest_plan(traj_shape),
+        traj_shape,
+    )
+
+
 def run_impala_distributed(
     cfg: ImpalaConfig,
     *,
@@ -1389,6 +1527,11 @@ def run_impala_distributed(
     host: str = "127.0.0.1",
     port: int = 0,
     stop_event: threading.Event | None = None,
+    programs: ImpalaPrograms | None = None,
+    external_actors: bool = False,
+    on_server_start=None,
+    coordinator=None,
+    wire_plan=None,
 ) -> Tuple[LearnerState, List[Tuple[int, Dict[str, float]]]]:
     """IMPALA with actors in separate PROCESSES streaming trajectories
     through ``distributed.transport`` — the same topology that spans
@@ -1403,6 +1546,18 @@ def run_impala_distributed(
     ``cfg.max_actor_restarts`` times, mirroring ``run_impala``; actors
     ride ``ResilientActorClient``, so transport faults cost retries and
     reconnects (reported through the transport_* metrics), not actors.
+
+    Control-plane hooks (``run_impala_standby`` / failover): with
+    ``external_actors`` the learner spawns and monitors NO actor
+    processes — the fleet belongs to someone else (a dead primary, a
+    separate supervisor) and merely redirects here;
+    ``on_server_start(host, port)`` fires once the listener is bound
+    and initial weights are published (the takeover path re-points the
+    actor ``Redirector`` from it); ``programs`` reuses an already-
+    compiled ``ImpalaPrograms`` (the warm standby compiled while the
+    primary was healthy — recompiling at takeover would put minutes of
+    XLA time back into the failover gap); ``coordinator`` is the
+    preemption stop-step consensus (see ``_learner_loop``).
     """
     import multiprocessing as mp
 
@@ -1416,28 +1571,21 @@ def run_impala_distributed(
         donation_supported,
     )
 
-    programs = make_impala(cfg)
+    if programs is None:
+        programs = make_impala(cfg)
     init, learner_step, make_actor_programs, mesh = programs
     state = (
         initial_state if initial_state is not None
         else init(jax.random.PRNGKey(cfg.seed))
     )
 
-    # Treedefs for rebuilding pytrees from wire leaves (leaf ORDER is
-    # tree_flatten order on both sides; structures match because both
-    # sides build them from the same config).
-    rollout_fn, env_reset_fn = make_actor_programs(0)
-    k0 = jax.random.PRNGKey(0)
-    es_shape, obs_shape, carry_shape = jax.eval_shape(env_reset_fn, k0)
-    _, _, _, traj_shape, ep_shape = jax.eval_shape(
-        rollout_fn, state.params, es_shape, obs_shape, carry_shape, k0
-    )
-    traj_def = jax.tree_util.tree_structure(traj_shape)
-    ep_def = jax.tree_util.tree_structure(ep_shape)
-    # Host-arena ingest: wire trajectories (numpy leaves) are scattered
-    # into preallocated per-leaf buffers and device_put with the
-    # learner's shardings by the prefetch thread.
-    ingest_plan = programs.ingest_plan(traj_shape)
+    # Treedefs for rebuilding pytrees from wire leaves + the host-arena
+    # ingest plan (preallocated per-leaf buffers, sharded device_put by
+    # the prefetch thread). Derivable here, but the warm standby hands
+    # them in pre-derived so takeover skips the eval_shape traces.
+    if wire_plan is None:
+        wire_plan = _derive_wire_plan(programs, state.params)
+    traj_def, ep_def, ingest_plan, _ = wire_plan
 
     q = TrajectoryQueue(cfg.queue_size)
     closing = threading.Event()
@@ -1450,17 +1598,20 @@ def run_impala_distributed(
     # and counted by the server as transport_rejected.
     validator = None
     if cfg.validate_trajectories:
-        validator = health_lib.TrajectoryValidator(
-            logit_bound=cfg.traj_logit_bound,
-            quarantine_threshold=cfg.quarantine_threshold,
-        )
+        validator = _make_validator(cfg, programs)
 
-    def on_trajectory(traj_leaves, ep_leaves):
+    def on_trajectory(traj_leaves, ep_leaves, peer):
         item = (
             jax.tree_util.tree_unflatten(traj_def, traj_leaves),
             jax.tree_util.tree_unflatten(ep_def, ep_leaves),
         )
-        if validator is not None and not validator.admit(*item):
+        if validator is not None and not validator.admit(
+            # Hello-frame provenance outranks the episode-info leaf:
+            # the connection's identity cannot be scrambled by payload
+            # corruption, so quarantine lands on the right actor even
+            # when episode-info is the corrupt part.
+            *item, source_actor_id=peer.actor_id,
+        ):
             return False
         while not closing.is_set():
             try:
@@ -1478,6 +1629,9 @@ def run_impala_distributed(
         max_frame_bytes=cfg.transport_max_frame_mb << 20,
     )
     server.publish(jax.tree_util.tree_leaves(jax.device_get(state.params)))
+    if on_server_start is not None:
+        # Listener bound, weights published: safe to point actors here.
+        on_server_start(host, server.port)
 
     ctx = mp.get_context("spawn")
     connect_host = "127.0.0.1" if host in ("0.0.0.0", "") else host
@@ -1488,13 +1642,17 @@ def run_impala_distributed(
             args=(
                 cfg, i, connect_host, server.port,
                 cfg.seed * 10_000 + generation * 1_000 + i,
+                generation,
             ),
             daemon=True,
         )
         p.start()
         return p
 
-    procs = [spawn(i, 0) for i in range(cfg.num_actors)]
+    procs = (
+        [] if external_actors else
+        [spawn(i, 0) for i in range(cfg.num_actors)]
+    )
     restarts = 0
 
     def check_health(it: int):
@@ -1583,6 +1741,7 @@ def run_impala_distributed(
 
     sentinel = _make_sentinel(cfg, programs, publish, exec_lock)
 
+    completed = False
     try:
         state, history = _learner_loop(
             cfg, state, learner_step, q,
@@ -1607,17 +1766,190 @@ def run_impala_distributed(
             ingest_plan=ingest_plan,
             sentinel=sentinel,
             stop_event=stop_event,
+            coordinator=coordinator,
         )
+        completed = True
     finally:
         closing.set()
         try:
             publisher.close()
         except Exception:
             pass
-        server.close()
+        handed_off = 0
+        preempted = stop_event is not None and stop_event.is_set()
+        if preempted or not completed:
+            # Preempted or CRASHED (rollback/restart budget exhausted,
+            # any unhandled error) — NOT finished: a KIND_CLOSE
+            # broadcast would read as "training completed — stand
+            # down" to a warm standby's monitor, orphaning the fleet
+            # on exactly the failure class failover exists for. Tell
+            # hello-declared standbys to take over FIRST (same
+            # connection, ordered before any close). A standby that
+            # then finds no work left exits immediately.
+            handed_off = server.broadcast_handoff()
+        # With a standby taking over, the fleet must SURVIVE this
+        # learner: skip the goodbye (actors see a reset, retry, and
+        # land on the successor via the redirector) instead of telling
+        # every actor to exit. No standby -> the PR-3 clean shutdown.
+        server.close(graceful=handed_off == 0)
         q.close()
         for p in procs:
             p.join(timeout=5.0)
             if p.is_alive():
                 p.terminate()
     return state, history
+
+
+def run_impala_standby(
+    cfg: ImpalaConfig,
+    *,
+    checkpointer,
+    primary_host: str,
+    primary_port: int,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    redirect=None,
+    heartbeat_interval_s: float = 0.5,
+    takeover_deadline_s: float = 3.0,
+    warm_compile: bool = True,
+    spawn_actors: bool = False,
+    log_interval: int = 20,
+    log_fn=None,
+    summary_writer=None,
+    checkpoint_interval: int = 200,
+    stop_event: threading.Event | None = None,
+    coordinator=None,
+    on_ready=None,
+) -> Tuple[LearnerState, List[Tuple[int, Dict[str, float]]]] | None:
+    """Warm-standby learner: wait, stay hot, take over on primary death.
+
+    ``on_ready(monitor)`` fires once the warm phase is complete and the
+    ``PrimaryMonitor`` is watching — the moment the standby can
+    actually be relied on (supervisors should not consider a failover
+    pair armed, nor preempt the primary expecting a handoff, before
+    this; the warm compile can take minutes on real models).
+
+    While the primary at ``primary_host:primary_port`` is healthy this
+    process (a) compiles the full learner program set up front
+    (``warm_compile`` additionally executes one throwaway step on a
+    zero batch so XLA compilation is PAID, not just scheduled), and
+    (b) tails the primary's checkpoint directory, restoring each new
+    step into memory as it lands. On primary death — ``KIND_PING``
+    heartbeats silent past ``takeover_deadline_s``, or an explicit
+    ``KIND_HANDOFF`` — the standby binds its own listener, publishes
+    the tailed weights, and calls ``redirect(host, port)`` (typically
+    ``controlplane.Redirector.redirect``) to re-point the actor fleet.
+    The failover gap is therefore bind + redirect + actor reconnect,
+    not process start + compile + restore-from-disk (PERF.md "Control
+    plane").
+
+    Returns ``None`` without taking over when the primary finishes
+    cleanly (``KIND_CLOSE``) or ``stop_event`` fires first; otherwise
+    returns the takeover run's ``(state, history)``. With
+    ``spawn_actors=False`` (default) the standby expects the existing
+    actor fleet to be redirected to it; it never spawns its own.
+    """
+    from actor_critic_algs_on_tensorflow_tpu.distributed.controlplane import (
+        CheckpointTailer,
+        PrimaryMonitor,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
+        donation_supported,
+    )
+
+    programs = make_impala(cfg)
+    template = jax.eval_shape(programs.init, jax.random.PRNGKey(cfg.seed))
+    # Wire treedefs + ingest plan derived NOW (eval_shape traces): the
+    # takeover run receives them pre-built and skips its prologue
+    # tracing — every second shaved here comes straight off the gap.
+    wire_plan = _derive_wire_plan(programs, template.params)
+    if warm_compile:
+        # Pay the XLA compiles too: init, and the same learner_step
+        # variant the takeover run will pick, driven through the REAL
+        # wire ingest path (host arena + sharded device_put) so the
+        # compiled executable matches the batches takeover will feed.
+        warm_state = programs.init(jax.random.PRNGKey(cfg.seed))
+        traj_shape = wire_plan[3]
+        treedef, axes_leaves, shardings_leaves = wire_plan[2]
+        part_np = [
+            np.zeros(s.shape, s.dtype)
+            for s in jax.tree_util.tree_leaves(traj_shape)
+        ]
+        from actor_critic_algs_on_tensorflow_tpu.data.pipeline import (
+            HostArena,
+        )
+
+        arena = HostArena(axes_leaves, cfg.batch_trajectories)
+        for j in range(cfg.batch_trajectories):
+            arena.write_part(0, j, part_np)
+        dev_leaves = [
+            jax.device_put(buf, s)
+            for buf, s in zip(arena.slot_leaves(0), shardings_leaves)
+        ]
+        warm_batch = jax.tree_util.tree_unflatten(treedef, dev_leaves)
+        donate = (
+            cfg.donate_buffers
+            and donation_supported()
+            and _cpu_mesh_exec_lock(programs.mesh) is None
+        )
+        step = (
+            programs.learner_step_donated if donate
+            else programs.learner_step
+        )
+        out = step(warm_state, warm_batch)
+        jax.block_until_ready(out)
+        del warm_state, warm_batch, out, arena
+        print("[standby] learner programs compiled (warm)", flush=True)
+
+    tailer = CheckpointTailer(checkpointer, template)
+    monitor = PrimaryMonitor(
+        primary_host, primary_port,
+        interval_s=heartbeat_interval_s,
+        deadline_s=takeover_deadline_s,
+    )
+    try:
+        if on_ready is not None:
+            on_ready(monitor)
+        outcome = monitor.wait_outcome(stop_event=stop_event)
+    finally:
+        monitor.close()
+        # One last synchronous poll: the primary's dying save (the
+        # preemption path writes one final checkpoint) may have landed
+        # between our last poll and its death.
+        tailer.close(final_poll=True)
+    if outcome != "down":
+        print(
+            f"[standby] no takeover "
+            f"({outcome or 'stopped before any outcome'})",
+            flush=True,
+        )
+        return None
+
+    step_id, state = tailer.newest()
+    print(
+        f"[standby] TAKEOVER ({monitor.reason}): "
+        + (
+            f"resuming from tailed checkpoint step {step_id} "
+            f"(already restored in memory)"
+            if state is not None
+            else "no checkpoint ever landed; starting from init"
+        ),
+        flush=True,
+    )
+    return run_impala_distributed(
+        cfg,
+        log_interval=log_interval,
+        log_fn=log_fn,
+        summary_writer=summary_writer,
+        checkpointer=checkpointer,
+        checkpoint_interval=checkpoint_interval,
+        initial_state=state,
+        host=host,
+        port=port,
+        stop_event=stop_event,
+        programs=programs,
+        external_actors=not spawn_actors,
+        on_server_start=redirect,
+        coordinator=coordinator,
+        wire_plan=wire_plan,
+    )

@@ -333,15 +333,29 @@ def finalize_iteration(
     replay,
     update_metrics,
     ep_info,
+    guard: bool = False,
 ):
     """pmean'd scalar metrics + episode stats + the rebuilt state (the
-    tail every off-policy ``local_iteration`` shares)."""
+    tail every off-policy ``local_iteration`` shares). ``guard`` folds
+    the in-graph all-finite reduction over the raw per-update losses
+    and the new params into the program (``health_finite``), shared by
+    DDPG/TD3/SAC — one site instead of three."""
     from actor_critic_algs_on_tensorflow_tpu.algos.common import (
         episode_metrics,
+        guard_metrics,
     )
 
     metrics = jax.lax.pmean(
-        jax.tree_util.tree_map(jnp.mean, update_metrics), DATA_AXIS
+        {
+            **jax.tree_util.tree_map(jnp.mean, update_metrics),
+            # Inside the pmean: each device guards ITS update losses
+            # (replay shards differ per device). A NaN that matters
+            # reaches every device's bit anyway — gradients are
+            # pmean'd inside one_update, so a poisoned update poisons
+            # the (replicated) params everywhere.
+            **guard_metrics(guard, (update_metrics, params)),
+        },
+        DATA_AXIS,
     )
     metrics.update(episode_metrics(ep_info))
     metrics["replay_size"] = jax.lax.pmean(

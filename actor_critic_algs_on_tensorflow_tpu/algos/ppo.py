@@ -118,6 +118,10 @@ class PPOConfig:
     compact_frames: bool = False
     compute_dtype: str = "float32"  # "bfloat16" runs torsos on the MXU in bf16
     use_pallas_scan: bool = False   # fused Pallas VMEM kernel for GAE
+    # In-graph all-finite guard over the per-minibatch losses and the
+    # final params, folded into the iteration (one fused reduction;
+    # surfaced as ``health_finite`` for common.run_loop's sentinel).
+    numerics_guards: bool = True
     seed: int = 0
     num_devices: int = 0            # 0 = all visible devices
 
@@ -504,6 +508,11 @@ def make_ppo(cfg: PPOConfig) -> common.IterationFns:
         metrics = jax.lax.pmean(
             jax.tree_util.tree_map(jnp.mean, m), DATA_AXIS
         )
+        # Guard BEFORE the mean dilutes anything: any non-finite
+        # minibatch loss, or a non-finite leaf in the final params.
+        metrics.update(
+            common.guard_metrics(cfg.numerics_guards, (m["loss"], params))
+        )
         metrics.update(common.episode_metrics(ep_info))
 
         new_extra = (
@@ -652,6 +661,9 @@ def make_ppo(cfg: PPOConfig) -> common.IterationFns:
         )
         metrics = jax.lax.pmean(
             jax.tree_util.tree_map(jnp.mean, m), DATA_AXIS
+        )
+        metrics.update(
+            common.guard_metrics(cfg.numerics_guards, (m["loss"], params))
         )
         metrics.update(common.episode_metrics(ep_info))
 

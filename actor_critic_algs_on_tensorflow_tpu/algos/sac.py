@@ -60,6 +60,9 @@ class SACConfig:
     # (uniform replay over recent data ≈ the visitation distribution),
     # and apply at BOTH acting and update time; replay stores raw obs.
     normalize_obs: bool = False
+    # In-graph all-finite guard over the update losses + new params
+    # (``health_finite`` metric; read by the run loops' sentinel).
+    numerics_guards: bool = True
     seed: int = 0
     num_devices: int = 0
 
@@ -263,6 +266,7 @@ def make_sac(cfg: SACConfig) -> offpolicy.OffPolicyFns:
             replay=replay,
             update_metrics=m,
             ep_info=ep_info,
+            guard=cfg.numerics_guards,
         )
 
     parts = offpolicy.TrainerParts(

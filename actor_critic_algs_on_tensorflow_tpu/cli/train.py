@@ -408,6 +408,34 @@ def build_parser() -> argparse.ArgumentParser:
                    help="impala: run actors as separate processes "
                         "streaming over the TCP transport (the "
                         "multi-host topology) instead of threads")
+    p.add_argument("--standby", default=None, metavar="HOST:PORT",
+                   help="impala: run as a WARM-STANDBY learner for the "
+                        "primary at HOST:PORT — compile up front, tail "
+                        "its --checkpoint-dir (restoring each step into "
+                        "memory), and on primary death (missed "
+                        "heartbeats or an explicit handoff) bind "
+                        "--learner-bind, publish the tailed weights, "
+                        "and take the actor fleet over. Requires "
+                        "--checkpoint-dir; spawns no actors of its own")
+    p.add_argument("--redirector", default=None, metavar="[HOST:]PORT",
+                   help="with --standby: also run the actor-facing "
+                        "redirector (actors connect here, never to a "
+                        "learner directly); it forwards to the primary "
+                        "until takeover, then re-points at the local "
+                        "learner and resets live links. Binds 0.0.0.0 "
+                        "unless HOST is given — the fleet is usually "
+                        "on other hosts")
+    p.add_argument("--coordinate-preemption", default=None,
+                   metavar="SPEC",
+                   help="impala: coordinate the SIGTERM final "
+                        "checkpoint across learner hosts so every host "
+                        "saves at ONE agreed step. SPEC is "
+                        "'lead:N@HOST:PORT' (leader; expects N "
+                        "followers on HOST:PORT) or 'follow@HOST:PORT' "
+                        "(connect to the leader). On preemption the "
+                        "hosts exchange step reports, train up to the "
+                        "agreed (max) step, save, and barrier before "
+                        "exiting")
     p.add_argument("--learner-bind", default=None, metavar="HOST[:PORT]",
                    help="with --actor-processes: bind the learner's "
                         "trajectory listener here (default "
@@ -443,6 +471,55 @@ def parse_bind(spec: str | None) -> Tuple[str, int]:
         return host or "127.0.0.1", int(port) if port else 0
     except ValueError:
         raise SystemExit(f"--learner-bind: bad port in {spec!r}")
+
+
+def parse_hostport(spec: str, what: str) -> Tuple[str, int]:
+    """``HOST:PORT`` with a REQUIRED port (unlike parse_bind, these
+    name a peer to connect to — there is no ephemeral default)."""
+    host, port = parse_bind(spec)
+    if port == 0:
+        raise SystemExit(f"{what}: an explicit port is required ({spec!r})")
+    return host, port
+
+
+def make_coordinator(spec: str):
+    """``lead:N@HOST:PORT`` | ``follow@HOST:PORT`` -> a preemption
+    coordinator (distributed.controlplane)."""
+    from actor_critic_algs_on_tensorflow_tpu.distributed.controlplane import (
+        PreemptionFollower,
+        PreemptionLeader,
+    )
+
+    role, sep, addr = spec.partition("@")
+    if not sep:
+        raise SystemExit(
+            f"--coordinate-preemption: expected 'lead:N@HOST:PORT' or "
+            f"'follow@HOST:PORT', got {spec!r}"
+        )
+    if role.startswith("lead"):
+        try:
+            n = int(role.split(":", 1)[1])
+        except (IndexError, ValueError):
+            raise SystemExit(
+                f"--coordinate-preemption: leader needs a follower "
+                f"count ('lead:N@...'), got {spec!r}"
+            )
+        # The leader BINDS (port 0 = ephemeral, printed below); only
+        # followers need an explicit peer port.
+        host, port = parse_bind(addr)
+        coord = PreemptionLeader(n_followers=n, host=host, port=port)
+        print(
+            f"[train] preemption leader on {host}:{coord.port} "
+            f"(expecting {n} followers)",
+            flush=True,
+        )
+        return coord
+    if role == "follow":
+        host, port = parse_hostport(addr, "--coordinate-preemption")
+        return PreemptionFollower(host, port)
+    raise SystemExit(
+        f"--coordinate-preemption: unknown role {role!r} in {spec!r}"
+    )
 
 
 def make_config(args) -> Tuple[str, Any]:
@@ -582,13 +659,118 @@ def format_return_hist(per_env) -> str:
     return "[eval] return_hist " + " ".join(cells)
 
 
+def _run_standby(args, cfg, writer, coordinator) -> int:
+    """``--standby`` mode: warm-standby learner (+ optional actor
+    redirector) for the primary at ``args.standby``."""
+    from actor_critic_algs_on_tensorflow_tpu.algos.impala import (
+        run_impala_standby,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
+        Checkpointer,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.utils.health import (
+        ShutdownSignal,
+    )
+
+    if not args.checkpoint_dir:
+        raise SystemExit(
+            "--standby requires --checkpoint-dir (the primary's "
+            "checkpoint directory — the warm restore source)"
+        )
+    phost, pport = parse_hostport(args.standby, "--standby")
+    host, port = parse_bind(args.learner_bind)
+    checkpointer = Checkpointer(args.checkpoint_dir)
+    redirector = None
+    redirect = None
+    if args.redirector is not None:
+        from actor_critic_algs_on_tensorflow_tpu.distributed.controlplane import (  # noqa: E501
+            Redirector,
+        )
+
+        if ":" not in args.redirector:
+            # Bare PORT: bind all interfaces — the actor fleet this
+            # endpoint exists for is usually on OTHER hosts.
+            try:
+                rhost, rport = "0.0.0.0", int(args.redirector)
+            except ValueError:
+                raise SystemExit(
+                    f"--redirector: bad port {args.redirector!r}"
+                )
+        else:
+            rhost, rport = parse_bind(args.redirector)
+        redirector = Redirector(phost, pport, host=rhost, port=rport)
+        print(
+            f"[train] actor redirector on {rhost}:{redirector.port} -> "
+            f"{phost}:{pport} (until takeover)",
+            flush=True,
+        )
+
+        def redirect(h, p):
+            redirector.redirect(
+                "127.0.0.1" if h in ("0.0.0.0", "") else h, p
+            )
+
+    shutdown = None
+    if args.preempt_save:
+        shutdown = ShutdownSignal().install()
+    try:
+        out = run_impala_standby(
+            cfg,
+            checkpointer=checkpointer,
+            primary_host=phost,
+            primary_port=pport,
+            host=host,
+            port=port,
+            redirect=redirect,
+            log_interval=args.log_interval,
+            summary_writer=writer,
+            checkpoint_interval=args.checkpoint_interval,
+            stop_event=shutdown.event if shutdown is not None else None,
+            coordinator=coordinator,
+        )
+    finally:
+        if shutdown is not None:
+            shutdown.uninstall()
+        if redirector is not None:
+            redirector.close()
+        if coordinator is not None:
+            coordinator.close()
+    if out is None:
+        checkpointer.wait()
+        checkpointer.close()
+        print("[train] standby: primary finished; no takeover needed")
+        return 0
+    state, _ = out
+    steps_per_batch = (
+        cfg.batch_trajectories * cfg.envs_per_actor * cfg.rollout_length
+    )
+    _finalize_checkpointer(
+        checkpointer, int(state.step) * steps_per_batch, state
+    )
+    print(
+        f"[train] standby run ended at learner steps={int(state.step)} "
+        f"(took over as primary)"
+    )
+    return 0
+
+
 def _run(args, algo, cfg, writer) -> int:
     if args.render_dir and not args.eval:
         raise SystemExit("--render-dir requires --eval")
-    if args.learner_bind and not (algo == "impala" and args.actor_processes):
+    if args.learner_bind and not (
+        algo == "impala" and (args.actor_processes or args.standby)
+    ):
         raise SystemExit(
-            "--learner-bind requires impala with --actor-processes"
+            "--learner-bind requires impala with --actor-processes "
+            "or --standby"
         )
+    if (args.standby or args.coordinate_preemption) and algo != "impala":
+        raise SystemExit(
+            "--standby / --coordinate-preemption are impala-only "
+            "(the actor-learner control plane)"
+        )
+    if args.redirector is not None and not args.standby:
+        raise SystemExit("--redirector requires --standby")
     if args.eval:
         if not args.checkpoint_dir:
             raise SystemExit("--eval requires --checkpoint-dir")
@@ -623,6 +805,13 @@ def _run(args, algo, cfg, writer) -> int:
             run_impala_distributed,
         )
 
+        coordinator = None
+        if args.coordinate_preemption:
+            coordinator = make_coordinator(args.coordinate_preemption)
+
+        if args.standby:
+            return _run_standby(args, cfg, writer, coordinator)
+
         def make_template():
             import jax
 
@@ -632,7 +821,7 @@ def _run(args, algo, cfg, writer) -> int:
             )
 
         checkpointer, initial_state = _open_checkpointer(args, make_template)
-        kwargs = {}
+        kwargs = {"coordinator": coordinator}
         if args.actor_processes:
             runner = run_impala_distributed
             kwargs["host"], kwargs["port"] = parse_bind(args.learner_bind)
@@ -663,6 +852,8 @@ def _run(args, algo, cfg, writer) -> int:
         finally:
             if shutdown is not None:
                 shutdown.uninstall()
+            if coordinator is not None:
+                coordinator.close()
         steps_per_batch = (
             cfg.batch_trajectories * cfg.envs_per_actor * cfg.rollout_length
         )
@@ -729,6 +920,47 @@ def _run(args, algo, cfg, writer) -> int:
         return fns.init(jax.random.PRNGKey(cfg.seed))
 
     checkpointer, state = _open_checkpointer(args, make_template, cfg)
+    # The PR-3 sentinel glue, now shared by every checkpointed trainer:
+    # the update programs emit the in-graph health_finite bit
+    # (numerics_guards) and the loop rolls back to a last-good snapshot
+    # on a trip instead of training — and checkpointing — NaNs. The
+    # delayed check hides the guard fetch behind dispatch run-ahead.
+    sentinel = None
+    if getattr(cfg, "numerics_guards", False):
+        import jax
+
+        from actor_critic_algs_on_tensorflow_tpu.utils import (
+            health as health_lib,
+        )
+
+        if algo in ("ddpg", "td3", "sac") and not use_async:
+            # Off-policy through the synchronous loop: snapshot ONLY
+            # (params, opt_state). The replay ring is data, not derived
+            # math — a full-state snapshot would double replay HBM per
+            # ring slot — and ``merge`` grafts the restored slice onto
+            # the current state at rollback so the ring/env carry stay.
+            # (The async loop needs none of this: it hands the sentinel
+            # a bare params/opt_state pair already.)
+            sentinel = health_lib.TrainingHealthSentinel(
+                copy_state=jax.jit(
+                    lambda t: jax.tree_util.tree_map(
+                        jax.numpy.copy, (t.params, t.opt_state)
+                    )
+                ),
+                merge=lambda current, restored: current.replace(
+                    params=restored[0], opt_state=restored[1]
+                ),
+                publish=lambda p: None,  # no actor fleet to re-point here
+                delayed=True,
+            )
+        else:
+            sentinel = health_lib.TrainingHealthSentinel(
+                copy_state=jax.jit(
+                    lambda t: jax.tree_util.tree_map(jax.numpy.copy, t)
+                ),
+                publish=lambda p: None,  # no actor fleet to re-point here
+                delayed=True,
+            )
     if use_async:
         from actor_critic_algs_on_tensorflow_tpu.algos.host_async import (
             run_host_async,
@@ -745,6 +977,7 @@ def _run(args, algo, cfg, writer) -> int:
             checkpoint_interval_iters=args.checkpoint_interval,
             initial_state=state,
             summary_writer=writer,
+            sentinel=sentinel,
         )
     else:
         state, history = common.run_loop(
@@ -756,6 +989,7 @@ def _run(args, algo, cfg, writer) -> int:
             checkpoint_interval_iters=args.checkpoint_interval,
             state=state,
             summary_writer=writer,
+            sentinel=sentinel,
         )
     _finalize_checkpointer(
         checkpointer, int(state.step) * fns.steps_per_iteration, state

@@ -1,0 +1,602 @@
+"""Control plane over the actor⇄learner data plane: learner failover
+and coordinated multi-host preemption.
+
+PR 1-3 hardened the DATA plane — actors survive transport faults, the
+ingest pipeline overlaps the learner, and the training process guards
+its own numerics — but the learner itself remained a single point of
+failure, and a pod-slice preemption was uncoordinated (each host saved
+on its own SIGTERM, so a restore could mix steps across hosts).
+IMPALA-class systems treat learner availability as THE throughput
+bottleneck: every actor idles while the learner is down, so the
+restart gap is paid fleet-wide. This module supplies the control
+plane:
+
+  - ``PrimaryMonitor`` — a standby-side heartbeat watcher: pings the
+    primary learner's listener over the existing transport
+    (``KIND_PING``/``KIND_PONG``) and announces itself with a hello
+    frame (role ``ROLE_STANDBY``), so the primary can address it with
+    an explicit ``KIND_HANDOFF``. Declares the primary down on missed
+    heartbeats, finished on ``KIND_CLOSE`` (training completed — do
+    NOT take over), or handed-off on ``KIND_HANDOFF``.
+  - ``CheckpointTailer`` — keeps a warm restore: polls the primary's
+    checkpoint directory (``Checkpointer.refresh``) and restores each
+    new step into memory as it lands, so at takeover the standby's
+    state is already resident — the gap shrinks from
+    restart-from-disk (process start + compile + restore) to a port
+    takeover (bind + re-point, PERF.md "Control plane").
+  - ``Redirector`` — the stable actor-facing endpoint: actors connect
+    here; failover re-points it at the live learner
+    (``ChaosProxy.set_target`` promoted from chaos tooling to the
+    production redirection primitive) and resets live links so
+    in-flight connections fail over immediately instead of waiting
+    out their idle deadlines.
+  - ``PreemptionLeader``/``PreemptionFollower`` — SIGTERM consensus
+    for multi-host learner jobs: every host reports its local step,
+    the leader broadcasts ONE agreed stop step (the max), each host
+    trains up to it, saves exactly there, and a barrier holds everyone
+    until all saves are durable — a restore can never mix steps across
+    hosts. Frames ride the existing wire format
+    (``KIND_STEP_REPORT``/``KIND_STOP_STEP``/``KIND_BARRIER``/
+    ``KIND_BARRIER_OK``).
+
+The IMPALA-side orchestration (``run_impala_standby``, the learner
+loop's consensus hook) lives in ``algos.impala`` — this module stays
+below the algorithm layer and speaks only sockets, checkpoints, and
+threads.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Any, Callable, List, Optional, Tuple
+
+import numpy as np
+
+from actor_critic_algs_on_tensorflow_tpu.distributed.resilience import (
+    ChaosProxy,
+)
+from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+    KIND_BARRIER,
+    KIND_BARRIER_OK,
+    KIND_CLOSE,
+    KIND_HANDOFF,
+    KIND_HELLO,
+    KIND_PING,
+    KIND_PONG,
+    KIND_STEP_REPORT,
+    KIND_STOP_STEP,
+    ROLE_STANDBY,
+    recv_msg,
+    send_msg,
+)
+
+__all__ = [
+    "CheckpointTailer",
+    "PreemptionFollower",
+    "PreemptionLeader",
+    "PrimaryMonitor",
+    "Redirector",
+]
+
+
+class Redirector(ChaosProxy):
+    """Stable actor-facing endpoint with control-plane re-pointing.
+
+    The production sibling of the chaos proxy: same accept/pump
+    machinery, no faults armed. Actors connect to ``redirector.port``
+    once and never learn learner addresses; on failover the control
+    plane calls ``redirect`` — new connections go to the new learner,
+    and (by default) live links are reset so actors already blocked on
+    the dead primary reconnect NOW instead of waiting out a heartbeat
+    idle window (their resilient clients treat the reset as an
+    ordinary transport fault and re-push)."""
+
+    def redirect(
+        self, host: str, port: int, *, reset_existing: bool = True
+    ) -> int:
+        """Point new connections at ``host:port``; returns how many
+        live links were reset over to it."""
+        self.set_target(host, port)
+        return self.reset_all() if reset_existing else 0
+
+
+class PrimaryMonitor(threading.Thread):
+    """Standby-side liveness watch on the primary learner.
+
+    Connects to the primary's listener, announces itself with a hello
+    frame (``ROLE_STANDBY`` — so ``LearnerServer.broadcast_handoff``
+    can find it), and pings every ``interval_s``. Outcomes, exposed as
+    events:
+
+      - ``down``      — ``deadline_s`` of silence (missed heartbeats,
+        refused reconnects) or an explicit ``KIND_HANDOFF``: take over.
+      - ``finished``  — orderly ``KIND_CLOSE``: training completed;
+        do NOT take over.
+
+    Connection loss alone is not death — the monitor reconnects and
+    only declares ``down`` when the primary has produced no evidence
+    of life for the full deadline (a learner stalled in a long jit
+    compile still answers pings from its server threads). A primary
+    that has NEVER been reachable is "not up yet", not dead: it gets
+    the much larger ``never_seen_grace_s`` (default 10x the deadline)
+    before unreachability counts as death, so a standby that merely
+    won the start race does not take over a booting primary and split
+    the fleet — while a standby restarted after the primary truly died
+    still takes over, just later."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        *,
+        interval_s: float = 0.5,
+        deadline_s: float = 3.0,
+        never_seen_grace_s: float | None = None,
+        standby_id: int = 0,
+        log: Callable[[str], None] | None = None,
+    ):
+        super().__init__(name="primary-monitor", daemon=True)
+        self._addr = (host, port)
+        self._interval = interval_s
+        self._deadline = deadline_s
+        self._never_seen_grace = (
+            10.0 * deadline_s
+            if never_seen_grace_s is None
+            else never_seen_grace_s
+        )
+        self._standby_id = standby_id
+        self._log = log if log is not None else (
+            lambda msg: print(f"[standby] {msg}", flush=True)
+        )
+        self.down = threading.Event()
+        self.finished = threading.Event()
+        self.reason: str = ""
+        self.pongs = 0
+        self._halt = threading.Event()
+        self.start()
+
+    def _declare_down(self, reason: str) -> None:
+        self.reason = reason
+        self._log(f"primary declared DOWN: {reason}")
+        self.down.set()
+
+    def run(self) -> None:
+        sock: Optional[socket.socket] = None
+        last_alive = last_log = time.monotonic()
+        seen_alive = False
+        try:
+            while not self._halt.is_set():
+                if self.down.is_set() or self.finished.is_set():
+                    return
+                if sock is None:
+                    try:
+                        sock = socket.create_connection(
+                            self._addr, timeout=self._interval
+                        )
+                        seen_alive = True
+                        send_msg(
+                            sock, KIND_HELLO, 0,
+                            [np.asarray(
+                                [self._standby_id, 0, ROLE_STANDBY],
+                                np.int64,
+                            )],
+                        )
+                    except OSError:
+                        sock = None
+                        # A NEVER-seen primary is "not up yet", not
+                        # dead: at the plain deadline a standby that
+                        # merely won the start race would take over a
+                        # primary still booting — two live learners
+                        # writing one checkpoint dir. Before first
+                        # contact, only the (much larger) grace counts
+                        # unreachability as death.
+                        budget = (
+                            self._deadline
+                            if seen_alive
+                            else self._never_seen_grace
+                        )
+                        if not seen_alive and (
+                            time.monotonic() - last_log > self._deadline
+                        ):
+                            last_log = time.monotonic()
+                            self._log(
+                                f"primary at {self._addr[0]}:"
+                                f"{self._addr[1]} not up yet (taking "
+                                f"over in "
+                                f"{budget - (time.monotonic() - last_alive):.1f}s "
+                                f"unless it appears)"
+                            )
+                        if time.monotonic() - last_alive > budget:
+                            self._declare_down(
+                                f"unreachable for {budget:.1f}s"
+                                + ("" if seen_alive else " (never seen)")
+                            )
+                            return
+                        self._halt.wait(self._interval)
+                        continue
+                try:
+                    # Recv tolerance is the DEADLINE, not the ping
+                    # interval: a primary busy in a long synchronous
+                    # save answers pongs late, and recycling the
+                    # connection on every slow pong opens windows in
+                    # which a KIND_HANDOFF broadcast would be lost.
+                    # A peer silent past the deadline is down anyway.
+                    sock.settimeout(max(self._interval, self._deadline))
+                    send_msg(sock, KIND_PING)
+                    kind, _, _ = recv_msg(sock)
+                    last_alive = time.monotonic()
+                    if kind == KIND_PONG:
+                        self.pongs += 1
+                    elif kind == KIND_CLOSE:
+                        self.reason = "primary finished (KIND_CLOSE)"
+                        self.finished.set()
+                        return
+                    elif kind == KIND_HANDOFF:
+                        self._declare_down("explicit handoff frame")
+                        return
+                    # Any other frame still proves liveness.
+                    self._halt.wait(self._interval)
+                except (socket.timeout, ConnectionError, OSError):
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    sock = None
+                    if time.monotonic() - last_alive > self._deadline:
+                        self._declare_down(
+                            f"no heartbeat for {self._deadline:.1f}s"
+                        )
+                        return
+        finally:
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+    def wait_outcome(
+        self,
+        timeout: float | None = None,
+        stop_event: threading.Event | None = None,
+    ) -> Optional[str]:
+        """Block until an outcome (or ``stop_event``/timeout); returns
+        ``"down"``, ``"finished"``, or ``None``."""
+        deadline = (
+            time.monotonic() + timeout if timeout is not None else None
+        )
+        while True:
+            if self.down.is_set():
+                return "down"
+            if self.finished.is_set():
+                return "finished"
+            if stop_event is not None and stop_event.is_set():
+                return None
+            if deadline is not None and time.monotonic() >= deadline:
+                return None
+            time.sleep(0.05)
+
+    def close(self) -> None:
+        self._halt.set()
+        self.join(timeout=2.0 + self._interval)
+
+
+class CheckpointTailer(threading.Thread):
+    """Keep the latest checkpoint restored IN MEMORY on the standby.
+
+    Polls ``checkpointer`` (with ``refresh()`` so steps written by the
+    primary's process become visible) and restores each new step into
+    ``template``'s structure as it lands. ``newest()`` then hands the
+    takeover path an already-resident state — the restore cost was
+    paid while the primary was still healthy, off everyone's critical
+    path. A restore that fails (e.g. the poll raced a slow finalize)
+    is logged and retried at the next poll; the previous good state is
+    kept."""
+
+    def __init__(
+        self,
+        checkpointer,
+        template: Any,
+        *,
+        poll_interval_s: float = 0.25,
+        log: Callable[[str], None] | None = None,
+    ):
+        super().__init__(name="checkpoint-tailer", daemon=True)
+        self._ckpt = checkpointer
+        self._template = template
+        self._interval = poll_interval_s
+        self._log = log if log is not None else (
+            lambda msg: print(f"[standby] {msg}", flush=True)
+        )
+        self._lock = threading.Lock()
+        self._step: Optional[int] = None
+        self._state: Any = None
+        self.restores = 0
+        self._halt = threading.Event()
+        self.start()
+
+    def _poll_once(self) -> None:
+        try:
+            self._ckpt.refresh()
+            latest = self._ckpt.latest_step()
+        except Exception as e:  # directory mid-write, fs hiccup: retry
+            self._log(f"checkpoint poll failed ({e!r}); retrying")
+            return
+        with self._lock:
+            have = self._step
+        if latest is None or latest == have:
+            return
+        try:
+            state = self._ckpt.restore(self._template, step=latest)
+        except Exception as e:
+            self._log(
+                f"tail restore of step {latest} failed ({e!r}); "
+                f"keeping step {have}"
+            )
+            return
+        with self._lock:
+            self._step, self._state = latest, state
+        self.restores += 1
+        self._log(f"tailed checkpoint step {latest} (restored, warm)")
+
+    def run(self) -> None:
+        while not self._halt.is_set():
+            self._poll_once()
+            self._halt.wait(self._interval)
+
+    def newest(self) -> Tuple[Optional[int], Any]:
+        """(step, state) of the newest restored checkpoint — the state
+        is live in this process's memory, not a path on disk."""
+        with self._lock:
+            return self._step, self._state
+
+    def close(self, *, final_poll: bool = True) -> None:
+        """Stop polling; with ``final_poll`` do one last synchronous
+        scan first (the primary's dying save may have just landed)."""
+        self._halt.set()
+        self.join(timeout=5.0 + self._interval)
+        if final_poll:
+            self._poll_once()
+
+
+# ---------------------------------------------------------------------
+# Coordinated preemption: one agreed stop step across learner hosts.
+# ---------------------------------------------------------------------
+
+class PreemptionLeader:
+    """Leader side of the SIGTERM stop-step consensus.
+
+    Construct at job start (followers connect early, while everything
+    is healthy); at preemption call ``decide(local_step)`` then, after
+    saving, ``barrier()``. The agreed step is ``max`` over every
+    reported step: hosts behind train up to it (their actors keep
+    feeding them until the learner exits), hosts at it stop — so every
+    host can actually REACH the agreed step, which a ``min`` rule
+    cannot guarantee (a host cannot save a past state it no longer
+    holds). A follower that dies before reporting is dropped from the
+    quorum after ``timeout_s`` with a loud log — a degraded save beats
+    no save during a preemption countdown."""
+
+    def __init__(
+        self,
+        *,
+        n_followers: int,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        log: Callable[[str], None] | None = None,
+    ):
+        self.n_followers = n_followers
+        self._log = log if log is not None else (
+            lambda msg: print(f"[preempt-leader] {msg}", flush=True)
+        )
+        self._lock = threading.Lock()
+        self._socks: List[socket.socket] = []
+        self._halt = threading.Event()
+        self._listener = socket.create_server((host, port))
+        self._listener.settimeout(0.2)
+        self.port = self._listener.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="preempt-leader-accept",
+            daemon=True,
+        )
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._halt.is_set():
+            with self._lock:
+                if len(self._socks) >= self.n_followers:
+                    break
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            with self._lock:
+                self._socks.append(conn)
+        self._listener.close()
+
+    def _wait_followers(self, deadline: float) -> List[socket.socket]:
+        while time.monotonic() < deadline:
+            with self._lock:
+                if len(self._socks) >= self.n_followers:
+                    return list(self._socks)
+            time.sleep(0.02)
+        with self._lock:
+            got = list(self._socks)
+        self._log(
+            f"only {len(got)}/{self.n_followers} followers connected by "
+            f"the consensus deadline; proceeding degraded"
+        )
+        return got
+
+    def _recv_each(
+        self,
+        socks: List[socket.socket],
+        expect_kind: int,
+        deadline: float,
+        what: str,
+    ) -> List[Optional[int]]:
+        """Recv one ``expect_kind`` frame from every socket
+        CONCURRENTLY, each against the full remaining deadline.
+        Sequential recvs would let one wedged peer (SIGSTOP, network
+        blackhole — socket open, nothing sent) consume the whole shared
+        budget and starve live-but-slow peers of their recv window.
+        Returns the frame tag per socket, None where the recv failed."""
+        results: List[Optional[int]] = [None] * len(socks)
+
+        def one(i: int, s: socket.socket) -> None:
+            try:
+                s.settimeout(max(0.1, deadline - time.monotonic()))
+                kind, tag, _ = recv_msg(s)
+                if kind != expect_kind:
+                    raise ConnectionError(f"expected {what}, got {kind}")
+                results[i] = int(tag)
+            except (socket.timeout, ConnectionError, OSError) as e:
+                self._log(f"follower lost during {what} ({e!r})")
+
+        threads = [
+            threading.Thread(
+                target=one, args=(i, s),
+                name=f"preempt-recv-{what}-{i}", daemon=True,
+            )
+            for i, s in enumerate(socks)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=max(0.1, deadline - time.monotonic()) + 1.0)
+        return results
+
+    def decide(self, local_step: int, timeout_s: float = 20.0) -> int:
+        """Collect every follower's step report, broadcast the agreed
+        stop step (max of all, including ours), return it."""
+        deadline = time.monotonic() + timeout_s
+        socks = self._wait_followers(deadline)
+        reports = self._recv_each(
+            socks, KIND_STEP_REPORT, deadline, "step report"
+        )
+        steps = [int(local_step)]
+        live: List[socket.socket] = []
+        for s, rep in zip(socks, reports):
+            if rep is not None:
+                steps.append(rep)
+                live.append(s)
+        agreed = max(steps)
+        for s in live:
+            try:
+                send_msg(s, KIND_STOP_STEP, agreed)
+            except OSError:
+                pass
+        # Only reporters stay in the quorum: a follower that was dead
+        # here cannot reach the agreed step, so barrier() must not
+        # wait on it again.
+        with self._lock:
+            self._socks = live
+        self._log(
+            f"stop-step consensus: reports {steps} -> agreed {agreed}"
+        )
+        return agreed
+
+    def barrier(self, timeout_s: float = 60.0) -> bool:
+        """Wait for every (surviving) follower's save-complete frame,
+        then release them all; True when the full quorum arrived."""
+        deadline = time.monotonic() + timeout_s
+        with self._lock:
+            socks = list(self._socks)
+        arrived = [
+            s
+            for s, got in zip(
+                socks,
+                self._recv_each(socks, KIND_BARRIER, deadline, "barrier"),
+            )
+            if got is not None
+        ]
+        for s in arrived:
+            try:
+                send_msg(s, KIND_BARRIER_OK)
+            except OSError:
+                pass
+        return len(arrived) == self.n_followers
+
+    def close(self) -> None:
+        self._halt.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self._accept_thread.join(timeout=2.0)
+        with self._lock:
+            socks, self._socks = self._socks, []
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class PreemptionFollower:
+    """Follower side: connect at job start, report at preemption, hold
+    the barrier until the leader releases."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        *,
+        connect_timeout: float = 10.0,
+        log: Callable[[str], None] | None = None,
+    ):
+        self._log = log if log is not None else (
+            lambda msg: print(f"[preempt-follower] {msg}", flush=True)
+        )
+        # Retry within the connect budget: hosts of one job come up in
+        # arbitrary order, and a follower that starts a beat before the
+        # leader binds must not crash the whole run.
+        deadline = time.monotonic() + connect_timeout
+        while True:
+            try:
+                self._sock = socket.create_connection(
+                    (host, port), timeout=max(0.2, connect_timeout / 10)
+                )
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.1)
+        self._sock.settimeout(None)
+
+    def decide(self, local_step: int, timeout_s: float = 20.0) -> int:
+        """Report our step; block for the leader's agreed stop step.
+        On a dead leader, fall back to our own step (save locally —
+        degraded beats nothing) with a loud log."""
+        try:
+            self._sock.settimeout(timeout_s)
+            send_msg(self._sock, KIND_STEP_REPORT, int(local_step))
+            kind, tag, _ = recv_msg(self._sock)
+            if kind != KIND_STOP_STEP:
+                raise ConnectionError(f"expected STOP_STEP, got {kind}")
+            return int(tag)
+        except (socket.timeout, ConnectionError, OSError) as e:
+            self._log(
+                f"leader unreachable during consensus ({e!r}); saving at "
+                f"the local step {local_step} (UNCOORDINATED)"
+            )
+            return int(local_step)
+
+    def barrier(self, timeout_s: float = 60.0) -> bool:
+        try:
+            self._sock.settimeout(timeout_s)
+            send_msg(self._sock, KIND_BARRIER)
+            kind, _, _ = recv_msg(self._sock)
+            return kind == KIND_BARRIER_OK
+        except (socket.timeout, ConnectionError, OSError) as e:
+            self._log(f"barrier release never arrived ({e!r})")
+            return False
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -140,6 +140,7 @@ class ResilientActorClient:
         idle_timeout_s: float | None = 120.0,
         connect_timeout: float = 10.0,
         max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+        hello: Tuple[int, int, int] | None = None,
         rng: random.Random | None = None,
     ):
         self._host, self._port = host, port
@@ -148,6 +149,9 @@ class ResilientActorClient:
         self._idle = idle_timeout_s
         self._connect_timeout = connect_timeout
         self._max_frame_bytes = max_frame_bytes
+        # (actor_id, generation, role): re-announced on EVERY reconnect,
+        # so the server's connection provenance survives link churn.
+        self._hello = hello
         self._rng = rng if rng is not None else random.Random()
         self._lock = threading.Lock()
         self._client: ActorClient | None = None
@@ -168,6 +172,7 @@ class ResilientActorClient:
                 heartbeat_interval_s=self._heartbeat,
                 idle_timeout_s=self._idle,
                 max_frame_bytes=self._max_frame_bytes,
+                hello=self._hello,
             )
             if self._ever_connected:
                 self.reconnects += 1
@@ -320,7 +325,7 @@ class ChaosProxy:
     """
 
     def __init__(self, target_host: str, target_port: int,
-                 *, host: str = "127.0.0.1"):
+                 *, host: str = "127.0.0.1", port: int = 0):
         self._lock = threading.Lock()
         self._target = (target_host, target_port)
         self._delay = 0.0
@@ -333,7 +338,9 @@ class ChaosProxy:
         self.connections_total = 0
         self.corrupted_chunks = 0
         self._stop = threading.Event()
-        self._listener = socket.create_server((host, 0))
+        # port 0 = ephemeral (tests); the control-plane Redirector binds
+        # a FIXED port — it is the stable address the actor fleet keeps.
+        self._listener = socket.create_server((host, port))
         self._listener.settimeout(0.1)
         self.port = self._listener.getsockname()[1]
         self._threads: List[threading.Thread] = []

@@ -19,11 +19,17 @@ integer headers ever cross the socket, so a malicious peer can at worst
 send garbage data, not code):
 
   frame   := MAGIC(4) kind(u8) tag(u64) n_arrays(u32) array*
-  array   := dtype_len(u8) dtype_str ndim(u8) dim(u64)* payload_len(u64) payload
+  array   := dtype_len(u8) dtype_str ndim(u8) dim(u64)* payload_len(u64)
+             crc32(u32) payload
 
 ``tag`` is message-dependent: the param version for PARAMS/ACK frames,
 the count of trajectory leaves (vs trailing episode-info leaves) for
-TRAJ frames.
+TRAJ frames. ``crc32`` is the zlib CRC-32 of the payload bytes,
+verified by ``recv_msg`` BEFORE the arrays are handed upward: bit flips
+inside a payload (flaky DCN links, buggy middleboxes) surface as a
+clean ``ChecksumError`` at the wire instead of NaN-shaped garbage
+deep inside training — the corruption class header validation cannot
+catch (the frame structure is intact, only the data is wrong).
 
 Fault tolerance (see ``distributed.resilience`` for the retry layer):
 
@@ -50,6 +56,7 @@ import socket
 import struct as struct_lib
 import threading
 import time
+import zlib
 from typing import Callable, Dict, List, Sequence, Tuple
 
 import numpy as np
@@ -62,6 +69,17 @@ KIND_PARAMS = 4       # learner -> actor: tag = version, arrays = leaves
 KIND_CLOSE = 5        # either side: orderly shutdown
 KIND_PING = 6         # heartbeat probe (tag echoed back)
 KIND_PONG = 7         # heartbeat reply
+# --- control plane (distributed.controlplane) ------------------------
+KIND_HELLO = 8        # peer -> learner: [actor_id, generation, role]
+KIND_HANDOFF = 9      # learner -> standby: take over NOW (planned handoff)
+KIND_STEP_REPORT = 10  # follower -> leader: tag = local step at preemption
+KIND_STOP_STEP = 11    # leader -> follower: tag = agreed final step
+KIND_BARRIER = 12      # follower -> leader: reached the agreed step + saved
+KIND_BARRIER_OK = 13   # leader -> follower: everyone arrived; exit now
+
+# KIND_HELLO role field values.
+ROLE_ACTOR = 0
+ROLE_STANDBY = 1
 
 _HEADER = struct_lib.Struct(">4sBQI")
 _ARRAY_HEADER = struct_lib.Struct(">B")
@@ -84,29 +102,54 @@ class LearnerShutdown(ConnectionError):
     done — exit quietly" from a transport fault worth retrying."""
 
 
-def frame_views(kind: int, tag: int, arrays: Sequence[np.ndarray]) -> list:
+class ChecksumError(ConnectionError):
+    """A payload's CRC-32 disagreed with its header.
+
+    The frame structure was intact but the data inside it was not —
+    corruption in flight. Subclasses ``ConnectionError`` so the
+    resilient client reconnects and re-pushes (at-least-once delivery
+    makes that free); the server counts these separately
+    (``transport_checksum_failures``) because silent payload corruption
+    is a different operational signal than a dropped peer."""
+
+
+def frame_views(
+    kind: int,
+    tag: int,
+    arrays: Sequence[np.ndarray],
+    crcs: Sequence[int] | None = None,
+) -> list:
     """Frame as a scatter-gather list: small header ``bytes`` objects
     interleaved with zero-copy ``memoryview``s of the array payloads.
     Nothing is serialized with ``tobytes()`` and nothing is joined —
     the kernel gathers the pieces straight off the caller's buffers
     (vectored writes). The caller must not mutate the arrays until the
-    send completes."""
+    send completes. ``crcs`` supplies precomputed per-array CRC-32
+    digests for payloads that are sent repeatedly (param publishes go
+    to every actor — recomputing a GB-scale CRC per peer would put
+    redundant full-payload passes on the connection threads)."""
     parts: list = [_HEADER.pack(MAGIC, kind, tag, len(arrays))]
-    for a in arrays:
+    for i, a in enumerate(arrays):
         a = np.asarray(a)
         shape = a.shape  # before ascontiguousarray, which promotes 0-d to 1-d
         a = np.ascontiguousarray(a)
         dtype = a.dtype.str.encode()
+        # Per-leaf integrity: CRC-32 over the payload bytes rides in the
+        # header. One read pass over data that is about to cross the
+        # kernel boundary anyway — measured in PERF.md (control plane).
+        payload = memoryview(a).cast("B") if a.nbytes else b""
+        crc = zlib.crc32(payload) if crcs is None else crcs[i]
         header = (
             _ARRAY_HEADER.pack(len(dtype))
             + dtype
             + struct_lib.pack(">B", len(shape))
             + struct_lib.pack(f">{len(shape)}Q", *shape)
             + struct_lib.pack(">Q", a.nbytes)
+            + struct_lib.pack(">I", crc)
         )
         parts.append(header)
         if a.nbytes:  # 0-size views cannot cast; they carry no payload
-            parts.append(memoryview(a).cast("B"))
+            parts.append(payload)
     return parts
 
 
@@ -166,8 +209,9 @@ def send_msg(
     kind: int,
     tag: int = 0,
     arrays: Sequence[np.ndarray] = (),
+    crcs: Sequence[int] | None = None,
 ) -> None:
-    _sendmsg_all(sock, frame_views(kind, tag, arrays))
+    _sendmsg_all(sock, frame_views(kind, tag, arrays, crcs))
 
 
 def recv_msg(
@@ -229,12 +273,24 @@ def recv_msg(
                 f"{nbytes}"
             )
         budget -= nbytes
+        (crc_want,) = struct_lib.unpack(">I", _recv_exact(sock, 4))
         buf = (
             alloc(nbytes) if alloc is not None
             else np.empty(nbytes, dtype=np.uint8)
         )
+        payload = memoryview(buf).cast("B")[:nbytes]
         if nbytes:
-            _recv_exact_into(sock, memoryview(buf).cast("B")[:nbytes])
+            _recv_exact_into(sock, payload)
+        crc_got = zlib.crc32(payload) if nbytes else zlib.crc32(b"")
+        if crc_got != crc_want:
+            # Valid framing, rotten data: in-flight corruption. Fail the
+            # connection (the stream's integrity is no longer trusted);
+            # the resilient client reconnects and re-pushes.
+            raise ChecksumError(
+                f"frame array checksum mismatch (crc32 {crc_got:#010x} != "
+                f"header {crc_want:#010x}, {nbytes} bytes) — payload "
+                f"corrupted in flight"
+            )
         try:
             arrays.append(buf[:nbytes].view(dtype).reshape(shape))
         except (ValueError, TypeError) as e:
@@ -247,6 +303,18 @@ def _set_nodelay(sock: socket.socket) -> None:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     except OSError:
         pass  # non-TCP socket (e.g. socketpair in tests)
+
+
+@dataclasses.dataclass(frozen=True)
+class PeerInfo:
+    """Connection-level provenance handed to 3-arg ``on_trajectory``
+    callbacks: identity from the hello frame (or -1s if the peer never
+    sent one), which no later payload corruption can alter."""
+
+    cid: int
+    actor_id: int
+    generation: int
+    role: int
 
 
 @dataclasses.dataclass
@@ -262,6 +330,12 @@ class _Conn:
     bytes_in: int = 0
     trajectories: int = 0
     rejected: int = 0
+    # Connection-level provenance from the KIND_HELLO frame: who is on
+    # the other end, independent of anything inside later payloads
+    # (quarantine attribution must survive corrupt episode-info).
+    actor_id: int = -1
+    generation: int = -1
+    role: int = ROLE_ACTOR
     send_lock: threading.Lock = dataclasses.field(
         default_factory=threading.Lock
     )
@@ -278,7 +352,12 @@ class LearnerServer:
     training-health validator quarantining a poison trajectory): the
     server still ACKs — an unacked frame would just be re-pushed, and
     re-pushing poison is pointless — but counts it under
-    ``transport_rejected`` / the per-connection registry.
+    ``transport_rejected`` / the per-connection registry. A callback
+    accepting THREE parameters additionally receives a ``PeerInfo``
+    with the connection's hello-frame provenance (actor id +
+    generation), which is attribution the payload cannot forge — the
+    validator can quarantine the right actor even when the episode-info
+    leaves themselves are the corrupt part.
 
     Fault tolerance: each connection lives in a registry with liveness
     and byte/frame counters (``metrics()``/``connections()``); a peer
@@ -301,6 +380,15 @@ class LearnerServer:
         log: Callable[[str], None] | None = None,
     ):
         self._on_trajectory = on_trajectory
+        # A 3-parameter callback opts into connection provenance
+        # (PeerInfo from the hello frame) alongside the leaves.
+        try:
+            import inspect
+
+            n_params = len(inspect.signature(on_trajectory).parameters)
+        except (TypeError, ValueError):
+            n_params = 2
+        self._pass_peer = n_params >= 3
         self._idle_timeout = idle_timeout_s
         self._max_frame_bytes = max_frame_bytes
         self._log = log if log is not None else (
@@ -308,6 +396,7 @@ class LearnerServer:
         )
         self._params_lock = threading.Lock()
         self._param_leaves: List[np.ndarray] = []
+        self._param_crcs: List[int] = []
         self._version = 0
         self._stopping = threading.Event()
         self._closing = threading.Event()  # graceful drain in progress
@@ -325,6 +414,9 @@ class LearnerServer:
         self._trajectories = 0
         self._rejected = 0
         self._pings = 0
+        self._hellos = 0
+        self._checksum_failures = 0
+        self._handoffs_sent = 0
         self._listener = socket.create_server((host, port))
         self._listener.settimeout(0.2)
         self.port = self._listener.getsockname()[1]
@@ -335,8 +427,20 @@ class LearnerServer:
 
     def publish(self, param_leaves: Sequence[np.ndarray]) -> int:
         """Publish new weights; returns the new version."""
+        leaves = [
+            np.ascontiguousarray(np.asarray(p)) for p in param_leaves
+        ]
+        # CRC once per PUBLISH, not once per actor send: the payload is
+        # byte-identical for every peer fetching this version, so with
+        # K actors the connection threads would otherwise burn K full
+        # passes over GB-scale params per publish.
+        crcs = [
+            zlib.crc32(memoryview(a).cast("B")) if a.nbytes else 0
+            for a in leaves
+        ]
         with self._params_lock:
-            self._param_leaves = [np.asarray(p) for p in param_leaves]
+            self._param_leaves = leaves
+            self._param_crcs = crcs
             self._version += 1
             return self._version
 
@@ -358,6 +462,9 @@ class LearnerServer:
                 "transport_trajectories": self._trajectories,
                 "transport_rejected": self._rejected,
                 "transport_pings": self._pings,
+                "transport_hellos": self._hellos,
+                "transport_checksum_failures": self._checksum_failures,
+                "transport_handoffs_sent": self._handoffs_sent,
             }
 
     def connections(self) -> List[dict]:
@@ -374,6 +481,9 @@ class LearnerServer:
                     "bytes_in": c.bytes_in,
                     "trajectories": c.trajectories,
                     "rejected": c.rejected,
+                    "actor_id": c.actor_id,
+                    "generation": c.generation,
+                    "role": c.role,
                 }
                 for c in self._conns.values()
             ]
@@ -411,9 +521,11 @@ class LearnerServer:
             self._conn_threads.append(t)
         self._listener.close()
 
-    def _send(self, c: _Conn, kind: int, tag: int = 0, arrays=()) -> None:
+    def _send(
+        self, c: _Conn, kind: int, tag: int = 0, arrays=(), crcs=None
+    ) -> None:
         with c.send_lock:
-            send_msg(c.sock, kind, tag, arrays)
+            send_msg(c.sock, kind, tag, arrays, crcs)
 
     def _retire(self, c: _Conn, reason: str) -> None:
         with self._reg_lock:
@@ -470,7 +582,16 @@ class LearnerServer:
                     elif kind == KIND_PING:
                         self._pings += 1
                 if kind == KIND_TRAJ:
-                    ok = self._on_trajectory(arrays[:tag], arrays[tag:])
+                    if self._pass_peer:
+                        with self._reg_lock:
+                            peer = PeerInfo(
+                                c.cid, c.actor_id, c.generation, c.role
+                            )
+                        ok = self._on_trajectory(
+                            arrays[:tag], arrays[tag:], peer
+                        )
+                    else:
+                        ok = self._on_trajectory(arrays[:tag], arrays[tag:])
                     if ok is False:
                         with self._reg_lock:
                             c.rejected += 1
@@ -478,15 +599,42 @@ class LearnerServer:
                     self._send(c, KIND_ACK, self._version)
                 elif kind == KIND_GET_PARAMS:
                     with self._params_lock:
-                        leaves, version = self._param_leaves, self._version
-                    self._send(c, KIND_PARAMS, version, leaves)
+                        leaves, crcs, version = (
+                            self._param_leaves,
+                            self._param_crcs,
+                            self._version,
+                        )
+                    self._send(c, KIND_PARAMS, version, leaves, crcs=crcs)
                 elif kind == KIND_PING:
                     self._send(c, KIND_PONG, tag)
+                elif kind == KIND_HELLO:
+                    # Identity announcement: [actor_id, generation, role].
+                    # One-way (no reply) so the client never blocks on it.
+                    ident = (
+                        np.asarray(arrays[0]).reshape(-1)
+                        if arrays else np.empty(0, np.int64)
+                    )
+                    with self._reg_lock:
+                        if ident.size >= 1:
+                            c.actor_id = int(ident[0])
+                        if ident.size >= 2:
+                            c.generation = int(ident[1])
+                        if ident.size >= 3:
+                            c.role = int(ident[2])
+                        self._hellos += 1
                 elif kind == KIND_CLOSE:
                     reason = "graceful"
                     break
                 else:
                     raise ConnectionError(f"unknown frame kind {kind}")
+        except ChecksumError as e:
+            with self._reg_lock:
+                self._checksum_failures += 1
+            if not self._stopping.is_set():
+                self._log(
+                    f"actor#{c.cid} ({c.addr}) payload corrupt: {e}; "
+                    f"recycling connection"
+                )
         except (ConnectionError, OSError) as e:
             # Not the old silent ``except: pass`` — a lost actor is an
             # event the learner should report (it keeps training on the
@@ -500,6 +648,35 @@ class LearnerServer:
         finally:
             self._retire(c, reason)
             conn.close()
+
+    def broadcast_handoff(self) -> int:
+        """Tell connected STANDBY peers (hello role == ROLE_STANDBY) to
+        take over now — the planned-handoff path (e.g. draining this
+        learner for maintenance). Actors never see the frame (their
+        protocol would reject the unexpected kind); returns how many
+        standbys were told."""
+        with self._reg_lock:
+            standbys = [
+                c for c in self._conns.values() if c.role == ROLE_STANDBY
+            ]
+        told = 0
+        for c in standbys:
+            if c.send_lock.acquire(timeout=0.5):
+                try:
+                    send_msg(c.sock, KIND_HANDOFF, self._version)
+                    told += 1
+                except OSError:
+                    pass
+                finally:
+                    c.send_lock.release()
+        with self._reg_lock:
+            self._handoffs_sent += told
+            n_conns = len(self._conns)
+        self._log(
+            f"handoff broadcast: {told} standby(s) told "
+            f"({n_conns} connections registered)"
+        )
+        return told
 
     def _broadcast_close(self) -> None:
         with self._reg_lock:
@@ -582,6 +759,7 @@ class ActorClient:
         heartbeat_interval_s: float | None = None,
         idle_timeout_s: float | None = None,
         max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+        hello: Tuple[int, int, int] | None = None,
     ):
         self._sock = socket.create_connection(
             (host, port), timeout=connect_timeout
@@ -591,6 +769,13 @@ class ActorClient:
         self._heartbeat = heartbeat_interval_s
         self._idle = idle_timeout_s
         self._max_frame_bytes = max_frame_bytes
+        if hello is not None:
+            # Announce (actor_id, generation, role) at connect time so
+            # the server has connection-level provenance before any
+            # payload arrives. Fire-and-forget: no reply to wait on.
+            self._send(
+                KIND_HELLO, 0, [np.asarray(list(hello), np.int64)]
+            )
 
     def _send(self, kind: int, tag: int = 0, arrays=()) -> None:
         """Send one frame; with an idle deadline configured, a send that

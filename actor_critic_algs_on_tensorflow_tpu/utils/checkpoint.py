@@ -98,6 +98,17 @@ class Checkpointer:
         self.wait()
         return True
 
+    def refresh(self) -> None:
+        """Re-scan the checkpoint directory for steps written by a
+        DIFFERENT process. Orbax caches the step list at construction,
+        so a standby tailing a primary's checkpoint directory
+        (``distributed.controlplane.CheckpointTailer``) must reload
+        before each ``latest_step`` poll or it will never see the
+        primary's progress."""
+        reload_fn = getattr(self._mgr, "reload", None)
+        if reload_fn is not None:
+            reload_fn()
+
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 

@@ -215,6 +215,21 @@ class TrainingHealthSentinel:
     copies are what keep ring entries/restores from aliasing donated
     buffers. ``exec_lock`` (CPU-mesh mode) serializes the copy
     dispatches against other executions, same rule as the learner loop.
+
+    ``delayed=True`` checks step i-1's guard scalars at step i: by the
+    time ``after_step(i)`` runs, step i has been dispatched and step
+    i-1 has long retired, so the ``device_get`` of its metrics returns
+    without stalling the dispatch pipeline — the guard's device
+    round-trip (~8% of a 12 ms CPU step, PERF.md) hides behind run-
+    ahead. The price is ONE extra step of rollback lag: a trip is
+    detected one step late, so the bad step AND the step dispatched
+    after it are both discarded. Snapshot hygiene is preserved by
+    promotion: a due snapshot is copied immediately but enters the
+    last-good ring only after ITS OWN verdict arrives clean on the
+    next check — the ring can never hold a state whose guard had not
+    yet passed. The loop must call ``flush(state)`` after its final
+    step so the last pending verdict is resolved before any final
+    checkpoint is written.
     """
 
     def __init__(
@@ -226,15 +241,25 @@ class TrainingHealthSentinel:
         ring_capacity: int = 2,
         snapshot_interval: int = 20,
         check_interval: int = 1,
+        delayed: bool = False,
         detector: DivergenceDetector | None = None,
+        merge: Callable[[Any, Any], Any] | None = None,
         exec_lock: threading.Lock | None = None,
         log: Callable[[str], None] | None = None,
     ):
         self._copy_state = copy_state
         self._publish = publish
+        # Partial-state guarding: ``copy_state`` may snapshot only the
+        # slice of the state a bad step can poison (e.g. params +
+        # opt_state, NOT a multi-GB replay ring whose contents are
+        # data); ``merge(current, restored_slice)`` then grafts the
+        # restored slice back onto the current full state at rollback.
+        # None = snapshots are complete states (the IMPALA default).
+        self._merge = merge
         self.max_rollbacks = max_rollbacks
         self.snapshot_interval = max(1, snapshot_interval)
         self.check_interval = max(1, check_interval)
+        self.delayed = delayed
         self._detector = detector
         self._exec_lock = exec_lock
         self._log = log if log is not None else (
@@ -247,12 +272,17 @@ class TrainingHealthSentinel:
         self.snapshots = 0
         self._ok_checks = 0
         self.last_good_step = -1
+        # Delayed mode: the unresolved (it, metrics) from the previous
+        # call, and a snapshot copied but not yet verdict-promoted.
+        self._pending: Optional[Tuple[int, Any]] = None
+        self._pending_snapshot: Optional[Tuple[int, Any]] = None
 
-    def _copy(self, state: Any) -> Any:
+    def _copy(self, state: Any, fn: Callable[[Any], Any] | None = None) -> Any:
+        fn = self._copy_state if fn is None else fn
         if self._exec_lock is None:
-            return self._copy_state(state)
+            return fn(state)
         with self._exec_lock:
-            out = self._copy_state(state)
+            out = fn(state)
             jax.block_until_ready(out)
             return out
 
@@ -264,13 +294,10 @@ class TrainingHealthSentinel:
         self.snapshots += 1
         self.last_good_step = it
 
-    def after_step(self, it: int, state: Any, metrics) -> Any:
-        """Check the guard scalars of the step that just ran; returns
-        the (possibly rolled-back) state to continue from."""
-        if (it + 1) % self.check_interval:
-            return state
-        # With the divergence tripwires off (the default), only the one
-        # guard bit leaves the device.
+    def _verdict(self, metrics) -> Optional[str]:
+        """Fetch the guard scalars of one step and judge them; counts
+        the check. With the divergence tripwires off (the default),
+        only the one guard bit leaves the device."""
         if self._detector is not None and self._detector.enabled:
             wanted = ("health_finite", "loss", "grad_norm")
         else:
@@ -280,20 +307,20 @@ class TrainingHealthSentinel:
         )
         vals = {k: float(v) for k, v in vals.items()}
         self.checks += 1
-        reason = None
         if vals.get("health_finite", 1.0) < 0.5:
-            reason = "non-finite loss/grads/params"
-        elif self._detector is not None and self._detector.enabled:
-            reason = self._detector.observe(
+            return "non-finite loss/grads/params"
+        if self._detector is not None and self._detector.enabled:
+            return self._detector.observe(
                 vals.get("loss"), vals.get("grad_norm")
             )
-        if reason is None:
-            self._ok_checks += 1
-            if self._ok_checks % self.snapshot_interval == 0:
-                self._ring.push(it, self._copy(state))
-                self.snapshots += 1
-                self.last_good_step = it
-            return state
+        return None
+
+    def _trip(self, it: int, reason: str, current: Any) -> Any:
+        """Roll back to the newest verified snapshot (or raise once the
+        budget is spent); returns the restored state. ``current`` is
+        the in-flight (bad-lineage) state — with a ``merge`` hook the
+        restored SLICE is grafted onto it (its unguarded parts, e.g.
+        the replay ring, are data and stay)."""
         self.trips += 1
         if self.rollbacks >= self.max_rollbacks:
             raise RuntimeError(
@@ -303,7 +330,16 @@ class TrainingHealthSentinel:
             )
         self.rollbacks += 1
         tag, good = self._ring.newest()
-        state = self._copy(good)
+        # With a merge hook the ring holds SLICES, not full states, so
+        # the slicing copy_state cannot re-copy its own output — use a
+        # structure-generic tree copy for the restore instead.
+        state = self._copy(
+            good,
+            None if self._merge is None
+            else (lambda t: jax.tree_util.tree_map(jnp.copy, t)),
+        )
+        if self._merge is not None:
+            state = self._merge(current, state)
         self._log(
             f"guard tripped at iteration {it} ({reason}); rolled back to "
             f"last-good snapshot from iteration {tag} "
@@ -311,6 +347,76 @@ class TrainingHealthSentinel:
             f"re-publishing params"
         )
         self._publish(state.params)
+        return state
+
+    def _resolve_pending(self, state: Any) -> Tuple[Any, bool]:
+        """Delayed mode: judge the step whose metrics were held from
+        the previous call. Returns ``(state, tripped)`` — on a trip the
+        returned state is the ring restore and the CURRENT in-flight
+        state (computed from the bad lineage) is discarded with it."""
+        if self._pending is None:
+            return state, False
+        it0, metrics = self._pending
+        self._pending = None
+        reason = self._verdict(metrics)
+        if reason is None:
+            self._ok_checks += 1
+            if self._pending_snapshot is not None:
+                # This verdict covers the held snapshot's own step:
+                # clean, so it finally enters the last-good ring.
+                tag, snap = self._pending_snapshot
+                self._pending_snapshot = None
+                self._ring.push(tag, snap)
+                self.snapshots += 1
+                self.last_good_step = tag
+            return state, False
+        # The held snapshot (if any) is from the bad lineage too.
+        self._pending_snapshot = None
+        return (
+            self._trip(it0, f"{reason}; detected one step late", state),
+            True,
+        )
+
+    def after_step(self, it: int, state: Any, metrics) -> Any:
+        """Check the guard scalars (of the step that just ran, or — in
+        delayed mode — of the previous step); returns the (possibly
+        rolled-back) state to continue from."""
+        if self.delayed:
+            state, tripped = self._resolve_pending(state)
+            if tripped:
+                # The metrics in hand belong to the discarded lineage;
+                # judging them next call would double-count the event.
+                return state
+            if (it + 1) % self.check_interval == 0:
+                if (
+                    self._pending_snapshot is None
+                    and (self._ok_checks + 1) % self.snapshot_interval == 0
+                ):
+                    # Copy now (before donation recycles these buffers),
+                    # promote only once this step's own verdict is in.
+                    self._pending_snapshot = (it, self._copy(state))
+                self._pending = (it, metrics)
+            return state
+
+        if (it + 1) % self.check_interval:
+            return state
+        reason = self._verdict(metrics)
+        if reason is None:
+            self._ok_checks += 1
+            if self._ok_checks % self.snapshot_interval == 0:
+                self._ring.push(it, self._copy(state))
+                self.snapshots += 1
+                self.last_good_step = it
+            return state
+        return self._trip(it, reason, state)
+
+    def flush(self, state: Any) -> Any:
+        """Resolve the final pending verdict (delayed mode) so the loop
+        never checkpoints a state whose last step went unchecked.
+        No-op in immediate mode."""
+        if not self.delayed:
+            return state
+        state, _ = self._resolve_pending(state)
         return state
 
     def metrics(self) -> Dict[str, float]:
@@ -328,14 +434,24 @@ class TrajectoryValidator:
 
     ``admit(traj, ep)`` returns True to let a trajectory into the
     queue/arena. A trajectory fails when any float leaf of
-    obs/rewards/last_obs/dones is non-finite or the behaviour
-    log-probs exceed ``logit_bound`` in magnitude. Failures are
-    dropped-and-recorded; ``quarantine_threshold`` CONSECUTIVE failures
-    from one actor (provenance = the ``actor_id`` leaf each rollout
-    carries in its episode-info) quarantine that actor: every further
-    push from it is dropped and it is flagged for respawn through the
-    existing actor-generation mechanism (``take_respawns`` →
-    ``reset_actor`` once the fresh generation is up).
+    obs/rewards/last_obs/dones is non-finite, the behaviour log-probs
+    exceed ``logit_bound`` in magnitude, a discrete action falls
+    outside ``[0, num_actions)`` (a corrupt int payload — 0xFF bytes
+    decode to −1 — is finite, so the NaN checks sail past it), or —
+    with ``obs_bound`` set — an observation's magnitude exceeds it.
+    ``obs_bound`` is for runs whose observations are normalized (or
+    otherwise bounded by construction): running mean/std normalization
+    clips to ±10σ-style ranges, so anything far outside the bound is
+    corruption, not data; leave it 0 (disabled) for raw unbounded
+    observations. Failures are dropped-and-recorded;
+    ``quarantine_threshold`` CONSECUTIVE failures from one actor
+    (provenance = the ``actor_id`` leaf each rollout carries in its
+    episode-info, or — stronger — the connection-level id from the
+    transport hello frame passed as ``admit(..., source_actor_id=...)``,
+    which payload corruption cannot alter) quarantine that actor: every
+    further push from it is dropped and it is flagged for respawn
+    through the existing actor-generation mechanism (``take_respawns``
+    → ``reset_actor`` once the fresh generation is up).
 
     ``reset_actor`` lifts the quarantine ON PROBATION: provenance is
     actor id only (not generation), so poison the DEAD generation left
@@ -363,10 +479,14 @@ class TrajectoryValidator:
         self,
         *,
         logit_bound: float = 1e4,
+        num_actions: int | None = None,
+        obs_bound: float = 0.0,
         quarantine_threshold: int = 3,
         log: Callable[[str], None] | None = None,
     ):
         self.logit_bound = logit_bound
+        self.num_actions = num_actions
+        self.obs_bound = obs_bound
         self.quarantine_threshold = max(1, quarantine_threshold)
         self._log = log if log is not None else (
             lambda msg: print(f"[sentinel] {msg}", flush=True)
@@ -415,10 +535,45 @@ class TrajectoryValidator:
                     f"behaviour_log_probs out of bounds "
                     f"(|x| > {self.logit_bound:g})"
                 )
+        actions = getattr(traj, "actions", None)
+        if self.num_actions is not None and actions is not None:
+            a = np.asarray(actions)
+            if np.issubdtype(a.dtype, np.integer) and a.size:
+                lo, hi = int(a.min()), int(a.max())
+                if lo < 0 or hi >= self.num_actions:
+                    # Finite-but-wrong ints (0xFF payload bytes decode
+                    # to -1) that the NaN checks cannot see.
+                    return (
+                        f"discrete action out of range "
+                        f"([{lo}, {hi}] vs [0, {self.num_actions}))"
+                    )
+        if self.obs_bound > 0:
+            for field in ("obs", "last_obs"):
+                for leaf in jax.tree_util.tree_leaves(
+                    getattr(traj, field, None)
+                ):
+                    a = np.asarray(leaf)
+                    if (
+                        np.issubdtype(a.dtype, np.inexact)
+                        and a.size
+                        and np.abs(a).max() > self.obs_bound
+                    ):
+                        return (
+                            f"{field} out of range "
+                            f"(|x| > {self.obs_bound:g})"
+                        )
         return None
 
-    def admit(self, traj: Any, ep: Any) -> bool:
-        aid = self._actor_id(ep)
+    def admit(self, traj: Any, ep: Any, source_actor_id: int = -1) -> bool:
+        """``source_actor_id`` (when >= 0) is connection-level
+        provenance from the transport hello frame — preferred over the
+        episode-info leaf, because a corrupt payload can scramble the
+        leaf but not the connection it arrived on."""
+        aid = (
+            int(source_actor_id)
+            if source_actor_id >= 0
+            else self._actor_id(ep)
+        )
         with self._lock:
             if aid in self._quarantined:
                 self.dropped += 1

@@ -1,0 +1,267 @@
+"""Control-plane ledger measurements (ISSUE 4, PERF.md "Control plane").
+
+Three legs, each printed as one line of evidence:
+
+  1. failover gap — kill the primary learner mid-run and measure
+     kill -> first learner step completed by the successor, for BOTH
+     recovery modes: the warm standby (programs compiled + checkpoint
+     tailed in memory before the kill) and the old-world
+     restart-from-disk (fresh process: import jax, compile, restore,
+     then serve). Same actor fleet, same redirector, same config.
+  2. delayed guard check — sentinel metrics fetch same-step vs
+     one-step-late over the identical learner_step stream (no actors:
+     isolates the fetch stall the delay exists to hide).
+  3. wire checksum cost — zlib.crc32 throughput over a typical
+     trajectory frame's payload bytes (the per-leaf CRC is one pass
+     over data that crosses the kernel boundary anyway).
+
+Run: JAX_PLATFORMS=cpu python scripts/controlplane_bench.py
+"""
+
+import dataclasses
+import os
+import signal
+import socket
+import sys
+import time
+import zlib
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from actor_critic_algs_on_tensorflow_tpu.algos import impala
+from actor_critic_algs_on_tensorflow_tpu.distributed.controlplane import (
+    Redirector,
+)
+from actor_critic_algs_on_tensorflow_tpu.utils import health
+from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import Checkpointer
+
+
+def _cfg(total_iters):
+    return impala.ImpalaConfig(
+        env="CartPole-v1", num_actors=2, envs_per_actor=4,
+        rollout_length=8, batch_trajectories=2, queue_size=4,
+        total_env_steps=2 * 4 * 8 * total_iters, num_devices=1,
+        transport_heartbeat_s=0.2, transport_idle_timeout_s=10.0,
+    )
+
+
+def _primary_main(cfg, port, ckpt_dir):
+    jax.config.update("jax_platforms", "cpu")
+    ckpt = Checkpointer(ckpt_dir, async_save=False)
+    impala.run_impala_distributed(
+        cfg, log_interval=1, log_fn=lambda s, m: None,
+        host="127.0.0.1", port=port,
+        checkpointer=ckpt, checkpoint_interval=2, external_actors=True,
+    )
+
+
+def _cold_restart_main(cfg, port, ckpt_dir, t0):
+    """The old world: fresh process restores from disk and serves."""
+    print(f"COLD_ENTER {time.time() - t0:.3f}", flush=True)  # spawn+imports
+    jax.config.update("jax_platforms", "cpu")
+    ckpt = Checkpointer(ckpt_dir, async_save=False)
+    template = jax.eval_shape(
+        impala.make_impala(cfg).init, jax.random.PRNGKey(cfg.seed)
+    )
+    state = ckpt.restore(template)
+    print(f"COLD_RESTORED {time.time() - t0:.3f}", flush=True)
+    first = []
+
+    def log_fn(s, m):
+        if not first:
+            first.append(time.time())
+            print(f"COLD_FIRST_STEP {first[0] - t0:.3f}", flush=True)
+
+    impala.run_impala_distributed(
+        cfg, log_interval=1, log_fn=log_fn,
+        host="127.0.0.1", port=port,
+        checkpointer=ckpt, checkpoint_interval=10**9,
+        initial_state=state, external_actors=True,
+    )
+
+
+def failover_leg(mode: str) -> float:
+    """Seconds from primary kill to the successor's first completed
+    learner step. mode: 'warm' | 'cold'."""
+    import multiprocessing as mp
+    import tempfile
+
+    ctx = mp.get_context("spawn")
+    tmp = tempfile.mkdtemp(prefix=f"failover-{mode}-")
+    cfg = _cfg(400)
+    probe = socket.create_server(("127.0.0.1", 0))
+    primary_port = probe.getsockname()[1]
+    probe.close()
+    redirector = Redirector("127.0.0.1", primary_port)
+    primary = ctx.Process(
+        target=_primary_main, args=(cfg, primary_port, tmp), daemon=True
+    )
+    primary.start()
+    actors = [
+        ctx.Process(
+            target=impala._actor_process_main,
+            args=(cfg, i, "127.0.0.1", redirector.port, 1000 + i, 0),
+            daemon=True,
+        )
+        for i in range(cfg.num_actors)
+    ]
+    for a in actors:
+        a.start()
+
+    reader = Checkpointer(tmp, async_save=False)
+    spb = cfg.batch_trajectories * cfg.envs_per_actor * cfg.rollout_length
+    while True:
+        reader.refresh()
+        latest = reader.latest_step()
+        if latest is not None and latest >= 4 * spb:
+            break
+        time.sleep(0.1)
+
+    gap = None
+    if mode == "warm":
+        # Standby compiles + tails BEFORE the kill (the steady state).
+        programs_ready = []
+        import threading
+
+        result = {}
+
+        def redirect(h, p):
+            result.setdefault("redirect_t", time.monotonic())
+            redirector.redirect(h, p)
+
+        def standby():
+            first = []
+
+            def log_fn(s, m):
+                if not first:
+                    first.append(time.monotonic())
+                    result["first_step_t"] = first[0]
+
+            impala.run_impala_standby(
+                cfg,
+                checkpointer=Checkpointer(tmp, async_save=False),
+                primary_host="127.0.0.1", primary_port=primary_port,
+                redirect=redirect,
+                heartbeat_interval_s=0.2, takeover_deadline_s=1.0,
+                log_interval=1, log_fn=log_fn,
+                checkpoint_interval=10**9,
+            )
+
+        t = threading.Thread(target=standby, daemon=True)
+        t.start()
+        time.sleep(8.0)  # let the standby warm-compile + tail
+        os.kill(primary.pid, signal.SIGKILL)
+        t_kill = time.monotonic()
+        t.join(timeout=570.0)
+        gap = result["first_step_t"] - t_kill
+        print(
+            f"FAILOVER_WARM_SPLIT detect+bind={result['redirect_t'] - t_kill:.3f}s "
+            f"redirect->first_step={result['first_step_t'] - result['redirect_t']:.3f}s",
+            flush=True,
+        )
+    else:
+        os.kill(primary.pid, signal.SIGKILL)
+        t0 = time.time()
+        # The cold learner reuses the primary's (now free) fixed port;
+        # it prints COLD_FIRST_STEP (seconds since the kill) to the
+        # inherited stdout — that line IS the measurement.
+        cold = ctx.Process(
+            target=_cold_restart_main,
+            args=(cfg, primary_port, tmp, t0), daemon=True,
+        )
+        cold.start()
+        redirector.redirect("127.0.0.1", primary_port)
+        cold.join(timeout=570.0)
+        gap = float("nan")
+    primary.join(timeout=5.0)
+    redirector.close()
+    for a in actors:
+        a.join(timeout=5.0)
+        if a.is_alive():
+            a.terminate()
+    reader.close()
+    return gap
+
+
+def guard_fetch_leg():
+    cfg = _cfg(1)
+    programs = impala.make_impala(cfg)
+    state = programs.init(jax.random.PRNGKey(0))
+    rollout, env_reset = programs.make_actor_programs(0)
+    env_state, obs, carry = env_reset(jax.random.PRNGKey(1))
+    env_state, obs, carry, traj, _ = rollout(
+        state.params, env_state, obs, carry, jax.random.PRNGKey(2)
+    )
+    batch = impala.stack_trajectories(
+        [traj] * cfg.batch_trajectories
+    )
+    step = programs.learner_step
+
+    def run(delayed, n=300):
+        s = programs.init(jax.random.PRNGKey(0))
+        sent = health.TrainingHealthSentinel(
+            copy_state=programs.copy_state, publish=lambda p: None,
+            delayed=delayed, snapshot_interval=50, log=lambda m: None,
+        )
+        sent.seed(s, -1)
+        s, m = step(s, batch)  # compile
+        t0 = time.perf_counter()
+        for i in range(n):
+            s, m = step(s, batch)
+            s = sent.after_step(i, s, m)
+        s = sent.flush(s)
+        jax.block_until_ready(s.params)
+        return n / (time.perf_counter() - t0)
+
+    # Interleaved reps (PERF.md measurement discipline).
+    imm, dly = [], []
+    for _ in range(3):
+        imm.append(run(False))
+        dly.append(run(True))
+    print(
+        f"GUARD_FETCH immediate={max(imm):.1f}/s delayed={max(dly):.1f}/s "
+        f"(best of 3 interleaved; speedup {max(dly) / max(imm):.3f}x)"
+    )
+
+
+def checksum_leg():
+    T, B = 32, 64
+    leaves = [
+        np.random.default_rng(0).random((T, B, 4)).astype(np.float32),
+        np.zeros((T, B), np.int32),
+        np.ones((T, B), np.float32),
+        np.zeros((T, B), np.float32),
+        -np.ones((T, B), np.float32),
+        np.zeros((B, 4), np.float32),
+    ]
+    total = sum(x.nbytes for x in leaves)
+    reps = 2000
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        for x in leaves:
+            zlib.crc32(memoryview(x).cast("B"))
+    dt = time.perf_counter() - t0
+    per_frame = dt / reps
+    print(
+        f"CHECKSUM frame={total / 1024:.0f}KiB crc_per_frame="
+        f"{per_frame * 1e6:.1f}us throughput={total * reps / dt / 1e9:.2f}GB/s"
+    )
+
+
+if __name__ == "__main__":
+    leg = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if leg in ("all", "checksum"):
+        checksum_leg()
+    if leg in ("all", "guard"):
+        guard_fetch_leg()
+    if leg in ("all", "warm"):
+        g = failover_leg("warm")
+        print(f"FAILOVER_WARM gap={g:.3f}s (kill -> first learner step)")
+    if leg in ("all", "cold"):
+        failover_leg("cold")  # prints COLD_FIRST_STEP from the child

@@ -59,6 +59,69 @@ def test_preempt_save_flag_and_sentinel_overrides():
     assert cfg.quarantine_threshold == 2
 
 
+def test_controlplane_flags_parse_and_validate():
+    """--standby/--coordinate-preemption/--redirector (ISSUE 4): spec
+    parsing and the impala-only / dependency guards."""
+    # Specs must carry explicit ports (they name peers, not binds).
+    with pytest.raises(SystemExit, match="explicit port"):
+        cli.parse_hostport("10.0.0.1", "--standby")
+    assert cli.parse_hostport("10.0.0.1:7000", "--standby") == (
+        "10.0.0.1", 7000,
+    )
+    with pytest.raises(SystemExit, match="lead:N@HOST:PORT"):
+        cli.make_coordinator("sideways:1")
+    with pytest.raises(SystemExit, match="follower count"):
+        cli.make_coordinator("lead@127.0.0.1:9000")
+    with pytest.raises(SystemExit, match="unknown role"):
+        cli.make_coordinator("boss:2@127.0.0.1:9000")
+    # Non-impala algos reject the control-plane flags outright.
+    args = cli.build_parser().parse_args(
+        ["--algo", "a2c", "--standby", "127.0.0.1:7000"]
+    )
+    with pytest.raises(SystemExit, match="impala-only"):
+        cli._run(args, "a2c", None, None)
+    args = cli.build_parser().parse_args(
+        ["--algo", "a2c", "--coordinate-preemption", "follow@h:1"]
+    )
+    with pytest.raises(SystemExit, match="impala-only"):
+        cli._run(args, "a2c", None, None)
+    # --redirector rides --standby; --standby needs the tail source.
+    args = cli.build_parser().parse_args(
+        ["--preset", "impala-cartpole", "--redirector", "7100"]
+    )
+    with pytest.raises(SystemExit, match="requires --standby"):
+        cli._run(args, "impala", None, None)
+    args = cli.build_parser().parse_args(
+        ["--preset", "impala-cartpole", "--standby", "127.0.0.1:7000"]
+    )
+    _, cfg = cli.make_config(args)
+    with pytest.raises(SystemExit, match="checkpoint-dir"):
+        cli._run(args, "impala", cfg, None)
+
+
+def test_coordinator_leader_follower_roundtrip_via_cli_specs():
+    """make_coordinator builds a working leader/follower pair."""
+    import threading
+
+    leader = cli.make_coordinator("lead:1@127.0.0.1:0")
+    try:
+        follower = cli.make_coordinator(f"follow@127.0.0.1:{leader.port}")
+        out = {}
+        t = threading.Thread(
+            target=lambda: out.setdefault(
+                "agreed", follower.decide(7, timeout_s=10.0)
+            ),
+            daemon=True,
+        )
+        t.start()
+        assert leader.decide(3, timeout_s=10.0) == 7
+        t.join(timeout=10.0)
+        assert out["agreed"] == 7
+        follower.close()
+    finally:
+        leader.close()
+
+
 def test_unknown_override_rejected():
     args = cli.build_parser().parse_args(
         ["--algo", "a2c", "--set", "nope=1"]

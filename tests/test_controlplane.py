@@ -1,0 +1,842 @@
+"""Control plane: warm-standby learner failover + coordinated
+multi-host preemption (ISSUE 4).
+
+Tier-1 units drive the control-plane pieces against real sockets and
+real checkpoints; the multi-process end-to-end scenarios (primary
+learner killed mid-run -> standby takeover; coordinated SIGTERM across
+two learner processes) are marked ``slow`` — each spawns several jax
+processes.
+"""
+
+import os
+import signal
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from actor_critic_algs_on_tensorflow_tpu.distributed.controlplane import (
+    CheckpointTailer,
+    PreemptionFollower,
+    PreemptionLeader,
+    PrimaryMonitor,
+    Redirector,
+)
+from actor_critic_algs_on_tensorflow_tpu.distributed.resilience import (
+    ResilientActorClient,
+    RetryPolicy,
+)
+from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+    ROLE_ACTOR,
+    ROLE_STANDBY,
+    ActorClient,
+    ChecksumError,
+    KIND_TRAJ,
+    LearnerServer,
+    pack_arrays,
+    recv_msg,
+)
+from tests.helpers import time_limit
+
+
+def _quiet_server(sink=None, **kw):
+    return LearnerServer(
+        sink if sink is not None else (lambda t, e: None),
+        log=lambda m: None,
+        **kw,
+    )
+
+
+def _mk_policy():
+    return RetryPolicy(base_delay_s=0.01, max_delay_s=0.05, deadline_s=15.0)
+
+
+# ---------------------------------------------------------------------
+# Wire integrity: per-leaf CRC-32.
+# ---------------------------------------------------------------------
+
+def test_checksum_rejects_flipped_payload_byte():
+    """A single payload bit flip — valid framing, rotten data — must
+    raise ChecksumError, not deserialize into garbage."""
+    frame = bytearray(
+        pack_arrays(KIND_TRAJ, 1, [np.arange(64, dtype=np.float32)])
+    )
+    frame[-17] ^= 0xFF  # deep inside the payload
+    a, b = socket.socketpair()
+    a.sendall(bytes(frame))
+    with pytest.raises(ChecksumError, match="checksum mismatch"):
+        recv_msg(b)
+    a.close()
+    b.close()
+
+
+def test_server_counts_checksum_failures_separately():
+    server = _quiet_server()
+    try:
+        sock = socket.create_connection(("127.0.0.1", server.port))
+        frame = bytearray(
+            pack_arrays(KIND_TRAJ, 1, [np.ones(256, np.float32)])
+        )
+        frame[200] ^= 0x55
+        sock.sendall(bytes(frame))
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if server.metrics()["transport_checksum_failures"] == 1:
+                break
+            time.sleep(0.02)
+        m = server.metrics()
+        assert m["transport_checksum_failures"] == 1
+        # Counted AND the connection recycled (stream no longer trusted).
+        assert m["transport_actors_connected"] == 0
+        sock.close()
+    finally:
+        server.close()
+
+
+# ---------------------------------------------------------------------
+# Hello frame: connection-level provenance.
+# ---------------------------------------------------------------------
+
+def test_hello_records_identity_in_registry():
+    server = _quiet_server()
+    try:
+        client = ActorClient(
+            "127.0.0.1", server.port, hello=(7, 2, ROLE_ACTOR)
+        )
+        client.push_trajectory([np.zeros(4, np.float32)])
+        (conn,) = server.connections()
+        assert conn["actor_id"] == 7
+        assert conn["generation"] == 2
+        assert conn["role"] == ROLE_ACTOR
+        assert server.metrics()["transport_hellos"] == 1
+        client.close()
+    finally:
+        server.close()
+
+
+def test_hello_provenance_reaches_trajectory_callback():
+    """A 3-arg on_trajectory callback receives PeerInfo — quarantine
+    attribution that corrupt episode-info leaves cannot scramble."""
+    peers = []
+
+    def sink(traj, ep, peer):
+        peers.append(peer)
+
+    server = _quiet_server(sink)
+    try:
+        client = ResilientActorClient(
+            "127.0.0.1", server.port,
+            retry=_mk_policy(),
+            heartbeat_interval_s=0.1, idle_timeout_s=2.0,
+            hello=(3, 1, ROLE_ACTOR),
+        )
+        client.push_trajectory([np.zeros(4, np.float32)])
+        assert peers and peers[0].actor_id == 3
+        assert peers[0].generation == 1
+        client.close()
+    finally:
+        server.close()
+
+
+def test_hello_reannounced_after_reconnect():
+    """Provenance must survive link churn: the resilient client sends
+    its hello again on every reconnect."""
+    with time_limit(30, "hello reconnect"):
+        server = _quiet_server()
+        proxy = Redirector("127.0.0.1", server.port)
+        try:
+            client = ResilientActorClient(
+                "127.0.0.1", proxy.port,
+                retry=_mk_policy(),
+                heartbeat_interval_s=0.1, idle_timeout_s=2.0,
+                hello=(5, 0, ROLE_ACTOR),
+            )
+            client.push_trajectory([np.zeros(4, np.float32)])
+            proxy.reset_all()
+            client.push_trajectory([np.zeros(4, np.float32)])
+            assert client.reconnects >= 1
+            assert server.metrics()["transport_hellos"] >= 2
+            # The dead link may not be retired yet; the NEWEST
+            # connection carries the re-announced identity.
+            conn = max(server.connections(), key=lambda c: c["cid"])
+            assert conn["actor_id"] == 5
+            client.close()
+        finally:
+            proxy.close()
+            server.close()
+
+
+# ---------------------------------------------------------------------
+# Redirector: the stable actor-facing endpoint.
+# ---------------------------------------------------------------------
+
+def test_redirector_moves_fleet_to_new_learner():
+    """Actors keep ONE address; redirect() points new connections at
+    the successor and resets live links so they fail over now."""
+    with time_limit(30, "redirect"):
+        got1, got2 = [], []
+        s1 = _quiet_server(lambda t, e: got1.append(int(t[0][0])))
+        s2 = _quiet_server(lambda t, e: got2.append(int(t[0][0])))
+        proxy = Redirector("127.0.0.1", s1.port)
+        try:
+            client = ResilientActorClient(
+                "127.0.0.1", proxy.port,
+                retry=_mk_policy(),
+                heartbeat_interval_s=0.1, idle_timeout_s=2.0,
+            )
+            client.push_trajectory([np.array([1], np.int64)])
+            assert got1 == [1]
+            n_reset = proxy.redirect("127.0.0.1", s2.port)
+            assert n_reset >= 1  # the live link was kicked over
+            client.push_trajectory([np.array([2], np.int64)])
+            assert got2 == [2] and got1 == [1]
+            assert client.reconnects >= 1
+            client.close()
+        finally:
+            proxy.close()
+            s1.close()
+            s2.close()
+
+
+# ---------------------------------------------------------------------
+# PrimaryMonitor: death / completion / explicit handoff.
+# ---------------------------------------------------------------------
+
+def test_monitor_detects_primary_death():
+    with time_limit(30, "monitor death"):
+        server = _quiet_server()
+        monitor = PrimaryMonitor(
+            "127.0.0.1", server.port,
+            interval_s=0.05, deadline_s=0.5, log=lambda m: None,
+        )
+        try:
+            deadline = time.monotonic() + 5.0
+            while monitor.pongs == 0 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert monitor.pongs >= 1  # healthy primary answers pings
+            assert not monitor.down.is_set()
+            server.close(graceful=False)  # crash, no goodbye
+            assert monitor.down.wait(5.0)
+            assert "no heartbeat" in monitor.reason or (
+                "unreachable" in monitor.reason
+            )
+            assert not monitor.finished.is_set()
+        finally:
+            monitor.close()
+            server.close()
+
+
+def test_monitor_never_seen_primary_gets_grace_not_deadline():
+    """A primary that has NEVER been reachable is "not up yet", not
+    dead: the plain deadline must not trigger a takeover (a standby
+    winning the start race would split the fleet); only the much
+    larger never-seen grace declares it down."""
+    with time_limit(30, "monitor never-seen"):
+        probe = socket.create_server(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()  # nothing ever listens here
+        monitor = PrimaryMonitor(
+            "127.0.0.1", port,
+            interval_s=0.05, deadline_s=0.3,
+            never_seen_grace_s=1.5, log=lambda m: None,
+        )
+        try:
+            # Well past the ordinary deadline: still just waiting.
+            assert not monitor.down.wait(0.9)
+            # ...but the grace bounds the wait (a standby restarted
+            # after the primary truly died still takes over).
+            assert monitor.down.wait(5.0)
+            assert "never seen" in monitor.reason
+        finally:
+            monitor.close()
+
+
+def test_monitor_treats_orderly_close_as_finished():
+    """KIND_CLOSE means training COMPLETED — the standby must not
+    take over a job that is done."""
+    with time_limit(30, "monitor finished"):
+        server = _quiet_server()
+        monitor = PrimaryMonitor(
+            "127.0.0.1", server.port,
+            interval_s=0.05, deadline_s=2.0, log=lambda m: None,
+        )
+        try:
+            deadline = time.monotonic() + 5.0
+            while monitor.pongs == 0 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            server.close(graceful=True)
+            assert monitor.finished.wait(5.0)
+            assert not monitor.down.is_set()
+            assert monitor.wait_outcome(timeout=0.1) == "finished"
+        finally:
+            monitor.close()
+            server.close()
+
+
+def test_monitor_obeys_explicit_handoff():
+    """broadcast_handoff targets hello-declared standbys only and
+    triggers an immediate takeover."""
+    with time_limit(30, "explicit handoff"):
+        server = _quiet_server()
+        monitor = PrimaryMonitor(
+            "127.0.0.1", server.port,
+            interval_s=0.05, deadline_s=5.0, log=lambda m: None,
+        )
+        try:
+            deadline = time.monotonic() + 5.0
+            while (
+                server.metrics()["transport_hellos"] == 0
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.02)
+            # An actor connection must NOT receive the handoff frame.
+            actor = ActorClient(
+                "127.0.0.1", server.port, hello=(0, 0, ROLE_ACTOR)
+            )
+            actor.push_trajectory([np.zeros(2, np.float32)])
+            told = server.broadcast_handoff()
+            assert told == 1
+            assert monitor.down.wait(5.0)
+            assert "handoff" in monitor.reason
+            # The actor's protocol still works after the broadcast.
+            actor.push_trajectory([np.zeros(2, np.float32)])
+            actor.close()
+        finally:
+            monitor.close()
+            server.close()
+
+
+@pytest.mark.chaos
+def test_preempted_primary_hands_off_instead_of_standing_down():
+    """A PREEMPTED primary must not read as 'training completed' to
+    its standby: the teardown sends KIND_HANDOFF to hello-declared
+    standbys before the KIND_CLOSE broadcast, so a preemption of only
+    the learner host triggers takeover instead of orphaning the
+    fleet."""
+    from actor_critic_algs_on_tensorflow_tpu.algos import impala
+
+    with time_limit(240, "preemption handoff"):
+        cfg = impala.ImpalaConfig(
+            env="CartPole-v1", num_actors=1, envs_per_actor=4,
+            rollout_length=8, batch_trajectories=1, queue_size=4,
+            total_env_steps=4 * 8 * 50, num_devices=1,
+        )
+        stop = threading.Event()
+        ready = {}
+
+        t = threading.Thread(
+            target=lambda: impala.run_impala_distributed(
+                cfg, log_interval=10**9, log_fn=lambda s, m: None,
+                external_actors=True, stop_event=stop,
+                on_server_start=lambda h, p: ready.setdefault("port", p),
+            ),
+            daemon=True,
+        )
+        t.start()
+        deadline = time.monotonic() + 120.0
+        while "port" not in ready and time.monotonic() < deadline:
+            time.sleep(0.05)
+        monitor = PrimaryMonitor(
+            "127.0.0.1", ready["port"],
+            interval_s=0.1, deadline_s=30.0, log=lambda m: None,
+        )
+        try:
+            deadline = time.monotonic() + 10.0
+            while monitor.pongs == 0 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert monitor.pongs >= 1
+            stop.set()  # the preemption
+            t.join(timeout=60.0)
+            assert not t.is_alive()
+            assert monitor.down.wait(10.0)
+            assert "handoff" in monitor.reason
+            assert not monitor.finished.is_set()
+        finally:
+            monitor.close()
+
+
+# ---------------------------------------------------------------------
+# CheckpointTailer: warm restores across processes.
+# ---------------------------------------------------------------------
+
+def test_checkpoint_tailer_follows_writer_from_other_manager(tmp_path):
+    """The tailer's Checkpointer instance is DISTINCT from the
+    writer's (as across processes): refresh() must reveal steps the
+    writer lands after the reader was constructed."""
+    import jax
+    import jax.numpy as jnp
+
+    from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
+        Checkpointer,
+    )
+
+    with time_limit(60, "tailer"):
+        state1 = {"w": jnp.arange(4.0), "step": jnp.asarray(1)}
+        writer = Checkpointer(tmp_path / "ck", async_save=False)
+        reader = Checkpointer(tmp_path / "ck", async_save=False)
+        template = jax.tree_util.tree_map(np.asarray, state1)
+        tailer = CheckpointTailer(
+            reader, template, poll_interval_s=0.05, log=lambda m: None
+        )
+        try:
+            assert tailer.newest() == (None, None)
+            writer.save(1, state1)
+            writer.wait()
+            deadline = time.monotonic() + 10.0
+            while tailer.newest()[0] != 1 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            step, got = tailer.newest()
+            assert step == 1
+            np.testing.assert_array_equal(np.asarray(got["w"]), state1["w"])
+            # A second, newer step replaces the warm state.
+            state2 = {"w": jnp.full(4, 7.0), "step": jnp.asarray(2)}
+            writer.save(2, state2)
+            writer.wait()
+            deadline = time.monotonic() + 10.0
+            while tailer.newest()[0] != 2 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            step, got = tailer.newest()
+            assert step == 2 and float(np.asarray(got["w"])[0]) == 7.0
+            assert tailer.restores == 2
+        finally:
+            tailer.close(final_poll=False)
+            writer.close()
+            reader.close()
+
+
+def test_checkpoint_tailer_final_poll_catches_dying_save(tmp_path):
+    """The primary's preemption path writes one last checkpoint as it
+    dies; close(final_poll=True) must pick it up even though the
+    polling thread already stopped."""
+    import jax.numpy as jnp
+
+    from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
+        Checkpointer,
+    )
+
+    with time_limit(60, "tailer final poll"):
+        writer = Checkpointer(tmp_path / "ck", async_save=False)
+        reader = Checkpointer(tmp_path / "ck", async_save=False)
+        template = {"w": np.zeros(2, np.float32)}
+        tailer = CheckpointTailer(
+            reader, template, poll_interval_s=30.0, log=lambda m: None
+        )
+        try:
+            # Lands AFTER the tailer's first (only) periodic poll.
+            time.sleep(0.1)
+            writer.save(5, {"w": jnp.ones(2)})
+            writer.wait()
+            tailer.close(final_poll=True)
+            step, got = tailer.newest()
+            assert step == 5
+            np.testing.assert_array_equal(np.asarray(got["w"]), [1.0, 1.0])
+        finally:
+            writer.close()
+            reader.close()
+
+
+# ---------------------------------------------------------------------
+# Preemption consensus.
+# ---------------------------------------------------------------------
+
+def test_consensus_two_hosts_agree_on_max_step():
+    with time_limit(30, "consensus"):
+        leader = PreemptionLeader(n_followers=1, log=lambda m: None)
+        follower = PreemptionFollower(
+            "127.0.0.1", leader.port, log=lambda m: None
+        )
+        out = {}
+
+        def follower_side():
+            out["f_agreed"] = follower.decide(5, timeout_s=10.0)
+            out["f_barrier"] = follower.barrier(timeout_s=10.0)
+
+        t = threading.Thread(target=follower_side, daemon=True)
+        t.start()
+        agreed = leader.decide(3, timeout_s=10.0)
+        ok = leader.barrier(timeout_s=10.0)
+        t.join(timeout=10.0)
+        assert not t.is_alive()
+        # Max rule: the laggard (leader at 3) trains up to 5.
+        assert agreed == 5 and out["f_agreed"] == 5
+        assert ok and out["f_barrier"]
+        leader.close()
+        follower.close()
+
+
+def test_consensus_leader_degrades_on_silent_follower():
+    """A follower that connected but dies before reporting must not
+    hang the preemption countdown: the leader decides without it."""
+    with time_limit(30, "consensus degraded"):
+        leader = PreemptionLeader(n_followers=1, log=lambda m: None)
+        silent = socket.create_connection(("127.0.0.1", leader.port))
+        t0 = time.monotonic()
+        agreed = leader.decide(4, timeout_s=1.0)
+        assert agreed == 4
+        assert time.monotonic() - t0 < 10.0
+        silent.close()
+        leader.close()
+
+
+def test_consensus_follower_degrades_on_dead_leader():
+    with time_limit(30, "consensus dead leader"):
+        leader = PreemptionLeader(n_followers=1, log=lambda m: None)
+        follower = PreemptionFollower(
+            "127.0.0.1", leader.port, log=lambda m: None
+        )
+        leader.close()  # dies before any decision
+        agreed = follower.decide(6, timeout_s=1.0)
+        assert agreed == 6  # saves locally rather than not at all
+        follower.close()
+
+
+@pytest.mark.chaos
+def test_learner_loop_consensus_two_inprocess_hosts(tmp_path):
+    """Integration: two REAL run_impala learners (own actors, own
+    checkpoint dirs) under one leader/follower pair, stopped at
+    staggered moments -> both final checkpoints land at ONE agreed
+    step, verified by restores that assert step equality."""
+    import jax
+
+    from actor_critic_algs_on_tensorflow_tpu.algos import impala
+    from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
+        Checkpointer,
+    )
+
+    with time_limit(300, "in-process consensus e2e"):
+        def cfg_for(seed):
+            return impala.ImpalaConfig(
+                env="CartPole-v1", num_actors=2, envs_per_actor=4,
+                rollout_length=8, batch_trajectories=2, queue_size=4,
+                total_env_steps=2 * 4 * 8 * 40,  # far beyond the stop
+                num_devices=1, seed=seed,
+            )
+
+        leader = PreemptionLeader(n_followers=1, log=lambda m: None)
+        follower = PreemptionFollower(
+            "127.0.0.1", leader.port, log=lambda m: None
+        )
+        stops = {"A": threading.Event(), "B": threading.Event()}
+        results = {}
+
+        def host(name, seed, coordinator, stop, ckpt_dir):
+            ckpt = Checkpointer(ckpt_dir, async_save=False)
+            try:
+                state, _ = impala.run_impala(
+                    cfg_for(seed),
+                    log_interval=1,
+                    log_fn=lambda s, m: results.setdefault(
+                        f"{name}_steps", []
+                    ).append(s),
+                    checkpointer=ckpt, checkpoint_interval=10**9,
+                    stop_event=stop, coordinator=coordinator,
+                )
+                results[name] = int(state.step)
+                results[f"{name}_ckpt"] = ckpt.latest_step()
+            except BaseException as e:  # surfaced below
+                results[f"{name}_error"] = e
+            finally:
+                ckpt.close()
+
+        ta = threading.Thread(
+            target=host,
+            args=("A", 0, leader, stops["A"], tmp_path / "a"),
+            daemon=True,
+        )
+        tb = threading.Thread(
+            target=host,
+            args=("B", 1, follower, stops["B"], tmp_path / "b"),
+            daemon=True,
+        )
+        ta.start()
+        tb.start()
+        # Stagger the "SIGTERM": A stops early, B keeps training a
+        # while longer, so their local steps genuinely diverge and the
+        # consensus catch-up has real work to do.
+        while len(results.get("A_steps", [])) < 2:
+            time.sleep(0.05)
+        stops["A"].set()
+        while len(results.get("B_steps", [])) < 5:
+            time.sleep(0.05)
+        stops["B"].set()
+        ta.join(timeout=240.0)
+        tb.join(timeout=240.0)
+        assert not ta.is_alive() and not tb.is_alive()
+        assert "A_error" not in results, results["A_error"]
+        assert "B_error" not in results, results["B_error"]
+
+        # One agreed step: both dirs' final checkpoints restore to the
+        # SAME step counter — no mixed-step restore possible.
+        cfg = cfg_for(0)
+        template = jax.eval_shape(
+            impala.make_impala(cfg).init, jax.random.PRNGKey(0)
+        )
+        ra = Checkpointer(tmp_path / "a").restore(template)
+        rb = Checkpointer(tmp_path / "b").restore(template)
+        assert int(ra.step) == int(rb.step), (
+            results.get("A_ckpt"), results.get("B_ckpt"),
+        )
+        assert results["A"] == results["B"] == int(ra.step)
+        leader.close()
+        follower.close()
+
+
+# ---------------------------------------------------------------------
+# Multi-process end-to-end scenarios (slow tier).
+# ---------------------------------------------------------------------
+
+def _failover_cfg(total_iters: int):
+    from actor_critic_algs_on_tensorflow_tpu.algos.impala import (
+        ImpalaConfig,
+    )
+
+    return ImpalaConfig(
+        env="CartPole-v1", num_actors=2, envs_per_actor=4,
+        rollout_length=8, batch_trajectories=2, queue_size=4,
+        total_env_steps=2 * 4 * 8 * total_iters, num_devices=1,
+        transport_heartbeat_s=0.2, transport_idle_timeout_s=10.0,
+        transport_retry_deadline_s=60.0,
+    )
+
+
+def _failover_primary_main(cfg, port, ckpt_dir):
+    """Primary learner process for the failover e2e (top-level for
+    mp-spawn pickling): external actors, frequent checkpoints."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from actor_critic_algs_on_tensorflow_tpu.algos import impala
+    from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
+        Checkpointer,
+    )
+
+    ckpt = Checkpointer(ckpt_dir, async_save=False)
+    impala.run_impala_distributed(
+        cfg, log_interval=1, log_fn=lambda s, m: None,
+        host="127.0.0.1", port=port,
+        checkpointer=ckpt, checkpoint_interval=2,
+        external_actors=True,
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_failover_primary_killed_standby_takes_over(tmp_path):
+    """ISSUE 4 acceptance: the primary learner is SIGKILLed mid-run.
+    The warm standby (checkpoint tailed + programs compiled while the
+    primary was healthy) detects the missed heartbeats, binds its own
+    listener, re-points the actor fleet through the redirector, and
+    finishes the ENTIRE remaining env-step budget from the restored
+    step — which requires every remaining batch to be delivered by the
+    surviving actors (at-least-once; duplicates are V-trace-benign)."""
+    import dataclasses
+    import multiprocessing as mp
+
+    import jax
+
+    from actor_critic_algs_on_tensorflow_tpu.algos import impala
+    from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
+        Checkpointer,
+    )
+
+    with time_limit(570, "failover e2e"):
+        total_iters = 150
+        cfg = _failover_cfg(total_iters)
+        steps_per_batch = (
+            cfg.batch_trajectories * cfg.envs_per_actor * cfg.rollout_length
+        )
+        ckpt_dir = str(tmp_path / "ck")
+
+        # A fixed port for the primary so the standby knows whom to
+        # monitor (bind-then-close: fine for a localhost test).
+        probe = socket.create_server(("127.0.0.1", 0))
+        primary_port = probe.getsockname()[1]
+        probe.close()
+
+        redirector = Redirector("127.0.0.1", primary_port)
+        ctx = mp.get_context("spawn")
+        primary = ctx.Process(
+            target=_failover_primary_main,
+            args=(cfg, primary_port, ckpt_dir),
+            daemon=True,
+        )
+        primary.start()
+        # The actor fleet belongs to the JOB, not the primary: actors
+        # connect to the redirector and survive the primary's death.
+        actors = [
+            ctx.Process(
+                target=impala._actor_process_main,
+                args=(cfg, i, "127.0.0.1", redirector.port, 1000 + i, 0),
+                daemon=True,
+            )
+            for i in range(cfg.num_actors)
+        ]
+        for a in actors:
+            a.start()
+
+        reader = Checkpointer(ckpt_dir, async_save=False)
+        try:
+            # Let the primary make real progress (>= 2 checkpoints).
+            deadline = time.monotonic() + 300.0
+            while time.monotonic() < deadline:
+                reader.refresh()
+                latest = reader.latest_step()
+                if latest is not None and latest >= 4 * steps_per_batch:
+                    break
+                time.sleep(0.1)
+            reader.refresh()
+            killed_at = reader.latest_step()
+            assert killed_at is not None, "primary never checkpointed"
+
+            # KILL the primary: no goodbye frame, no final save.
+            os.kill(primary.pid, signal.SIGKILL)
+            primary.join(timeout=10.0)
+            t_kill = time.monotonic()
+
+            out = impala.run_impala_standby(
+                cfg,
+                checkpointer=Checkpointer(ckpt_dir, async_save=False),
+                primary_host="127.0.0.1",
+                primary_port=primary_port,
+                redirect=redirector.redirect,
+                heartbeat_interval_s=0.2,
+                takeover_deadline_s=1.0,
+                log_interval=1,
+                log_fn=lambda s, m: None,
+                checkpoint_interval=10**9,
+            )
+            assert out is not None, "standby never took over"
+            state, history = out
+            # Takeover happened within (a few multiples of) the
+            # heartbeat deadline, not a restart-from-disk epoch.
+            # (The full-run wall time also includes the remaining
+            # training; the gap itself is detect + bind + redirect.)
+            assert time.monotonic() - t_kill < 300.0
+
+            # Training CONTINUED from the tailed checkpoint: the final
+            # step equals the full budget, which needs every remaining
+            # batch delivered by the redirected actors.
+            assert int(state.step) == total_iters
+            final = history[-1][1]
+            resumed_iters = total_iters - killed_at // steps_per_batch
+            assert final["transport_trajectories"] >= (
+                0.95 * resumed_iters * cfg.batch_trajectories
+            )
+            assert final["transport_accepts"] >= cfg.num_actors
+            assert np.isfinite(final["loss"])
+        finally:
+            reader.close()
+            redirector.close()
+            if primary.is_alive():
+                primary.terminate()
+            for a in actors:
+                a.join(timeout=10.0)
+                if a.is_alive():
+                    a.terminate()
+
+
+def _coord_learner_main(cfg, spec, ckpt_dir):
+    """One learner 'host' for the coordinated-SIGTERM e2e: in-process
+    actors, preemption coordinator from the CLI spec, preempt-save
+    signal handling — exactly the production wiring."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from actor_critic_algs_on_tensorflow_tpu.algos import impala
+    from actor_critic_algs_on_tensorflow_tpu.cli.train import (
+        make_coordinator,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.utils import health
+    from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
+        Checkpointer,
+    )
+
+    coordinator = make_coordinator(spec)
+    ckpt = Checkpointer(ckpt_dir, async_save=False)
+    shutdown = health.ShutdownSignal().install()
+    try:
+        impala.run_impala(
+            cfg, log_interval=1, log_fn=lambda s, m: None,
+            checkpointer=ckpt, checkpoint_interval=2,
+            stop_event=shutdown.event, coordinator=coordinator,
+        )
+    finally:
+        shutdown.uninstall()
+        coordinator.close()
+        ckpt.close()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_coordinated_sigterm_two_processes_one_agreed_step(tmp_path):
+    """ISSUE 4 acceptance: REAL SIGTERMs delivered to two learner
+    processes at staggered times -> the stop-step consensus makes both
+    final checkpoints land at ONE agreed step (restore asserts step
+    equality), and both processes exit 0."""
+    import multiprocessing as mp
+
+    import jax
+
+    from actor_critic_algs_on_tensorflow_tpu.algos import impala
+    from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
+        Checkpointer,
+    )
+
+    with time_limit(570, "coordinated sigterm e2e"):
+        cfg_a = _failover_cfg(400)
+        cfg_b = _failover_cfg(400)
+        probe = socket.create_server(("127.0.0.1", 0))
+        lead_port = probe.getsockname()[1]
+        probe.close()
+
+        ctx = mp.get_context("spawn")
+        pa = ctx.Process(
+            target=_coord_learner_main,
+            args=(cfg_a, f"lead:1@127.0.0.1:{lead_port}",
+                  str(tmp_path / "a")),
+        )
+        pb = ctx.Process(
+            target=_coord_learner_main,
+            args=(cfg_b, f"follow@127.0.0.1:{lead_port}",
+                  str(tmp_path / "b")),
+        )
+        pa.start()
+        pb.start()
+
+        def wait_progress(d, min_steps):
+            reader = Checkpointer(str(d), async_save=False)
+            try:
+                deadline = time.monotonic() + 300.0
+                while time.monotonic() < deadline:
+                    reader.refresh()
+                    latest = reader.latest_step()
+                    if latest is not None and latest >= min_steps:
+                        return latest
+                    time.sleep(0.1)
+                raise AssertionError(f"no progress in {d}")
+            finally:
+                reader.close()
+
+        spb = 2 * 4 * 8
+        wait_progress(tmp_path / "a", 2 * spb)
+        wait_progress(tmp_path / "b", 2 * spb)
+        # Staggered preemption: A (the leader) first; B keeps training
+        # and is signaled a beat later, so the two local steps diverge
+        # and the consensus catch-up does real work on one side.
+        os.kill(pa.pid, signal.SIGTERM)
+        time.sleep(1.5)
+        os.kill(pb.pid, signal.SIGTERM)
+        pa.join(timeout=240.0)
+        pb.join(timeout=240.0)
+        assert not pa.is_alive() and not pb.is_alive()
+        assert pa.exitcode == 0 and pb.exitcode == 0
+
+        cfg = _failover_cfg(400)
+        template = jax.eval_shape(
+            impala.make_impala(cfg).init, jax.random.PRNGKey(cfg.seed)
+        )
+        ra = Checkpointer(str(tmp_path / "a")).restore(template)
+        rb = Checkpointer(str(tmp_path / "b")).restore(template)
+        assert int(ra.step) == int(rb.step) > 0

@@ -461,13 +461,16 @@ def test_chaos_end_to_end_delivery():
 
 @pytest.mark.chaos
 def test_chaos_corrupt_payload_quarantine_and_rollback():
-    """ISSUE 3 acceptance: garbage *data* on the wire (ChaosProxy
-    ``corrupt_payload`` — bytes that parse as a valid frame but decode
-    to NaN floats, the class wire hardening cannot catch) is dropped by
-    the pre-arena validator (quarantine: ``health_traj_dropped`` +
-    ``transport_rejected`` increment); and a poison batch that reaches
-    the learner anyway trips the in-graph guard, the sentinel rolls
-    back to the last-good snapshot, and the final params are finite."""
+    """Defense in depth against poison, layer by layer. ISSUE 4
+    promoted the wire defense: in-flight corruption (ChaosProxy
+    ``corrupt_payload`` — bytes that parse as a valid frame) is now
+    caught by the per-leaf CRC-32 BEFORE deserialization
+    (``transport_checksum_failures``; the resilient client re-pushes
+    clean bytes, so nothing is lost). A poisonous SOURCE — an actor
+    genuinely emitting NaNs, which checksums verify faithfully — is
+    still the validator's job (quarantine + ``transport_rejected``);
+    and a poison batch reaching the learner anyway trips the in-graph
+    guard and the sentinel rolls back."""
     import jax
     import jax.numpy as jnp
 
@@ -485,6 +488,10 @@ def test_chaos_corrupt_payload_quarantine_and_rollback():
             last_obs=np.zeros((B, 4), np.float32),
         )
         traj_leaves, traj_def = jax.tree_util.tree_flatten(clean)
+        poison_traj = clean.replace(
+            obs=np.full((T, B, 4), np.nan, np.float32)
+        )
+        poison_leaves = jax.tree_util.tree_leaves(poison_traj)
         ep = {
             "actor_id": np.asarray(0, np.int32),
             "episode_return": np.zeros(B, np.float32),
@@ -519,21 +526,31 @@ def test_chaos_corrupt_payload_quarantine_and_rollback():
             client.push_trajectory(traj_leaves, ep_leaves)
             assert validator.metrics()["health_traj_ok"] == 1
 
-            # Corrupted pushes: each armed chunk either lands in the
-            # float payload (validator drops NaN obs — the common case
-            # with a 16 KiB obs leaf) or clips a header (clean
-            # ConnectionError -> reconnect + re-push). Push until the
-            # validator has dropped one AND quarantined the actor.
-            for _ in range(30):
+            # Layer 1 — wire integrity: every corrupted chunk either
+            # fails its CRC (checksum failure; connection recycled) or
+            # clips a header (clean ConnectionError); either way the
+            # resilient client re-pushes the TRUE bytes, so corruption
+            # costs a retry, never data. Nothing for the validator to
+            # drop: corruption no longer masquerades as actor poison.
+            for _ in range(6):
                 proxy.set_corrupt_payload(1)
                 client.push_trajectory(traj_leaves, ep_leaves)
-                if validator.metrics()["health_quarantines"] >= 1:
+                if server.metrics()["transport_checksum_failures"] >= 1:
                     break
-            m = validator.metrics()
-            assert m["health_traj_dropped"] >= 1, m
-            assert m["health_quarantines"] == 1, m
             assert proxy.corrupted_chunks >= 1
-            assert server.metrics()["transport_rejected"] >= 1
+            assert server.metrics()["transport_checksum_failures"] >= 1
+            assert validator.metrics()["health_traj_dropped"] == 0
+            assert client.reconnects >= 1
+
+            # Layer 2 — poisonous source: genuine NaNs checksum
+            # faithfully; the validator drops them pre-arena and
+            # quarantines the actor after the threshold.
+            for _ in range(3):
+                client.push_trajectory(poison_leaves, ep_leaves)
+            m = validator.metrics()
+            assert m["health_traj_dropped"] >= 3, m
+            assert m["health_quarantines"] == 1, m
+            assert server.metrics()["transport_rejected"] >= 3
             assert validator.take_respawns() == [0]
             # Everything that DID reach the queue side is clean.
             for traj in received:
