@@ -164,6 +164,30 @@ class ImpalaConfig:
     transport_idle_timeout_s: float = 120.0
     transport_retry_deadline_s: float = 60.0
     transport_max_frame_mb: int = 1024
+    # --- param-sync data plane (distributed.codec) -------------------
+    # Serve weight fetches as lossless XOR-delta + zlib frames against
+    # the version each client reports holding (full frame on a ring
+    # miss); the ring keeps this many recent published versions' wire
+    # leaves on the server.
+    param_delta: bool = True
+    param_delta_ring: int = 4
+    # Opt-in bf16 wire cast for float32 leaves on ACTOR fetches only
+    # (half the bytes BEFORE the delta pass; ~2^-8 rounding that
+    # V-trace's importance weighting already corrects). Standbys and
+    # param tailers always receive full precision — their copy seeds a
+    # takeover learner. Default OFF: full-precision wire.
+    param_bf16_wire: bool = False
+    # --- hot standby (run_impala_standby) ----------------------------
+    # Bind the takeover listener at standby START: actors that lose
+    # the primary land here immediately (via the redirector's fallback
+    # route), their pushes are discarded and their fetches serve the
+    # tailed params — the reconnect backoff is paid BEFORE the
+    # failover, not inside the gap.
+    standby_serve_early: bool = True
+    # fetch_params-tail the primary's publishes so takeover serves
+    # FRESHER weights than the last checkpoint (training state still
+    # resumes from the checkpoint — optimizer state is not published).
+    standby_tail_params: bool = True
     compute_dtype: str = "float32"  # "bfloat16" runs the torso on the MXU in bf16
     use_pallas_scan: bool = False   # fused Pallas VMEM kernel for V-trace
     # Recurrent (LSTM) policy — the IMPALA-paper model family. Actors
@@ -1116,6 +1140,20 @@ def _learner_loop(
                     m.update(pm)
                 if sentinel is not None:
                     m.update(sentinel.metrics())
+                if coordinator is not None and hasattr(
+                    coordinator, "report_step"
+                ):
+                    # Cross-host step telemetry rides the preemption
+                    # coordinator's live sockets: followers report
+                    # their step each log window, the leader folds the
+                    # fleet-wide spread into ITS log stream as
+                    # coord_step_lag — a host falling behind its peers
+                    # is visible long before a preemption would
+                    # discover it.
+                    coordinator.report_step(it + 1)
+                    lag = getattr(coordinator, "lag_metrics", None)
+                    if lag is not None:
+                        m.update(lag())
                 m.update(extra_metrics())
                 history.append((env_steps, m))
                 if summary_writer is not None:
@@ -1448,6 +1486,18 @@ def _actor_process_main(
             time.sleep(0.05)
             version, leaves = client.fetch_params()
         params = jax.tree_util.tree_unflatten(params_def, leaves)
+
+        def refetch():
+            # A fetch can reconnect mid-call onto a learner that has
+            # not published yet (a standby's early listener with param
+            # tailing off) and come back (0, []) — keep the current
+            # weights; the next ack/notify re-fetches.
+            nonlocal version, params
+            fetched, fresh = client.fetch_params()
+            if fetched > 0:
+                version = fetched
+                params = jax.tree_util.tree_unflatten(params_def, fresh)
+
         key = jax.random.PRNGKey(seed)
         key, k = jax.random.split(key)
         env_state, obs, carry = env_reset_fn(k)
@@ -1456,6 +1506,15 @@ def _actor_process_main(
             env_state, obs, carry, traj, ep = rollout_fn(
                 params, env_state, obs, carry, k
             )
+            # Push-based publish discovery: a KIND_PARAMS_NOTIFY that
+            # landed during the rollout is in the socket buffer now —
+            # fetch BEFORE pushing, so this push's ack round-trip (and
+            # any backpressure stall inside it) never adds to weight
+            # staleness. Zero steady-state cost: the poll is a
+            # non-blocking drain of already-arrived frames.
+            notified = client.poll_notified()
+            if notified > 0 and notified != version:
+                refetch()
             server_version = client.push_trajectory(
                 [np.asarray(x) for x in jax.tree_util.tree_leaves(traj)],
                 [np.asarray(x) for x in jax.tree_util.tree_leaves(ep)],
@@ -1467,8 +1526,7 @@ def _actor_process_main(
             # (0 = a learner that has not published yet: keep the
             # current weights and let the next ack trigger the fetch.)
             if server_version != version and server_version > 0:
-                version, leaves = client.fetch_params()
-                params = jax.tree_util.tree_unflatten(params_def, leaves)
+                refetch()
     except LearnerShutdown:
         # Orderly KIND_CLOSE broadcast: the learner is done. Exit
         # quietly — this is the expected end of every run, not a fault.
@@ -1532,6 +1590,7 @@ def run_impala_distributed(
     on_server_start=None,
     coordinator=None,
     wire_plan=None,
+    server=None,
 ) -> Tuple[LearnerState, List[Tuple[int, Dict[str, float]]]]:
     """IMPALA with actors in separate PROCESSES streaming trajectories
     through ``distributed.transport`` — the same topology that spans
@@ -1557,7 +1616,12 @@ def run_impala_distributed(
     compiled ``ImpalaPrograms`` (the warm standby compiled while the
     primary was healthy — recompiling at takeover would put minutes of
     XLA time back into the failover gap); ``coordinator`` is the
-    preemption stop-step consensus (see ``_learner_loop``).
+    preemption stop-step consensus (see ``_learner_loop``);
+    ``server`` adopts an already-listening ``LearnerServer`` (the hot
+    standby's pre-takeover listener, with actors ALREADY connected to
+    it) — its trajectory sink is swapped from the standby's discard
+    mode onto this run's queue, so takeover starts consuming a live
+    stream instead of waiting out reconnects.
     """
     import multiprocessing as mp
 
@@ -1621,13 +1685,23 @@ def run_impala_distributed(
                 continue
         return True
 
-    server = LearnerServer(
-        on_trajectory,
-        host=host,
-        port=port,
-        idle_timeout_s=cfg.transport_idle_timeout_s,
-        max_frame_bytes=cfg.transport_max_frame_mb << 20,
-    )
+    if server is not None:
+        # Adopt the pre-takeover listener: actors connected while the
+        # standby was absorbing (and discarding) their pushes now feed
+        # the real queue. The publish below bumps the version and
+        # notifies them, so everyone re-fetches from the new learner.
+        server.set_trajectory_sink(on_trajectory)
+    else:
+        server = LearnerServer(
+            on_trajectory,
+            host=host,
+            port=port,
+            idle_timeout_s=cfg.transport_idle_timeout_s,
+            max_frame_bytes=cfg.transport_max_frame_mb << 20,
+            param_delta=cfg.param_delta,
+            param_delta_ring=cfg.param_delta_ring,
+            param_bf16=cfg.param_bf16_wire,
+        )
     server.publish(jax.tree_util.tree_leaves(jax.device_get(state.params)))
     if on_server_start is not None:
         # Listener bound, weights published: safe to point actors here.
@@ -1820,6 +1894,7 @@ def run_impala_standby(
     stop_event: threading.Event | None = None,
     coordinator=None,
     on_ready=None,
+    on_serving=None,
 ) -> Tuple[LearnerState, List[Tuple[int, Dict[str, float]]]] | None:
     """Warm-standby learner: wait, stay hot, take over on primary death.
 
@@ -1836,12 +1911,26 @@ def run_impala_standby(
     (b) tails the primary's checkpoint directory, restoring each new
     step into memory as it lands. On primary death — ``KIND_PING``
     heartbeats silent past ``takeover_deadline_s``, or an explicit
-    ``KIND_HANDOFF`` — the standby binds its own listener, publishes
-    the tailed weights, and calls ``redirect(host, port)`` (typically
+    ``KIND_HANDOFF`` — the standby publishes the tailed weights and
+    calls ``redirect(host, port)`` (typically
     ``controlplane.Redirector.redirect``) to re-point the actor fleet.
-    The failover gap is therefore bind + redirect + actor reconnect,
-    not process start + compile + restore-from-disk (PERF.md "Control
-    plane").
+
+    The param-sync data plane makes the standby HOT, not just warm:
+
+      - ``cfg.standby_tail_params``: a ``ParamTailer`` follows the
+        primary's publish stream (notify-driven, delta-coded), so
+        takeover grafts weights fresher than the last checkpoint onto
+        the restored state (optimizer state still comes from the
+        checkpoint — it is never published).
+      - ``cfg.standby_serve_early``: the takeover listener binds NOW,
+        at standby start — ``on_serving(host, port)`` announces it, so
+        the supervisor can arm the redirector's fallback route. Actors
+        that lose the primary land here on their FIRST retry, their
+        pushes are absorbed (ACKed and discarded) and their fetches
+        serve the tailed weights; at takeover the same server — with
+        the fleet already connected — is adopted by the learner run.
+        The reconnect-backoff term of the failover gap is paid before
+        the failover, not inside it (PERF.md "Param data plane").
 
     Returns ``None`` without taking over when the primary finishes
     cleanly (``KIND_CLOSE``) or ``stop_event`` fires first; otherwise
@@ -1851,7 +1940,11 @@ def run_impala_standby(
     """
     from actor_critic_algs_on_tensorflow_tpu.distributed.controlplane import (
         CheckpointTailer,
+        ParamTailer,
         PrimaryMonitor,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+        LearnerServer,
     )
     from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
         donation_supported,
@@ -1901,23 +1994,76 @@ def run_impala_standby(
         del warm_state, warm_batch, out, arena
         print("[standby] learner programs compiled (warm)", flush=True)
 
-    tailer = CheckpointTailer(checkpointer, template)
-    monitor = PrimaryMonitor(
-        primary_host, primary_port,
-        interval_s=heartbeat_interval_s,
-        deadline_s=takeover_deadline_s,
-    )
+    # Early data plane: bind the takeover listener NOW so actors that
+    # lose the primary land here (via the redirector's fallback route)
+    # and pay their reconnect before the failover. Pushes are absorbed
+    # (ACKed, dropped — the primary is consuming the real stream);
+    # fetches serve whatever the param tailer has re-published.
+    early_server = None
+    ptailer = None
+    if cfg.standby_serve_early:
+        early_server = LearnerServer(
+            lambda traj_leaves, ep_leaves: True,
+            host=host,
+            port=port,
+            idle_timeout_s=cfg.transport_idle_timeout_s,
+            max_frame_bytes=cfg.transport_max_frame_mb << 20,
+            param_delta=cfg.param_delta,
+            param_delta_ring=cfg.param_delta_ring,
+            param_bf16=cfg.param_bf16_wire,
+            log=lambda msg: print(f"[standby-server] {msg}", flush=True),
+        )
+        port = early_server.port
+        if on_serving is not None:
+            on_serving(host, early_server.port)
+    try:
+        if cfg.standby_tail_params:
+            ptailer = ParamTailer(
+                primary_host, primary_port,
+                poll_interval_s=max(heartbeat_interval_s, 0.25),
+                on_params=(
+                    (lambda v, leaves: early_server.publish(leaves))
+                    if early_server is not None
+                    else None
+                ),
+            )
+
+        tailer = CheckpointTailer(checkpointer, template)
+        monitor = PrimaryMonitor(
+            primary_host, primary_port,
+            interval_s=heartbeat_interval_s,
+            deadline_s=takeover_deadline_s,
+        )
+    except BaseException:
+        # Nothing below ever runs: release the early listener (a
+        # supervisor's retry would otherwise hit "Address already in
+        # use" on the --learner-bind rebind) and stop the tail thread.
+        if ptailer is not None:
+            ptailer.close()
+        if early_server is not None:
+            early_server.close()
+        raise
     try:
         if on_ready is not None:
             on_ready(monitor)
         outcome = monitor.wait_outcome(stop_event=stop_event)
+    except BaseException:
+        if early_server is not None:
+            early_server.close()
+        raise
     finally:
         monitor.close()
         # One last synchronous poll: the primary's dying save (the
         # preemption path writes one final checkpoint) may have landed
         # between our last poll and its death.
         tailer.close(final_poll=True)
+        # The param tail likewise stops at the outcome: its newest()
+        # is frozen at the last publish the primary ever made.
+        if ptailer is not None:
+            ptailer.close()
     if outcome != "down":
+        if early_server is not None:
+            early_server.close()
         print(
             f"[standby] no takeover "
             f"({outcome or 'stopped before any outcome'})",
@@ -1926,13 +2072,66 @@ def run_impala_standby(
         return None
 
     step_id, state = tailer.newest()
+    tailed_version, tailed_leaves = (
+        ptailer.newest() if ptailer is not None else (0, None)
+    )
+    # Graft only when the publish stream is actually the fresher
+    # source, ordered by CONTENT time (checkpoint = writer's dir
+    # mtime, publish = fetch arrival): publishes ride every learner
+    # step while checkpoints land every interval, so the last publish
+    # is normally newer — but a param-tail outage (reconnect window)
+    # or a dying save that outran the severed tail means the
+    # checkpoint's params are at least as new, and grafting the stale
+    # tail over them would silently REGRESS the weights.
+    if tailed_leaves is not None and state is not None and (
+        ptailer.newest_seen_t <= tailer.newest_seen_t
+    ):
+        print(
+            f"[standby] tailed params version {tailed_version} "
+            f"predate the newest checkpoint (step {step_id}); using "
+            f"the checkpoint's params",
+            flush=True,
+        )
+        tailed_leaves = None
+    if tailed_leaves is not None:
+        # Graft the freshest PUBLISHED weights onto the restored
+        # training state: params advance every publish (usually every
+        # learner step), checkpoints every checkpoint_interval — the
+        # takeover learner and the fleet resume from weights newer
+        # than any checkpoint. Optimizer state and the step counter
+        # still come from the checkpoint (they are never published).
+        if state is None:
+            state = programs.init(jax.random.PRNGKey(cfg.seed))
+        params = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(template.params),
+            [np.asarray(x) for x in tailed_leaves],
+        )
+        state = state.replace(
+            params=jax.device_put(
+                params, NamedSharding(programs.mesh, P())
+            )
+        )
+    if early_server is not None:
+        absorbed = early_server.metrics()["transport_trajectories"]
+        if absorbed:
+            print(
+                f"[standby] absorbed {absorbed} pre-takeover "
+                f"trajectory pushes (discarded; backoff already paid)",
+                flush=True,
+            )
     print(
         f"[standby] TAKEOVER ({monitor.reason}): "
         + (
             f"resuming from tailed checkpoint step {step_id} "
             f"(already restored in memory)"
-            if state is not None
+            if step_id is not None
             else "no checkpoint ever landed; starting from init"
+        )
+        + (
+            f" + tailed params version {tailed_version} (fresher than "
+            f"the checkpoint)"
+            if tailed_leaves is not None
+            else ""
         ),
         flush=True,
     )
@@ -1952,4 +2151,5 @@ def run_impala_standby(
         on_server_start=redirect,
         coordinator=coordinator,
         wire_plan=wire_plan,
+        server=early_server,
     )

@@ -416,7 +416,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "heartbeats or an explicit handoff) bind "
                         "--learner-bind, publish the tailed weights, "
                         "and take the actor fleet over. Requires "
-                        "--checkpoint-dir; spawns no actors of its own")
+                        "--checkpoint-dir; spawns no actors of its own. "
+                        "Hot-standby knobs are config fields: --set "
+                        "standby_serve_early= (pre-takeover listener + "
+                        "redirector fallback) standby_tail_params= "
+                        "(follow the primary's publishes, not just its "
+                        "checkpoints)")
     p.add_argument("--redirector", default=None, metavar="[HOST:]PORT",
                    help="with --standby: also run the actor-facing "
                         "redirector (actors connect here, never to a "
@@ -445,7 +450,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "--set transport_heartbeat_s=... "
                         "transport_idle_timeout_s= "
                         "transport_retry_deadline_s= "
-                        "transport_max_frame_mb=")
+                        "transport_max_frame_mb=. Param-sync wire "
+                        "codec: --set param_delta= param_delta_ring= "
+                        "param_bf16_wire= (bf16 is opt-in, actor "
+                        "fetches only)")
     return p
 
 
@@ -710,6 +718,21 @@ def _run_standby(args, cfg, writer, coordinator) -> int:
                 "127.0.0.1" if h in ("0.0.0.0", "") else h, p
             )
 
+    def on_serving(h, p):
+        # The standby's pre-takeover listener is up: arm the
+        # redirector's fallback route so actors that lose the primary
+        # land on the standby on their FIRST retry (reconnect backoff
+        # paid before the failover) instead of backing off against a
+        # dead address until takeover re-points the target.
+        h = "127.0.0.1" if h in ("0.0.0.0", "") else h
+        print(
+            f"[train] standby data plane serving on {h}:{p} "
+            f"(pre-takeover: absorbs pushes, serves tailed params)",
+            flush=True,
+        )
+        if redirector is not None:
+            redirector.set_fallback(h, p)
+
     shutdown = None
     if args.preempt_save:
         shutdown = ShutdownSignal().install()
@@ -727,6 +750,7 @@ def _run_standby(args, cfg, writer, coordinator) -> int:
             checkpoint_interval=args.checkpoint_interval,
             stop_event=shutdown.event if shutdown is not None else None,
             coordinator=coordinator,
+            on_serving=on_serving,
         )
     finally:
         if shutdown is not None:

@@ -47,6 +47,7 @@ threads.
 
 from __future__ import annotations
 
+import dataclasses
 import socket
 import threading
 import time
@@ -56,6 +57,8 @@ import numpy as np
 
 from actor_critic_algs_on_tensorflow_tpu.distributed.resilience import (
     ChaosProxy,
+    ResilientActorClient,
+    RetryPolicy,
 )
 from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
     KIND_BARRIER,
@@ -68,12 +71,14 @@ from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
     KIND_STEP_REPORT,
     KIND_STOP_STEP,
     ROLE_STANDBY,
+    LearnerShutdown,
     recv_msg,
     send_msg,
 )
 
 __all__ = [
     "CheckpointTailer",
+    "ParamTailer",
     "PreemptionFollower",
     "PreemptionLeader",
     "PrimaryMonitor",
@@ -312,6 +317,7 @@ class CheckpointTailer(threading.Thread):
         self._lock = threading.Lock()
         self._step: Optional[int] = None
         self._state: Any = None
+        self._seen_t = float("-inf")
         self.restores = 0
         self._halt = threading.Event()
         self.start()
@@ -335,8 +341,21 @@ class CheckpointTailer(threading.Thread):
                 f"keeping step {have}"
             )
             return
+        # Stamp the step with its CONTENT time — the writer's dir
+        # mtime — not with when this poll finished: the poll + restore
+        # lag would otherwise overstate a checkpoint's age by ~0.5 s
+        # against the ms-lag param-publish stream it is ordered with
+        # at takeover.
+        written = None
+        fn = getattr(self._ckpt, "step_written_at", None)
+        if fn is not None:
+            try:
+                written = fn(latest)
+            except Exception:
+                written = None
         with self._lock:
             self._step, self._state = latest, state
+            self._seen_t = written if written is not None else time.time()
         self.restores += 1
         self._log(f"tailed checkpoint step {latest} (restored, warm)")
 
@@ -351,6 +370,16 @@ class CheckpointTailer(threading.Thread):
         with self._lock:
             return self._step, self._state
 
+    @property
+    def newest_seen_t(self) -> float:
+        """Wall-clock CONTENT time of the newest restored step (the
+        writer's dir mtime, observation time as fallback; −inf if
+        none) — lets takeover order the checkpoint tail against the
+        param tail. Cross-host clock skew only flips near-ties, where
+        the two sources are freshness-equivalent anyway."""
+        with self._lock:
+            return self._seen_t
+
     def close(self, *, final_poll: bool = True) -> None:
         """Stop polling; with ``final_poll`` do one last synchronous
         scan first (the primary's dying save may have just landed)."""
@@ -360,12 +389,157 @@ class CheckpointTailer(threading.Thread):
             self._poll_once()
 
 
+class ParamTailer(threading.Thread):
+    """``fetch_params``-tail the primary's publishes on the standby.
+
+    The checkpoint tailer bounds takeover staleness by the CHECKPOINT
+    interval; this tailer bounds it by the PUBLISH interval (usually
+    every learner step): it connects to the primary as a
+    ``ROLE_STANDBY`` peer (full-precision wire — the copy seeds a
+    takeover *learner*, so the bf16 actor cast never applies), sleeps
+    on the publish notify broadcast, and fetches each new version —
+    riding the same delta codec as the actors, so steady-state tailing
+    costs delta bytes, not full payloads. ``newest()`` hands takeover
+    the freshest published weights; training state (optimizer, step)
+    still resumes from the tailed checkpoint — the optimizer state is
+    never published. ``on_params(version, leaves)`` (optional) fires on
+    every new version — the hot standby re-publishes into its OWN
+    listener so pre-takeover actors fetch live weights from it.
+
+    A lost primary just means retry-with-backoff here (the monitor owns
+    declaring it dead); an orderly ``KIND_CLOSE`` ends the tail."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        *,
+        standby_id: int = 0,
+        poll_interval_s: float = 1.0,
+        on_params: Callable[[int, List[np.ndarray]], None] | None = None,
+        log: Callable[[str], None] | None = None,
+    ):
+        super().__init__(name="param-tailer", daemon=True)
+        self._addr = (host, port)
+        self._standby_id = standby_id
+        self._interval = poll_interval_s
+        self._on_params = on_params
+        self._log = log if log is not None else (
+            lambda msg: print(f"[standby] {msg}", flush=True)
+        )
+        self._lock = threading.Lock()
+        self._version = 0
+        self._leaves: Optional[List[np.ndarray]] = None
+        self._seen_t = float("-inf")
+        self.fetches = 0
+        self._halt = threading.Event()
+        self.start()
+
+    def run(self) -> None:
+        client = None
+        idle_wakes = 0
+        try:
+            while not self._halt.is_set():
+                if client is None:
+                    try:
+                        client = ResilientActorClient(
+                            *self._addr,
+                            retry=RetryPolicy(deadline_s=2.0),
+                            heartbeat_interval_s=None,
+                            idle_timeout_s=30.0,
+                            connect_timeout=2.0,
+                            hello=(self._standby_id, 0, ROLE_STANDBY),
+                        )
+                    except (ConnectionError, OSError):
+                        # Not up yet / mid-restart: the monitor decides
+                        # what that means; we just keep trying.
+                        client = None
+                        self._halt.wait(self._interval)
+                        continue
+                try:
+                    notified = client.wait_params_notify(self._interval)
+                    with self._lock:
+                        have = self._version
+                    # Fetch on notify, and every few IDLE intervals as
+                    # a safety net for a dropped best-effort notify.
+                    # Under the delta codec an already-current fetch is
+                    # a near-empty frame, but with param_delta=False
+                    # each one is a FULL frame — fetching every wakeup
+                    # would pull the whole param set ~4x/s from an idle
+                    # primary.
+                    if notified == have and notified != 0:
+                        idle_wakes += 1
+                        if idle_wakes % 8 != 0:
+                            continue
+                    else:
+                        idle_wakes = 0
+                    version, leaves = client.fetch_params()
+                    if version != 0 and version != have:
+                        with self._lock:
+                            self._version, self._leaves = version, leaves
+                            self._seen_t = time.time()
+                        self.fetches += 1
+                        if self._on_params is not None:
+                            self._on_params(version, leaves)
+                except LearnerShutdown:
+                    self._log("param tail: primary finished (close)")
+                    return
+                except (ConnectionError, OSError):
+                    try:
+                        client.close()
+                    except Exception:
+                        pass
+                    client = None
+                    self._halt.wait(self._interval)
+        finally:
+            if client is not None:
+                try:
+                    client.close()
+                except Exception:
+                    pass
+
+    def newest(self) -> Tuple[int, Optional[List[np.ndarray]]]:
+        """(version, host param leaves) of the freshest tailed publish
+        — ``(0, None)`` if nothing was ever fetched."""
+        with self._lock:
+            return self._version, self._leaves
+
+    @property
+    def newest_seen_t(self) -> float:
+        """Wall clock when the freshest publish was fetched (−inf if
+        none) — content lags arrival by only the notify+fetch RTT
+        (ms), so arrival IS the content time here; the counterpart of
+        ``CheckpointTailer.newest_seen_t``."""
+        with self._lock:
+            return self._seen_t
+
+    def close(self) -> None:
+        self._halt.set()
+        self.join(timeout=5.0 + self._interval)
+
+
 # ---------------------------------------------------------------------
 # Coordinated preemption: one agreed stop step across learner hosts.
 # ---------------------------------------------------------------------
 
+@dataclasses.dataclass(eq=False)
+class _Follower:
+    """Leader-side per-follower state, fed by that follower's reader
+    thread. ``last_step`` is the newest PERIODIC (healthy-training)
+    step report; ``final_report`` the preemption report the consensus
+    waits on; ``barrier_arrived`` the save-complete frame."""
+
+    sock: socket.socket
+    last_step: Optional[int] = None
+    last_step_t: float = 0.0
+    final_report: Optional[int] = None
+    barrier_arrived: bool = False
+    dead: bool = False
+
+
 class PreemptionLeader:
-    """Leader side of the SIGTERM stop-step consensus.
+    """Leader side of the SIGTERM stop-step consensus — and, between
+    preemptions, the collector of the cross-host step-lag metric.
 
     Construct at job start (followers connect early, while everything
     is healthy); at preemption call ``decide(local_step)`` then, after
@@ -376,7 +550,18 @@ class PreemptionLeader:
     cannot guarantee (a host cannot save a past state it no longer
     holds). A follower that dies before reporting is dropped from the
     quorum after ``timeout_s`` with a loud log — a degraded save beats
-    no save during a preemption countdown."""
+    no save during a preemption countdown.
+
+    Each follower socket is drained by a dedicated reader thread into
+    a per-follower inbox, which is what makes the same connection
+    carry BOTH traffic classes: periodic ``KIND_STEP_REPORT`` frames
+    during HEALTHY training (one marker array; they feed
+    ``lag_metrics()`` — the early warning that one host's learner is
+    falling behind its peers) and the final report at preemption (no
+    arrays — wire-compatible with pre-refactor followers). The inbox
+    waits are naturally concurrent per follower, preserving the old
+    guarantee that one wedged peer cannot starve live-but-slow peers
+    of their recv window."""
 
     def __init__(
         self,
@@ -390,9 +575,14 @@ class PreemptionLeader:
         self._log = log if log is not None else (
             lambda msg: print(f"[preempt-leader] {msg}", flush=True)
         )
-        self._lock = threading.Lock()
-        self._socks: List[socket.socket] = []
+        self._cond = threading.Condition()
+        self._followers: List[_Follower] = []
+        # Every follower ever accepted — the quorum list is trimmed at
+        # decide(), but close() must still unblock every reader.
+        self._all_followers: List[_Follower] = []
+        self._own_step: Optional[int] = None
         self._halt = threading.Event()
+        self._reader_threads: List[threading.Thread] = []
         self._listener = socket.create_server((host, port))
         self._listener.settimeout(0.2)
         self.port = self._listener.getsockname()[1]
@@ -404,8 +594,8 @@ class PreemptionLeader:
 
     def _accept_loop(self) -> None:
         while not self._halt.is_set():
-            with self._lock:
-                if len(self._socks) >= self.n_followers:
+            with self._cond:
+                if len(self._followers) >= self.n_followers:
                     break
             try:
                 conn, _ = self._listener.accept()
@@ -413,87 +603,144 @@ class PreemptionLeader:
                 continue
             except OSError:
                 break
-            with self._lock:
-                self._socks.append(conn)
+            f = _Follower(sock=conn)
+            with self._cond:
+                self._followers.append(f)
+                self._all_followers.append(f)
+                self._cond.notify_all()
+            t = threading.Thread(
+                target=self._read_loop, args=(f,),
+                name=f"preempt-leader-read-{len(self._reader_threads)}",
+                daemon=True,
+            )
+            t.start()
+            self._reader_threads.append(t)
         self._listener.close()
 
-    def _wait_followers(self, deadline: float) -> List[socket.socket]:
-        while time.monotonic() < deadline:
-            with self._lock:
-                if len(self._socks) >= self.n_followers:
-                    return list(self._socks)
-            time.sleep(0.02)
-        with self._lock:
-            got = list(self._socks)
-        self._log(
-            f"only {len(got)}/{self.n_followers} followers connected by "
-            f"the consensus deadline; proceeding degraded"
-        )
+    def _read_loop(self, f: _Follower) -> None:
+        try:
+            while not self._halt.is_set():
+                kind, tag, arrays = recv_msg(f.sock)
+                with self._cond:
+                    if kind == KIND_STEP_REPORT and arrays:
+                        # Periodic (marker array): healthy-training
+                        # step telemetry, never part of a consensus.
+                        f.last_step = int(tag)
+                        f.last_step_t = time.monotonic()
+                    elif kind == KIND_STEP_REPORT:
+                        f.final_report = int(tag)
+                        self._cond.notify_all()
+                    elif kind == KIND_BARRIER:
+                        f.barrier_arrived = True
+                        self._cond.notify_all()
+                    # Anything else: ignore (liveness is implicit).
+        except (ConnectionError, OSError) as e:
+            with self._cond:
+                if not f.dead:
+                    f.dead = True
+                    self._cond.notify_all()
+            if not self._halt.is_set():
+                self._log(f"follower connection lost ({e!r})")
+
+    # -- healthy-training telemetry ------------------------------------
+
+    def report_step(self, step: int) -> None:
+        """Record the leader host's own step (pairs with the
+        followers' periodic reports in ``lag_metrics``)."""
+        with self._cond:
+            self._own_step = int(step)
+
+    def lag_metrics(self) -> dict:
+        """Cross-host learner step spread from the newest periodic
+        reports: ``coord_step_lag`` = max − min over every host with a
+        known step (0 = in lockstep). Rides the leader's ordinary log
+        stream — a host falling behind its peers is visible long
+        before a preemption would discover it."""
+        now = time.monotonic()
+        with self._cond:
+            steps = [self._own_step] if self._own_step is not None else []
+            ages = []
+            for f in self._followers:
+                s = f.last_step if f.last_step is not None else f.final_report
+                if s is not None and not f.dead:
+                    steps.append(s)
+                    if f.last_step is not None:
+                        ages.append(now - f.last_step_t)
+        out = {"coord_hosts_reporting": len(steps)}
+        if len(steps) >= 2:
+            out["coord_step_lag"] = max(steps) - min(steps)
+        if ages:
+            # Staleness of the quietest host's periodic report: lag
+            # says "behind", age says "silent" — a host whose
+            # telemetry stopped flowing shows a growing age while its
+            # frozen step still feeds the lag above.
+            out["coord_report_age_s"] = round(max(ages), 3)
+        return out
+
+    # -- preemption consensus ------------------------------------------
+
+    def _wait_followers(self, deadline: float) -> List[_Follower]:
+        with self._cond:
+            while (
+                len(self._followers) < self.n_followers
+                and time.monotonic() < deadline
+            ):
+                self._cond.wait(
+                    timeout=max(0.02, min(0.2, deadline - time.monotonic()))
+                )
+            got = list(self._followers)
+        if len(got) < self.n_followers:
+            self._log(
+                f"only {len(got)}/{self.n_followers} followers connected "
+                f"by the consensus deadline; proceeding degraded"
+            )
         return got
 
-    def _recv_each(
+    def _wait_inbox(
         self,
-        socks: List[socket.socket],
-        expect_kind: int,
+        followers: List[_Follower],
+        have: Callable[[_Follower], bool],
         deadline: float,
         what: str,
-    ) -> List[Optional[int]]:
-        """Recv one ``expect_kind`` frame from every socket
-        CONCURRENTLY, each against the full remaining deadline.
-        Sequential recvs would let one wedged peer (SIGSTOP, network
-        blackhole — socket open, nothing sent) consume the whole shared
-        budget and starve live-but-slow peers of their recv window.
-        Returns the frame tag per socket, None where the recv failed."""
-        results: List[Optional[int]] = [None] * len(socks)
-
-        def one(i: int, s: socket.socket) -> None:
-            try:
-                s.settimeout(max(0.1, deadline - time.monotonic()))
-                kind, tag, _ = recv_msg(s)
-                if kind != expect_kind:
-                    raise ConnectionError(f"expected {what}, got {kind}")
-                results[i] = int(tag)
-            except (socket.timeout, ConnectionError, OSError) as e:
-                self._log(f"follower lost during {what} ({e!r})")
-
-        threads = [
-            threading.Thread(
-                target=one, args=(i, s),
-                name=f"preempt-recv-{what}-{i}", daemon=True,
-            )
-            for i, s in enumerate(socks)
-        ]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(timeout=max(0.1, deadline - time.monotonic()) + 1.0)
-        return results
+    ) -> List[_Follower]:
+        """Wait until every follower either satisfies ``have`` or is
+        dead (or the deadline passes); returns those that arrived. One
+        wedged peer never starves the others — arrival order does not
+        matter to a condition-variable wait."""
+        with self._cond:
+            while time.monotonic() < deadline and any(
+                not have(f) and not f.dead for f in followers
+            ):
+                self._cond.wait(
+                    timeout=max(0.02, min(0.2, deadline - time.monotonic()))
+                )
+            arrived = [f for f in followers if have(f)]
+        for f in followers:
+            if f not in arrived:
+                self._log(f"follower lost during {what}")
+        return arrived
 
     def decide(self, local_step: int, timeout_s: float = 20.0) -> int:
-        """Collect every follower's step report, broadcast the agreed
-        stop step (max of all, including ours), return it."""
+        """Collect every follower's (final) step report, broadcast the
+        agreed stop step (max of all, including ours), return it."""
         deadline = time.monotonic() + timeout_s
-        socks = self._wait_followers(deadline)
-        reports = self._recv_each(
-            socks, KIND_STEP_REPORT, deadline, "step report"
+        followers = self._wait_followers(deadline)
+        live = self._wait_inbox(
+            followers, lambda f: f.final_report is not None, deadline,
+            "step report",
         )
-        steps = [int(local_step)]
-        live: List[socket.socket] = []
-        for s, rep in zip(socks, reports):
-            if rep is not None:
-                steps.append(rep)
-                live.append(s)
+        steps = [int(local_step)] + [f.final_report for f in live]
         agreed = max(steps)
-        for s in live:
+        for f in live:
             try:
-                send_msg(s, KIND_STOP_STEP, agreed)
+                send_msg(f.sock, KIND_STOP_STEP, agreed)
             except OSError:
                 pass
         # Only reporters stay in the quorum: a follower that was dead
         # here cannot reach the agreed step, so barrier() must not
         # wait on it again.
-        with self._lock:
-            self._socks = live
+        with self._cond:
+            self._followers = live
         self._log(
             f"stop-step consensus: reports {steps} -> agreed {agreed}"
         )
@@ -503,19 +750,14 @@ class PreemptionLeader:
         """Wait for every (surviving) follower's save-complete frame,
         then release them all; True when the full quorum arrived."""
         deadline = time.monotonic() + timeout_s
-        with self._lock:
-            socks = list(self._socks)
-        arrived = [
-            s
-            for s, got in zip(
-                socks,
-                self._recv_each(socks, KIND_BARRIER, deadline, "barrier"),
-            )
-            if got is not None
-        ]
-        for s in arrived:
+        with self._cond:
+            followers = list(self._followers)
+        arrived = self._wait_inbox(
+            followers, lambda f: f.barrier_arrived, deadline, "barrier"
+        )
+        for f in arrived:
             try:
-                send_msg(s, KIND_BARRIER_OK)
+                send_msg(f.sock, KIND_BARRIER_OK)
             except OSError:
                 pass
         return len(arrived) == self.n_followers
@@ -527,13 +769,21 @@ class PreemptionLeader:
         except OSError:
             pass
         self._accept_thread.join(timeout=2.0)
-        with self._lock:
-            socks, self._socks = self._socks, []
-        for s in socks:
+        with self._cond:
+            followers = list(self._all_followers)
+            self._followers = []
+            self._all_followers = []
+        for f in followers:
             try:
-                s.close()
+                f.sock.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
+            try:
+                f.sock.close()
+            except OSError:
+                pass
+        for t in self._reader_threads:
+            t.join(timeout=2.0)
 
 
 class PreemptionFollower:
@@ -566,6 +816,49 @@ class PreemptionFollower:
                     raise
                 time.sleep(0.1)
         self._sock.settimeout(None)
+        self._telemetry_dead = False
+
+    def report_step(self, step: int) -> None:
+        """Periodic HEALTHY-training step report — the feed of the
+        leader's ``coord_step_lag`` metric. Carries a marker array so
+        the leader can tell it from the final preemption report (which
+        has none); best-effort and bounded, because telemetry must
+        never stall or fail a training step."""
+        if self._telemetry_dead:
+            return
+        try:
+            self._sock.settimeout(2.0)
+            send_msg(
+                self._sock, KIND_STEP_REPORT, int(step),
+                [np.asarray([1], np.int64)],
+            )
+        except (socket.timeout, ConnectionError, OSError) as e:
+            # A timed-out send may have written PART of the frame: the
+            # stream is desynced beyond repair (transport.py treats
+            # client-side send timeouts the same way), and the later
+            # consensus exchange (decide/barrier) would misparse on
+            # both ends — the leader's reader would mark us dead while
+            # we silently wait out the full decide window. Kill the
+            # link NOW so decide() fails fast into its loud
+            # uncoordinated-save fallback instead — and say so ONCE,
+            # or the degradation is undiagnosable until a real
+            # preemption discovers it hours later.
+            if not self._telemetry_dead:
+                self._telemetry_dead = True
+                self._log(
+                    f"step-report send failed ({e!r}); severing the "
+                    f"consensus link — a preemption on this host will "
+                    f"save UNCOORDINATED"
+                )
+                try:
+                    self._sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+        finally:
+            try:
+                self._sock.settimeout(None)
+            except OSError:
+                pass
 
     def decide(self, local_step: int, timeout_s: float = 20.0) -> int:
         """Report our step; block for the leader's agreed stop step.

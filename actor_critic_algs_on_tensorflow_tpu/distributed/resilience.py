@@ -245,6 +245,42 @@ class ResilientActorClient:
         with self._lock:
             return self._op(lambda c: c.fetch_params())
 
+    def poll_notified(self) -> int:
+        """Drain already-arrived publish notifies without blocking;
+        returns the newest notified param version (0 = none). Advisory
+        — a transport fault here just drops the connection (the next
+        real operation reconnects and retries); it is never worth a
+        backoff loop of its own."""
+        with self._lock:
+            if self._client is None:
+                return 0
+            try:
+                return self._client.poll_notified()
+            except LearnerShutdown:
+                raise
+            except (ConnectionError, OSError):
+                self._drop()
+                return 0
+
+    def wait_params_notify(self, timeout: float) -> int:
+        """Block up to ``timeout`` for a publish notify (reconnecting
+        first if the link is down); returns the newest notified version
+        or 0. Fault semantics match ``poll_notified``: a broken wait
+        returns 0 and the next operation pays the reconnect."""
+        with self._lock:
+            try:
+                client = self._ensure_connected()
+            except (ConnectionError, OSError):
+                time.sleep(min(timeout, 0.2))
+                return 0
+            try:
+                return client.wait_params_notify(timeout)
+            except LearnerShutdown:
+                raise
+            except (ConnectionError, OSError):
+                self._drop()
+                return 0
+
     def stats(self) -> dict:
         return {"reconnects": self.reconnects, "retries": self.retries}
 
@@ -328,6 +364,8 @@ class ChaosProxy:
                  *, host: str = "127.0.0.1", port: int = 0):
         self._lock = threading.Lock()
         self._target = (target_host, target_port)
+        self._fallback: Tuple[str, int] | None = None
+        self.fallback_connections = 0
         self._delay = 0.0
         self._refuse = False
         self._truncate_after: int | None = None
@@ -354,6 +392,17 @@ class ChaosProxy:
     def set_target(self, host: str, port: int) -> None:
         with self._lock:
             self._target = (host, port)
+
+    def set_fallback(self, host: str | None, port: int = 0) -> None:
+        """Secondary upstream tried when the primary target REFUSES a
+        connection (its listener is gone — in the control plane that
+        means the learner died). Clients then land on the fallback —
+        the hot standby's pre-takeover listener — on their FIRST retry
+        instead of accumulating backoff against a dead address, which
+        is exactly the reconnect-backoff term of the failover gap.
+        ``None`` clears."""
+        with self._lock:
+            self._fallback = (host, port) if host is not None else None
 
     def set_delay(self, seconds: float) -> None:
         with self._lock:
@@ -417,8 +466,20 @@ class ChaosProxy:
             try:
                 upstream = socket.create_connection(target, timeout=2.0)
             except OSError:
-                _hard_reset(client)
-                continue
+                with self._lock:
+                    fallback = self._fallback
+                if fallback is None:
+                    _hard_reset(client)
+                    continue
+                try:
+                    upstream = socket.create_connection(
+                        fallback, timeout=2.0
+                    )
+                except OSError:
+                    _hard_reset(client)
+                    continue
+                with self._lock:
+                    self.fallback_connections += 1
             link = _Link(client, upstream, truncate)
             with self._lock:
                 self._links = [l for l in self._links if not l.closed]

@@ -49,6 +49,7 @@ Fault tolerance (see ``distributed.resilience`` for the retry layer):
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import math
 import select
@@ -61,6 +62,8 @@ from typing import Callable, Dict, List, Sequence, Tuple
 
 import numpy as np
 
+from actor_critic_algs_on_tensorflow_tpu.distributed import codec
+
 MAGIC = b"ACTT"
 KIND_TRAJ = 1         # actor -> learner: trajectory + episode-info leaves
 KIND_ACK = 2          # learner -> actor: tag = current param version
@@ -72,10 +75,20 @@ KIND_PONG = 7         # heartbeat reply
 # --- control plane (distributed.controlplane) ------------------------
 KIND_HELLO = 8        # peer -> learner: [actor_id, generation, role]
 KIND_HANDOFF = 9      # learner -> standby: take over NOW (planned handoff)
-KIND_STEP_REPORT = 10  # follower -> leader: tag = local step at preemption
+KIND_STEP_REPORT = 10  # follower -> leader: tag = local step (final at
+#                        preemption: no arrays; periodic during HEALTHY
+#                        training: one marker array — see controlplane)
 KIND_STOP_STEP = 11    # leader -> follower: tag = agreed final step
 KIND_BARRIER = 12      # follower -> leader: reached the agreed step + saved
 KIND_BARRIER_OK = 13   # leader -> follower: everyone arrived; exit now
+# --- param-sync data plane (distributed.codec) -----------------------
+KIND_PARAMS_CODED = 14   # learner -> peer: tag = version, arrays =
+#                          [codec meta] + coded leaves (delta vs the
+#                          version the peer reported holding, or a full
+#                          coded frame when bf16 wire-cast is on)
+KIND_PARAMS_NOTIFY = 15  # learner -> peer: tag = freshly published
+#                          version, no arrays — fetch now (push-based
+#                          publish discovery; newest wins)
 
 # KIND_HELLO role field values.
 ROLE_ACTOR = 0
@@ -377,19 +390,29 @@ class LearnerServer:
         port: int = 0,
         idle_timeout_s: float | None = None,
         max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+        param_delta: bool = True,
+        param_delta_ring: int = 4,
+        param_bf16: bool = False,
         log: Callable[[str], None] | None = None,
     ):
-        self._on_trajectory = on_trajectory
-        # A 3-parameter callback opts into connection provenance
-        # (PeerInfo from the hello frame) alongside the leaves.
-        try:
-            import inspect
-
-            n_params = len(inspect.signature(on_trajectory).parameters)
-        except (TypeError, ValueError):
-            n_params = 2
-        self._pass_peer = n_params >= 3
+        self._sink = self._make_sink(on_trajectory)
         self._idle_timeout = idle_timeout_s
+        # Param wire codec (distributed.codec): keep a small ring of
+        # recent published versions' wire leaves and serve an XOR-delta
+        # (+ zlib) against the version the client reports holding; full
+        # frame on a ring miss. param_bf16 additionally wire-casts f32
+        # leaves to bf16 for ROLE_ACTOR peers ONLY (lossy; V-trace
+        # corrects actor-side drift — standbys/tailers always get full
+        # precision, their copy seeds a takeover learner).
+        self._param_delta = param_delta
+        self._param_ring_size = max(2, param_delta_ring)
+        self._param_bf16 = param_bf16
+        # version -> {bf16_variant: (wire_leaves, flags, crcs)}
+        self._param_ring: "collections.OrderedDict[int, dict]" = (
+            collections.OrderedDict()
+        )
+        # (base_version, target_version, bf16_variant) -> (arrays, crcs)
+        self._delta_cache: Dict[tuple, tuple] = {}
         self._max_frame_bytes = max_frame_bytes
         self._log = log if log is not None else (
             lambda msg: print(f"[learner-server] {msg}", flush=True)
@@ -417,6 +440,11 @@ class LearnerServer:
         self._hellos = 0
         self._checksum_failures = 0
         self._handoffs_sent = 0
+        self._bytes_out = 0
+        self._param_sends = 0
+        self._param_delta_sends = 0
+        self._param_bytes_out = 0
+        self._notifies_sent = 0
         self._listener = socket.create_server((host, port))
         self._listener.settimeout(0.2)
         self.port = self._listener.getsockname()[1]
@@ -425,24 +453,122 @@ class LearnerServer:
         )
         self._accept_thread.start()
 
-    def publish(self, param_leaves: Sequence[np.ndarray]) -> int:
-        """Publish new weights; returns the new version."""
+    @staticmethod
+    def _make_sink(on_trajectory):
+        """(callback, pass_peer) — a 3-parameter callback opts into
+        connection provenance (PeerInfo from the hello frame)."""
+        try:
+            import inspect
+
+            n_params = len(inspect.signature(on_trajectory).parameters)
+        except (TypeError, ValueError):
+            n_params = 2
+        return (on_trajectory, n_params >= 3)
+
+    def set_trajectory_sink(self, on_trajectory) -> None:
+        """Swap the trajectory callback on a LIVE server — the hot
+        standby binds its listener (and absorbs actor reconnects) long
+        before takeover, discarding pushes until the real learner loop
+        takes the stream over. One attribute store (GIL-atomic); frames
+        in flight land on whichever sink they raced."""
+        self._sink = self._make_sink(on_trajectory)
+
+    @staticmethod
+    def _crcs_of(arrays: Sequence[np.ndarray]) -> List[int]:
+        return [
+            zlib.crc32(memoryview(np.ascontiguousarray(a)).cast("B"))
+            if a.nbytes else 0
+            for a in arrays
+        ]
+
+    def publish(
+        self, param_leaves: Sequence[np.ndarray], *, notify: bool = True
+    ) -> int:
+        """Publish new weights; returns the new version.
+
+        With the codec enabled the wire variants (full precision, and
+        bf16-cast when ``param_bf16``) join the version ring that delta
+        serving decodes against; ``notify`` broadcasts a tiny
+        ``KIND_PARAMS_NOTIFY`` to every live peer so actors fetch NOW
+        instead of discovering the version on their next push ack."""
+        # ascontiguousarray promotes 0-d to 1-d on this numpy; restore
+        # the original shape so wire leaves mirror the real structure.
         leaves = [
-            np.ascontiguousarray(np.asarray(p)) for p in param_leaves
+            np.ascontiguousarray(a).reshape(a.shape)
+            for a in map(np.asarray, param_leaves)
         ]
         # CRC once per PUBLISH, not once per actor send: the payload is
         # byte-identical for every peer fetching this version, so with
         # K actors the connection threads would otherwise burn K full
         # passes over GB-scale params per publish.
-        crcs = [
-            zlib.crc32(memoryview(a).cast("B")) if a.nbytes else 0
-            for a in leaves
-        ]
+        crcs = self._crcs_of(leaves)
+        variants = None
+        if self._param_delta or self._param_bf16:
+            # Full-precision wire leaves ARE the published leaves (and
+            # their CRCs) — no copy; the bf16 variant costs one pack
+            # pass per publish, only when enabled.
+            variants = {False: (leaves, [0] * len(leaves), crcs)}
+            if self._param_bf16:
+                wire16, flags16 = codec.wire_cast(leaves, bf16=True)
+                variants[True] = (wire16, flags16, self._crcs_of(wire16))
         with self._params_lock:
             self._param_leaves = leaves
             self._param_crcs = crcs
             self._version += 1
-            return self._version
+            version = self._version
+            if variants is not None:
+                self._param_ring[version] = variants
+                while len(self._param_ring) > self._param_ring_size:
+                    self._param_ring.popitem(last=False)
+                # Deltas target the (previous) current version only:
+                # stale targets are never requested again.
+                self._delta_cache.clear()
+        if notify:
+            self._broadcast_notify(version)
+        return version
+
+    def _broadcast_notify(self, version: int) -> None:
+        """Best-effort KIND_PARAMS_NOTIFY to every live peer. Never
+        blocks a publish on a wedged peer: busy send locks are skipped
+        (that peer has a send in flight — it will learn the version
+        from its ack/fetch), as are peers whose send buffer is full (a
+        peer that stopped draining is wedged; same recovery). The
+        socket's timeout is deliberately NOT touched: it is shared
+        with the serve thread's recv loop, and mutating it here races
+        an in-progress recv into a spurious idle timeout — or, via the
+        fd's non-blocking flag, a ``BlockingIOError`` that tears down
+        a healthy connection."""
+        frame = pack_arrays(KIND_PARAMS_NOTIFY, version, ())
+        with self._reg_lock:
+            live = list(self._conns.values())
+        sent = 0
+        for c in live:
+            if not c.send_lock.acquire(blocking=False):
+                continue
+            try:
+                _, writable, _ = select.select([], [c.sock], [], 0)
+                if not writable:
+                    continue
+                n = c.sock.send(frame)
+                if n != len(frame):
+                    # A torn header desyncs every later frame on this
+                    # stream. A writable TCP socket takes this tiny
+                    # frame whole (>= SO_SNDLOWAT free, and we hold
+                    # the send lock), so this is effectively
+                    # unreachable — but kill the link rather than let
+                    # the peer misparse.
+                    c.sock.shutdown(socket.SHUT_RDWR)
+                    continue
+                sent += 1
+            except (OSError, ValueError):
+                # ValueError: the serve thread closed this socket
+                # between the registry snapshot and the select (a
+                # closed socket's fd is -1).
+                pass
+            finally:
+                c.send_lock.release()
+        with self._reg_lock:
+            self._notifies_sent += sent
 
     @property
     def version(self) -> int:
@@ -465,6 +591,15 @@ class LearnerServer:
                 "transport_hellos": self._hellos,
                 "transport_checksum_failures": self._checksum_failures,
                 "transport_handoffs_sent": self._handoffs_sent,
+                # Outbound accounting: the codec's win must be visible
+                # in the same log stream it optimizes.
+                "transport_mb_out": round(self._bytes_out / 1e6, 6),
+                "transport_param_sends": self._param_sends,
+                "transport_param_delta_sends": self._param_delta_sends,
+                "transport_param_mb_out": round(
+                    self._param_bytes_out / 1e6, 6
+                ),
+                "transport_notifies_sent": self._notifies_sent,
             }
 
     def connections(self) -> List[dict]:
@@ -523,9 +658,89 @@ class LearnerServer:
 
     def _send(
         self, c: _Conn, kind: int, tag: int = 0, arrays=(), crcs=None
-    ) -> None:
+    ) -> int:
+        parts = frame_views(kind, tag, arrays, crcs)
+        # Header bytes are `bytes`, payloads are uint8-cast memoryviews:
+        # len() is exact wire bytes either way.
+        nbytes = sum(len(p) for p in parts)
         with c.send_lock:
-            send_msg(c.sock, kind, tag, arrays, crcs)
+            _sendmsg_all(c.sock, parts)
+        with self._reg_lock:
+            self._bytes_out += nbytes
+        return nbytes
+
+    def _send_params(self, c: _Conn, held_version: int) -> None:
+        """Serve the current params to ``c``, which reports holding
+        ``held_version`` (0 = nothing). Ring hit -> XOR-delta + zlib
+        coded frame (cached per (base, target, variant) so K actors on
+        one version cost ONE encode); miss -> full frame — coded when
+        the peer's variant wire-casts (bf16 actors), else the legacy
+        ``KIND_PARAMS``. All payload CRCs are computed once per encode,
+        never per peer."""
+        encode_args = None
+        with self._params_lock:
+            version = self._version
+            use16 = self._param_bf16 and c.role == ROLE_ACTOR
+            target = self._param_ring.get(version)
+            base = (
+                self._param_ring.get(held_version)
+                if (
+                    self._param_delta
+                    and target is not None
+                    # <=: a fetch by an already-current peer (the param
+                    # tailer's idle safety fetch) gets a zero-XOR delta
+                    # that compresses to a few bytes per leaf, not a
+                    # full resend.
+                    and 0 < held_version <= version
+                )
+                else None
+            )
+            key = (held_version, version, use16)
+            cached = self._delta_cache.get(key) if base is not None else None
+            if cached is None and base is not None:
+                # Encode OUTSIDE the lock (zlib over the params): ring
+                # entries are immutable once placed, so references are
+                # safe to carry out.
+                encode_args = (base[use16], target[use16])
+            full_leaves, full_crcs = self._param_leaves, self._param_crcs
+            if target is not None and use16:
+                full_coded = target[True]
+            else:
+                full_coded = None
+        if encode_args is not None:
+            (base_wire, _, _), (new_wire, new_flags, _) = encode_args
+            arrays = codec.encode_delta(
+                base_wire, new_wire, new_flags, held_version
+            )
+            cached = (arrays, self._crcs_of(arrays))
+            with self._params_lock:
+                # Still-current targets only: publish() cleared stale
+                # entries and will again, but never resurrect one.
+                if self._version == version:
+                    self._delta_cache[key] = cached
+        if cached is not None:
+            arrays, crcs = cached
+            n = self._send(c, KIND_PARAMS_CODED, version, arrays, crcs=crcs)
+            delta = True
+        elif full_coded is not None:
+            wire, flags, crcs = full_coded
+            arrays = codec.encode_full(wire, flags)
+            # encode_full prepends one small meta array; CRC it alone.
+            n = self._send(
+                c, KIND_PARAMS_CODED, version, arrays,
+                crcs=self._crcs_of(arrays[:1]) + list(crcs),
+            )
+            delta = False
+        else:
+            n = self._send(
+                c, KIND_PARAMS, version, full_leaves, crcs=full_crcs
+            )
+            delta = False
+        with self._reg_lock:
+            self._param_sends += 1
+            self._param_bytes_out += n
+            if delta:
+                self._param_delta_sends += 1
 
     def _retire(self, c: _Conn, reason: str) -> None:
         with self._reg_lock:
@@ -582,29 +797,26 @@ class LearnerServer:
                     elif kind == KIND_PING:
                         self._pings += 1
                 if kind == KIND_TRAJ:
-                    if self._pass_peer:
+                    on_trajectory, pass_peer = self._sink
+                    if pass_peer:
                         with self._reg_lock:
                             peer = PeerInfo(
                                 c.cid, c.actor_id, c.generation, c.role
                             )
-                        ok = self._on_trajectory(
+                        ok = on_trajectory(
                             arrays[:tag], arrays[tag:], peer
                         )
                     else:
-                        ok = self._on_trajectory(arrays[:tag], arrays[tag:])
+                        ok = on_trajectory(arrays[:tag], arrays[tag:])
                     if ok is False:
                         with self._reg_lock:
                             c.rejected += 1
                             self._rejected += 1
                     self._send(c, KIND_ACK, self._version)
                 elif kind == KIND_GET_PARAMS:
-                    with self._params_lock:
-                        leaves, crcs, version = (
-                            self._param_leaves,
-                            self._param_crcs,
-                            self._version,
-                        )
-                    self._send(c, KIND_PARAMS, version, leaves, crcs=crcs)
+                    # tag = the version the client already holds (0 =
+                    # none / legacy client): ring hit -> delta frame.
+                    self._send_params(c, held_version=tag)
                 elif kind == KIND_PING:
                     self._send(c, KIND_PONG, tag)
                 elif kind == KIND_HELLO:
@@ -769,6 +981,22 @@ class ActorClient:
         self._heartbeat = heartbeat_interval_s
         self._idle = idle_timeout_s
         self._max_frame_bytes = max_frame_bytes
+        # Param codec held state: the wire leaves of the last fetched
+        # version, the delta base the server encodes against. Lives
+        # and dies WITH the connection (ResilientActorClient recreates
+        # this object on reconnect), so a reconnect — possibly onto a
+        # DIFFERENT learner whose version counter collides numerically
+        # — always reports held 0 and gets a full frame.
+        self._held_version = 0
+        self._held_wire: List[np.ndarray] | None = None
+        # Newest param version KNOWN on this connection — the newest
+        # KIND_PARAMS_NOTIFY seen OR the version a completed fetch
+        # returned (0 = neither). Push-based publish discovery:
+        # poll_notified() lets the caller fetch the moment a publish
+        # lands instead of learning about it from the next push ack;
+        # folding fetches in keeps a notify whose successor broadcast
+        # was skipped from looking eternally unsatisfied.
+        self.notified_version = 0
         if hello is not None:
             # Announce (actor_id, generation, role) at connect time so
             # the server has connection-level provenance before any
@@ -836,15 +1064,78 @@ class ActorClient:
                 sock.settimeout(None)
 
     def _await_reply(self) -> Tuple[int, int, List[np.ndarray]]:
-        """Next substantive frame: skips PONGs, turns ``KIND_CLOSE``
-        into ``LearnerShutdown``."""
+        """Next substantive frame: skips PONGs (and publish notifies,
+        recording their version), turns ``KIND_CLOSE`` into
+        ``LearnerShutdown``."""
         while True:
             kind, tag, arrays = self._next_frame()
             if kind == KIND_PONG:
                 continue
+            if kind == KIND_PARAMS_NOTIFY:
+                self.notified_version = tag
+                continue
             if kind == KIND_CLOSE:
                 raise LearnerShutdown("learner closed the stream")
+            if kind == KIND_HANDOFF:
+                raise LearnerShutdown("primary handing off")
             return kind, tag, arrays
+
+    def poll_notified(self) -> int:
+        """Drain frames that have ALREADY arrived (publish notifies,
+        stray pongs) without blocking; returns the newest param
+        version KNOWN on this connection — via notify or a completed
+        fetch (0 = neither yet). The request/reply protocol
+        guarantees no reply frame can be in flight here, so anything
+        readable is server-initiated."""
+        return self._drain_notify(deadline=None)
+
+    def wait_params_notify(self, timeout: float) -> int:
+        """Block up to ``timeout`` for a publish notify; returns the
+        newest notified version (possibly one that arrived earlier),
+        0 if none. The param tailer's steady state: sleep HERE, fetch
+        on wake — publish-to-visible latency becomes one RTT instead
+        of half the poll interval."""
+        return self._drain_notify(deadline=time.monotonic() + timeout)
+
+    def _drain_notify(self, deadline: float | None) -> int:
+        sock = self._sock
+        while True:
+            wait = 0.0
+            if deadline is not None:
+                wait = max(0.0, deadline - time.monotonic())
+            readable, _, _ = select.select([sock], [], [], wait)
+            if not readable:
+                return self.notified_version
+            # Server-initiated frames are tiny (17-byte headers); a
+            # mid-frame stall still trips the idle deadline below.
+            if self._idle is not None:
+                sock.settimeout(self._idle)
+            try:
+                kind, tag, _ = recv_msg(
+                    sock, max_frame_bytes=self._max_frame_bytes
+                )
+            except socket.timeout as e:
+                raise ConnectionError("peer stalled mid-frame") from e
+            finally:
+                sock.settimeout(None)
+            if kind == KIND_PARAMS_NOTIFY:
+                if deadline is not None:
+                    self.notified_version = tag
+                    return tag
+                self.notified_version = max(self.notified_version, tag)
+            elif kind == KIND_CLOSE:
+                raise LearnerShutdown("learner closed the stream")
+            elif kind == KIND_HANDOFF:
+                # The primary is handing the fleet off (preemption):
+                # it is done publishing. For the notify-sleeping param
+                # tailer this is the orderly end of the tail, not a
+                # protocol error that would send it into reconnect
+                # backoff against a shutting-down learner.
+                raise LearnerShutdown("primary handing off")
+            elif kind != KIND_PONG:
+                raise ConnectionError(
+                    f"unsolicited frame kind {kind} outside a reply wait"
+                )
 
     def push_trajectory(
         self,
@@ -862,11 +1153,54 @@ class ActorClient:
         return tag
 
     def fetch_params(self) -> Tuple[int, List[np.ndarray]]:
-        self._send(KIND_GET_PARAMS)
+        """Fetch the newest published params, reporting the version
+        this connection already holds so the server can reply with a
+        delta frame. Returns host-precision leaves either way; the
+        delta path is lossless (bit-exact vs the published leaves), and
+        a codec failure surfaces as ``ConnectionError`` so the
+        resilient wrapper reconnects — a fresh connection holds
+        nothing and always gets a full frame."""
+        self._send(KIND_GET_PARAMS, self._held_version)
         kind, version, leaves = self._await_reply()
-        if kind != KIND_PARAMS:
+        if kind == KIND_PARAMS:
+            # Legacy full frame: these leaves ARE the wire leaves the
+            # server's ring stores for this version — the delta base.
+            self._held_version = version
+            self._held_wire = [np.ascontiguousarray(a) for a in leaves]
+            # The reply serves the NEWEST published version (sends on
+            # this connection are serialized), so any notify recorded
+            # before it is satisfied by this fetch — without this, a
+            # notify whose successor broadcast was skipped (send lock
+            # busy) leaves notified != held forever and the caller
+            # re-fetches every poll during a publish lull.
+            self.notified_version = version
+            return version, leaves
+        if kind != KIND_PARAMS_CODED:
             raise ConnectionError(f"expected PARAMS, got kind {kind}")
-        return version, leaves
+        try:
+            base_version, _ = codec.parse_meta(leaves[0]) if leaves else (
+                0, []
+            )
+            held = (
+                self._held_wire
+                if base_version and base_version == self._held_version
+                else None
+            )
+            if base_version and held is None:
+                raise codec.CodecError(
+                    f"delta against version {base_version}, holding "
+                    f"{self._held_version}"
+                )
+            _, wire, flags = codec.decode(leaves, held)
+        except codec.CodecError as e:
+            # Drop the held state WITH the connection: the retry layer
+            # reconnects and the fresh connection fetches a full frame.
+            self._held_version, self._held_wire = 0, None
+            raise ConnectionError(f"param codec failure: {e}") from e
+        self._held_version = version
+        self._held_wire = wire
+        self.notified_version = version  # this fetch satisfies notifies
+        return version, codec.unwire(wire, flags)
 
     def close(self) -> None:
         try:

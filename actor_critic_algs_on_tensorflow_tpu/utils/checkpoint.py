@@ -112,6 +112,22 @@ class Checkpointer:
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 
+    def step_written_at(self, step: int) -> float | None:
+        """Wall-clock mtime of ``step``'s checkpoint directory — when
+        the WRITER produced it, regardless of when this process
+        noticed. A standby uses this to order a tailed checkpoint's
+        CONTENT against the param-publish stream (observation time
+        overstates a checkpoint's age by the poll + restore lag).
+        ``None`` if the path is gone (retention) or unreadable."""
+        try:
+            return os.path.getmtime(
+                os.path.join(
+                    os.fspath(self._mgr.directory), str(int(step))
+                )
+            )
+        except (OSError, ValueError):
+            return None
+
     def all_steps(self) -> list[int]:
         return sorted(int(s) for s in self._mgr.all_steps())
 

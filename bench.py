@@ -32,6 +32,14 @@ learner iteration time alongside steps/sec (the overlap the pipeline
 exists to hide). Merged into the same JSON line under
 ``"impala_pipeline"``; off by default so the driver contract is
 unchanged.
+
+Optional param-sync wire leg (``BENCH_PARAMS=1``): a third subprocess
+replays a converging CartPole publish stream through a real
+LearnerServer/ActorClient pair and reports wire bytes per
+publish-fetch for the delta codec vs full frames, plus the
+publish->actor-visible latency through the notify broadcast. Merged
+under ``"param_plane"``; same off-by-default contract. (The leg runs
+on CPU — wire bytes are device-independent.)
 """
 
 from __future__ import annotations
@@ -176,6 +184,63 @@ def measure_impala() -> dict:
     return out
 
 
+def measure_params() -> dict:
+    """Param-sync wire codec leg (scripts/controlplane_bench.py owns
+    the measurement helpers): per-fetch wire bytes over a converging
+    CartPole publish stream — full frames vs lossless delta (and the
+    opt-in bf16+delta variant) — plus publish->visible latency
+    percentiles through the KIND_PARAMS_NOTIFY wake path."""
+    import statistics
+
+    import numpy as np
+
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts"),
+    )
+    import controlplane_bench as cpb
+
+    n = int(os.environ.get("BENCH_PARAMS_VERSIONS", 40))
+    versions, _ = cpb._converging_param_stream(n)
+    full_b, _, _ = cpb._wire_fetch_bytes(versions, param_delta=False)
+    delta_b, _, last = cpb._wire_fetch_bytes(versions, param_delta=True)
+    for a, b in zip(last, versions[-1]):
+        np.testing.assert_array_equal(a, b)  # delta stream is lossless
+    bf16_b, _, _ = cpb._wire_fetch_bytes(
+        versions, param_delta=True, param_bf16=True
+    )
+    # Fetch 0 bootstraps with a full frame on every variant; the
+    # steady state is everything after it.
+    full = statistics.mean(full_b)
+    delta = statistics.mean(delta_b[1:])
+    out = {
+        "full_kib_per_fetch": round(full / 1024, 2),
+        "delta_kib_per_fetch": round(delta / 1024, 2),
+        "wire_reduction": round(full / delta, 2),
+        "bf16_delta_kib_per_fetch": round(
+            statistics.mean(bf16_b[1:]) / 1024, 2
+        ),
+        "versions": n,
+    }
+
+    lat_ms = _notify_latencies_ms(cpb, versions)
+    if lat_ms:
+        out["notify_visible_ms_p50"] = round(
+            float(np.percentile(lat_ms, 50)), 2
+        )
+        out["notify_visible_ms_p95"] = round(
+            float(np.percentile(lat_ms, 95)), 2
+        )
+    return out
+
+
+def _notify_latencies_ms(cpb, versions) -> list:
+    """publish() -> fetch-complete latencies (ms); the harness itself
+    lives in controlplane_bench (single source of truth)."""
+    n_pub = int(os.environ.get("BENCH_PARAMS_NOTIFIES", 30))
+    return [s * 1e3 for s in cpb._notify_latencies(versions, n_pub)]
+
+
 def main() -> int:
     rollout = int(os.environ.get("BENCH_ROLLOUT", 128))
     timed_iters = int(os.environ.get("BENCH_ITERS", 10))
@@ -183,6 +248,15 @@ def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] == "--measure-impala":
         try:
             print(json.dumps(measure_impala()))
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+            return 1
+        return 0
+
+    if len(sys.argv) > 1 and sys.argv[1] == "--measure-params":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        try:
+            print(json.dumps(measure_params()))
         except Exception:
             traceback.print_exc(file=sys.stderr)
             return 1
@@ -299,6 +373,23 @@ def main() -> int:
         except Exception:
             sys.stderr.write(
                 "[bench] impala pipeline leg failed\n"
+                + (child.stderr[-2000:] if "child" in dir() else "")
+            )
+    if os.environ.get("BENCH_PARAMS"):
+        try:
+            child = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--measure-params"],
+                capture_output=True,
+                text=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                timeout=int(os.environ.get("BENCH_CHILD_TIMEOUT", 900)),
+            )
+            payload["param_plane"] = json.loads(
+                child.stdout.strip().splitlines()[-1]
+            )
+        except Exception:
+            sys.stderr.write(
+                "[bench] param plane leg failed\n"
                 + (child.stderr[-2000:] if "child" in dir() else "")
             )
     print(json.dumps(payload))

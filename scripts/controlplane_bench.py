@@ -1,21 +1,30 @@
-"""Control-plane ledger measurements (ISSUE 4, PERF.md "Control plane").
+"""Control-plane ledger measurements (ISSUE 4 + ISSUE 5, PERF.md
+"Control plane" / "Param data plane").
 
-Three legs, each printed as one line of evidence:
+Legs, each printed as one line of evidence:
 
   1. failover gap — kill the primary learner mid-run and measure
      kill -> first learner step completed by the successor, for BOTH
      recovery modes: the warm standby (programs compiled + checkpoint
-     tailed in memory before the kill) and the old-world
-     restart-from-disk (fresh process: import jax, compile, restore,
-     then serve). Same actor fleet, same redirector, same config.
+     tailed in memory before the kill; since ISSUE 5 also param-tailed
+     and serving early, with the redirector's fallback landing actors
+     on it pre-takeover) and the old-world restart-from-disk (fresh
+     process: import jax, compile, restore, then serve). Same actor
+     fleet, same redirector, same config.
   2. delayed guard check — sentinel metrics fetch same-step vs
      one-step-late over the identical learner_step stream (no actors:
      isolates the fetch stall the delay exists to hide).
   3. wire checksum cost — zlib.crc32 throughput over a typical
      trajectory frame's payload bytes (the per-leaf CRC is one pass
      over data that crosses the kernel boundary anyway).
+  4. param wire codec — bytes per publish-fetch through the REAL wire
+     on a converging CartPole run (delta + shuffle + zlib vs the full
+     frame), split by training phase (deltas shrink as lr decays).
+  5. publish -> actor-visible latency — KIND_PARAMS_NOTIFY wake +
+     delta fetch, measured publish() to fetch-complete.
 
-Run: JAX_PLATFORMS=cpu python scripts/controlplane_bench.py
+Run: JAX_PLATFORMS=cpu python scripts/controlplane_bench.py [leg]
+(legs: checksum guard warm cold params notify all)
 """
 
 import dataclasses
@@ -126,7 +135,6 @@ def failover_leg(mode: str) -> float:
     gap = None
     if mode == "warm":
         # Standby compiles + tails BEFORE the kill (the steady state).
-        programs_ready = []
         import threading
 
         result = {}
@@ -134,6 +142,18 @@ def failover_leg(mode: str) -> float:
         def redirect(h, p):
             result.setdefault("redirect_t", time.monotonic())
             redirector.redirect(h, p)
+
+        def on_serving(h, p):
+            # The hot-standby data plane is up: arm the fallback route
+            # so actors that lose the primary land on the standby on
+            # their FIRST retry (reconnect paid pre-takeover).
+            redirector.set_fallback(h, p)
+
+        ready = threading.Event()
+
+        def on_ready(monitor):
+            result["monitor"] = monitor
+            ready.set()
 
         def standby():
             first = []
@@ -151,18 +171,32 @@ def failover_leg(mode: str) -> float:
                 heartbeat_interval_s=0.2, takeover_deadline_s=1.0,
                 log_interval=1, log_fn=log_fn,
                 checkpoint_interval=10**9,
+                on_serving=on_serving, on_ready=on_ready,
             )
 
         t = threading.Thread(target=standby, daemon=True)
         t.start()
-        time.sleep(8.0)  # let the standby warm-compile + tail
+        # Steady state first: the warm compile's duration varies, so a
+        # fixed sleep can kill the primary BEFORE the monitor's first
+        # contact — that measures the never-seen takeover grace, not
+        # the failover. ``on_ready`` is the supervisor contract for
+        # "the pair is armed"; one pong proves first contact, and a
+        # short settle lets the param tailer land steady-state fetches.
+        if not ready.wait(timeout=240.0):
+            raise RuntimeError("standby never armed (warm compile hung?)")
+        mon = result["monitor"]
+        arm_deadline = time.monotonic() + 60.0
+        while mon.pongs < 1 and time.monotonic() < arm_deadline:
+            time.sleep(0.05)
+        time.sleep(2.0)
         os.kill(primary.pid, signal.SIGKILL)
         t_kill = time.monotonic()
         t.join(timeout=570.0)
         gap = result["first_step_t"] - t_kill
         print(
             f"FAILOVER_WARM_SPLIT detect+bind={result['redirect_t'] - t_kill:.3f}s "
-            f"redirect->first_step={result['first_step_t'] - result['redirect_t']:.3f}s",
+            f"redirect->first_step={result['first_step_t'] - result['redirect_t']:.3f}s "
+            f"fallback_preconnects={redirector.fallback_connections}",
             flush=True,
         )
     else:
@@ -254,12 +288,185 @@ def checksum_leg():
     )
 
 
+def _converging_param_stream(n_versions: int):
+    """(leaves_per_version, cfg) from a REAL converging CartPole run:
+    single-process IMPALA (rollout -> learner_step), host-fetched
+    params after every step — the publish stream the distributed
+    learner would put on the wire."""
+    cfg = _cfg(1)
+    programs = impala.make_impala(cfg)
+    state = programs.init(jax.random.PRNGKey(0))
+    rollout, env_reset = programs.make_actor_programs(0)
+    env_state, obs, carry = env_reset(jax.random.PRNGKey(1))
+    key = jax.random.PRNGKey(2)
+    versions = []
+    for _ in range(n_versions):
+        key, k = jax.random.split(key)
+        env_state, obs, carry, traj, _ = rollout(
+            state.params, env_state, obs, carry, k
+        )
+        batch = impala.stack_trajectories([traj] * cfg.batch_trajectories)
+        state, _ = programs.learner_step(state, batch)
+        versions.append(
+            [np.asarray(x) for x in jax.tree_util.tree_leaves(
+                jax.device_get(state.params)
+            )]
+        )
+    return versions, cfg
+
+
+def _wire_fetch_bytes(versions, *, param_delta, param_bf16=False):
+    """Replay the publish stream through a REAL LearnerServer +
+    ActorClient pair (one fetch per publish, the actor steady state);
+    returns (per-fetch param bytes, per-fetch wall seconds, leaves of
+    the last fetch). Bytes come from the server's own outbound
+    accounting — the same counter the codec win is logged with."""
+    from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+        ActorClient,
+        LearnerServer,
+        ROLE_ACTOR,
+    )
+
+    server = LearnerServer(
+        lambda traj, ep: True,
+        param_delta=param_delta,
+        param_bf16=param_bf16,
+        log=lambda m: None,
+    )
+    try:
+        client = ActorClient(
+            "127.0.0.1", server.port, hello=(0, 0, ROLE_ACTOR)
+        )
+        per_fetch, times = [], []
+        last = None
+        for leaves in versions:
+            server.publish(leaves, notify=False)
+            before = server.metrics()["transport_param_mb_out"]
+            t0 = time.perf_counter()
+            _, last = client.fetch_params()
+            times.append(time.perf_counter() - t0)
+            after = server.metrics()["transport_param_mb_out"]
+            per_fetch.append((after - before) * 1e6)
+        client.close()
+        return per_fetch, times, last
+    finally:
+        server.close()
+
+
+def params_leg(n_versions: int = 60):
+    """Wire bytes per steady-state publish-fetch on a converging
+    CartPole run: lossless XOR-delta + shuffle + zlib vs the full
+    frame, split by training phase (early deltas churn more). Also
+    verifies the delta stream decodes bit-exact at the end, and
+    reports the opt-in bf16 wire variant."""
+    versions, _ = _converging_param_stream(n_versions)
+    full_b, _, _ = _wire_fetch_bytes(versions, param_delta=False)
+    delta_b, _, last = _wire_fetch_bytes(versions, param_delta=True)
+    for a, b in zip(last, versions[-1]):
+        np.testing.assert_array_equal(a, b)  # lossless, end of stream
+    bf16_b, _, _ = _wire_fetch_bytes(
+        versions, param_delta=True, param_bf16=True
+    )
+    full = np.mean(full_b)
+
+    def phase(xs):
+        third = max(1, len(xs) // 3)
+        return np.mean(xs[1:1 + third]), np.mean(xs[-third:])
+
+    d_early, d_late = phase(delta_b)
+    print(
+        f"PARAM_WIRE full={full / 1024:.1f}KiB/fetch "
+        f"delta={np.mean(delta_b[1:]) / 1024:.1f}KiB/fetch "
+        f"({full / np.mean(delta_b[1:]):.2f}x) "
+        f"early={d_early / 1024:.1f}KiB late={d_late / 1024:.1f}KiB "
+        f"bf16+delta={np.mean(bf16_b[1:]) / 1024:.1f}KiB/fetch "
+        f"({full / np.mean(bf16_b[1:]):.2f}x, opt-in lossy) "
+        f"[n={n_versions}, fetch 0 is the full-frame bootstrap]",
+        flush=True,
+    )
+
+
+def _notify_latencies(versions, n_publishes: int) -> list:
+    """publish() -> fetch-complete latencies (seconds): one warm
+    client holds v1 and sleeps on the KIND_PARAMS_NOTIFY broadcast
+    while a publisher thread pushes the stream; each wake delta-
+    fetches and the latency is publish-call to fetch-complete.
+    Shared by ``notify_leg`` here and ``bench.py --measure-params``
+    (single source of truth for the wait-loop/bookkeeping)."""
+    import threading
+
+    from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+        ActorClient,
+        LearnerServer,
+        ROLE_ACTOR,
+    )
+
+    server = LearnerServer(
+        lambda traj, ep: True, param_delta=True, log=lambda m: None
+    )
+    try:
+        server.publish(versions[0], notify=False)
+        client = ActorClient(
+            "127.0.0.1", server.port, hello=(0, 0, ROLE_ACTOR)
+        )
+        client.fetch_params()  # hold v1: steady-state delta fetches
+        lat = []
+        t_pub = {}
+        done = threading.Event()
+
+        def publisher():
+            for i in range(n_publishes):
+                time.sleep(0.02)
+                t_pub[i + 2] = time.perf_counter()  # version = i + 2
+                server.publish(versions[(i + 1) % len(versions)])
+            done.set()
+
+        t = threading.Thread(target=publisher, daemon=True)
+        t.start()
+        seen = 1
+        while seen < n_publishes + 1:
+            v = client.wait_params_notify(2.0)
+            if v <= seen:
+                if done.is_set():
+                    break
+                continue
+            version, _ = client.fetch_params()
+            lat.append(time.perf_counter() - t_pub[version])
+            seen = version
+        t.join(timeout=5.0)
+        client.close()
+        return lat
+    finally:
+        server.close()
+
+
+def notify_leg(n_publishes: int = 50):
+    """publish() -> actor-visible latency through KIND_PARAMS_NOTIFY:
+    the client sleeps on the notify broadcast and delta-fetches on
+    wake; measured from the publish call to fetch-complete. The
+    pre-notify world paid up to a full rollout+push round before the
+    piggybacked ack even revealed the version."""
+    versions, _ = _converging_param_stream(8)
+    lat = _notify_latencies(versions, n_publishes)
+    lat_ms = np.asarray(sorted(lat)) * 1e3
+    print(
+        f"PARAM_NOTIFY publish->visible p50={np.percentile(lat_ms, 50):.2f}ms "
+        f"p95={np.percentile(lat_ms, 95):.2f}ms max={lat_ms.max():.2f}ms "
+        f"(notify wake + delta fetch, n={len(lat)})",
+        flush=True,
+    )
+
+
 if __name__ == "__main__":
     leg = sys.argv[1] if len(sys.argv) > 1 else "all"
     if leg in ("all", "checksum"):
         checksum_leg()
     if leg in ("all", "guard"):
         guard_fetch_leg()
+    if leg in ("all", "params"):
+        params_leg()
+    if leg in ("all", "notify"):
+        notify_leg()
     if leg in ("all", "warm"):
         g = failover_leg("warm")
         print(f"FAILOVER_WARM gap={g:.3f}s (kill -> first learner step)")

@@ -1,0 +1,807 @@
+"""Param-sync data plane (ISSUE 5): delta/bf16 wire codec, push-based
+publish notifies, outbound transport accounting, the cross-host
+step-lag metric, and the hot-standby param tail.
+
+Codec correctness is pinned bit-exact (the delta path is lossless by
+construction — XOR + a byte permutation + DEFLATE — and by these
+tests); churn coverage drives the wire through reconnects and
+mid-fetch redirects, where a stale held-version base would corrupt
+weights silently if the protocol let it.
+"""
+
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from actor_critic_algs_on_tensorflow_tpu.distributed import codec
+from actor_critic_algs_on_tensorflow_tpu.distributed.controlplane import (
+    ParamTailer,
+    PreemptionFollower,
+    PreemptionLeader,
+    Redirector,
+)
+from actor_critic_algs_on_tensorflow_tpu.distributed.resilience import (
+    ResilientActorClient,
+    RetryPolicy,
+)
+from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+    ROLE_ACTOR,
+    ROLE_STANDBY,
+    ActorClient,
+    LearnerServer,
+)
+from tests.helpers import time_limit
+
+
+def _quiet_server(sink=None, **kw):
+    return LearnerServer(
+        sink if sink is not None else (lambda t, e: None),
+        log=lambda m: None,
+        **kw,
+    )
+
+
+def _mk_policy():
+    return RetryPolicy(base_delay_s=0.01, max_delay_s=0.05, deadline_s=15.0)
+
+
+def _leaves(rng, scale=1.0):
+    """A params-tree-shaped leaf list: f32 matrices (the delta's
+    target case), an int32 vector, a bool mask, and a 0-d scalar."""
+    return [
+        (rng.standard_normal((64, 32)) * scale).astype(np.float32),
+        (rng.standard_normal(33) * scale).astype(np.float32),
+        np.arange(7, dtype=np.int32),
+        np.array([True, False, True]),
+        np.asarray(3.5, np.float32),
+    ]
+
+
+def _perturb(leaves, rng, eps=1e-3):
+    """One optimizer-step-sized nudge: float leaves move a little,
+    non-float leaves stay (the steady state between publishes)."""
+    out = []
+    for a in leaves:
+        if a.dtype == np.float32:
+            out.append(
+                (a + eps * rng.standard_normal(a.shape).astype(np.float32))
+                .astype(np.float32)
+            )
+        else:
+            out.append(a.copy())
+    return out
+
+
+def _assert_leaves_equal(got, want):
+    assert len(got) == len(want)
+    for a, b in zip(got, want):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------
+# Codec units: lossless by test, not just by construction.
+# ---------------------------------------------------------------------
+
+def test_delta_roundtrip_bit_exact():
+    rng = np.random.default_rng(0)
+    base = _leaves(rng)
+    new = _perturb(base, rng)
+    base_wire, flags = codec.wire_cast(base, bf16=False)
+    new_wire, _ = codec.wire_cast(new, bf16=False)
+    frame = codec.encode_delta(base_wire, new_wire, flags, base_version=7)
+    base_version, wire, out_flags = codec.decode(frame, base_wire)
+    assert base_version == 7
+    _assert_leaves_equal(codec.unwire(wire, out_flags), new)
+    # The big f32 leaves actually rode as deltas (not the plain
+    # fallback), or this test measures nothing.
+    assert out_flags[0] & codec.FLAG_DELTA
+    assert frame[1].nbytes < new[0].nbytes
+
+
+def test_delta_roundtrip_fuzz_many_steps():
+    """A chain of delta frames (each against the previous version)
+    stays bit-exact over a long stream — held state is the decode
+    output, exactly as the client maintains it."""
+    rng = np.random.default_rng(1)
+    cur = _leaves(rng)
+    held_wire, flags = codec.wire_cast(cur, bf16=False)
+    for step in range(20):
+        nxt = _perturb(cur, rng, eps=10.0 ** -rng.integers(1, 6))
+        new_wire, _ = codec.wire_cast(nxt, bf16=False)
+        frame = codec.encode_delta(held_wire, new_wire, flags, step)
+        _, held_wire, out_flags = codec.decode(frame, held_wire)
+        _assert_leaves_equal(codec.unwire(held_wire, out_flags), nxt)
+        cur = nxt
+
+
+def test_incompressible_leaf_rides_plain_inside_delta_frame():
+    """A leaf whose compressed XOR comes out larger than the plain
+    leaf (pure noise vs pure noise) is sent plain — same frame, no
+    FLAG_DELTA — and still decodes bit-exact."""
+    rng = np.random.default_rng(2)
+    base = [rng.bytes(4096)]
+    base = [np.frombuffer(base[0], np.uint8)]
+    new = [np.frombuffer(rng.bytes(4096), np.uint8)]
+    frame = codec.encode_delta(base, new, [0], base_version=1)
+    _, flags = codec.parse_meta(frame[0])
+    assert not flags[0] & codec.FLAG_DELTA
+    _, wire, _ = codec.decode(frame, base)
+    _assert_leaves_equal(wire, new)
+
+
+def test_decode_without_held_base_raises():
+    rng = np.random.default_rng(3)
+    base = _leaves(rng)
+    new = _perturb(base, rng)
+    base_wire, flags = codec.wire_cast(base, bf16=False)
+    new_wire, _ = codec.wire_cast(new, bf16=False)
+    frame = codec.encode_delta(base_wire, new_wire, flags, base_version=4)
+    with pytest.raises(codec.CodecError):
+        codec.decode(frame, None)
+
+
+def test_bf16_pack_unpack_semantics():
+    vals = np.array(
+        [0.0, -0.0, 1.0, -1.0, np.inf, -np.inf, 3.14159, 1e-30, 65504.0],
+        np.float32,
+    )
+    h = codec.bf16_pack(vals)
+    assert h.dtype == np.uint16
+    back = codec.bf16_unpack(h)
+    # Round-to-nearest-even to 8 mantissa bits; specials exact.
+    np.testing.assert_array_equal(back[:6], vals[:6])
+    assert abs(back[6] - vals[6]) <= abs(vals[6]) * 2.0 ** -8
+    nan = codec.bf16_unpack(codec.bf16_pack(np.array([np.nan], np.float32)))
+    assert np.isnan(nan[0])
+
+
+def test_full_coded_frame_decodes_standalone():
+    """A full coded frame (the bf16 bootstrap) needs no held base."""
+    rng = np.random.default_rng(4)
+    leaves = _leaves(rng)
+    wire, flags = codec.wire_cast(leaves, bf16=True)
+    assert flags[0] & codec.FLAG_BF16 and wire[0].dtype == np.uint16
+    assert not flags[2] & codec.FLAG_BF16  # int leaf untouched
+    frame = codec.encode_full(wire, flags)
+    base_version, out_wire, out_flags = codec.decode(frame, None)
+    assert base_version == 0
+    got = codec.unwire(out_wire, out_flags)
+    _assert_leaves_equal(got[2:], leaves[2:])
+    np.testing.assert_array_equal(
+        got[0], codec.bf16_unpack(codec.bf16_pack(leaves[0]))
+    )
+
+
+# ---------------------------------------------------------------------
+# Server/client wire: delta serving, ring misses, metrics.
+# ---------------------------------------------------------------------
+
+def test_wire_delta_after_first_fetch_bit_exact():
+    rng = np.random.default_rng(5)
+    server = _quiet_server(param_delta=True)
+    try:
+        v1 = _leaves(rng)
+        server.publish(v1, notify=False)
+        client = ActorClient("127.0.0.1", server.port)
+        version, got = client.fetch_params()
+        assert version == 1
+        _assert_leaves_equal(got, v1)
+        assert server.metrics()["transport_param_delta_sends"] == 0
+
+        v2 = _perturb(v1, rng)
+        server.publish(v2, notify=False)
+        version, got = client.fetch_params()
+        assert version == 2
+        _assert_leaves_equal(got, v2)  # BIT-exact through the delta
+        m = server.metrics()
+        assert m["transport_param_delta_sends"] == 1
+        assert m["transport_param_sends"] == 2
+        client.close()
+    finally:
+        server.close()
+
+
+def test_ring_miss_falls_back_to_full_frame():
+    """More publishes than the ring holds between two fetches: the
+    held base is evicted, the server sends a full frame, the client
+    still lands bit-exact on the newest version."""
+    rng = np.random.default_rng(6)
+    server = _quiet_server(param_delta=True, param_delta_ring=2)
+    try:
+        cur = _leaves(rng)
+        server.publish(cur, notify=False)
+        client = ActorClient("127.0.0.1", server.port)
+        client.fetch_params()  # holds v1
+        for _ in range(4):  # v2..v5; ring keeps only {4, 5}
+            cur = _perturb(cur, rng)
+            server.publish(cur, notify=False)
+        version, got = client.fetch_params()
+        assert version == 5
+        _assert_leaves_equal(got, cur)
+        m = server.metrics()
+        assert m["transport_param_delta_sends"] == 0
+        assert m["transport_param_sends"] == 2
+        # ...and the NEXT fetch after a publish is a delta again (the
+        # full frame re-seeded the client's held base).
+        cur = _perturb(cur, rng)
+        server.publish(cur, notify=False)
+        version, got = client.fetch_params()
+        assert version == 6
+        _assert_leaves_equal(got, cur)
+        assert server.metrics()["transport_param_delta_sends"] == 1
+        client.close()
+    finally:
+        server.close()
+
+
+def test_reconnect_mid_delta_stream_falls_back_to_full_frame():
+    """Churn: the held-version state lives and dies with the
+    connection. After a forced reconnect the client reports holding
+    nothing, gets a full frame, and the stream stays bit-exact."""
+    with time_limit(30, "reconnect mid-delta"):
+        rng = np.random.default_rng(7)
+        server = _quiet_server(param_delta=True)
+        proxy = Redirector("127.0.0.1", server.port)
+        try:
+            cur = _leaves(rng)
+            server.publish(cur, notify=False)
+            client = ResilientActorClient(
+                "127.0.0.1", proxy.port,
+                retry=_mk_policy(), idle_timeout_s=5.0,
+            )
+            client.fetch_params()
+            cur = _perturb(cur, rng)
+            server.publish(cur, notify=False)
+            version, got = client.fetch_params()  # delta
+            _assert_leaves_equal(got, cur)
+            assert server.metrics()["transport_param_delta_sends"] == 1
+
+            # Kill the live link mid-stream; same server, new conn.
+            proxy.redirect("127.0.0.1", server.port)
+            cur = _perturb(cur, rng)
+            server.publish(cur, notify=False)
+            version, got = client.fetch_params()
+            assert version == 3
+            _assert_leaves_equal(got, cur)
+            assert client.reconnects >= 1
+            # The post-reconnect fetch was NOT served as a delta: the
+            # fresh connection held nothing.
+            assert server.metrics()["transport_param_delta_sends"] == 1
+            client.close()
+        finally:
+            proxy.close()
+            server.close()
+
+
+def test_redirect_during_inflight_fetches_never_torn_or_stale():
+    """Churn: a Redirector flip mid-fetch-stream must never deliver a
+    payload mixing two servers' versions (a torn decode) or a version
+    tag that mismatches its leaves. Every leaf value encodes
+    (server_marker + version), so any tear or staleness breaks the
+    whole-payload consistency check."""
+    with time_limit(60, "redirect in-flight"):
+        def snapshot(marker, version):
+            return [
+                np.full((256, 16), marker + version, np.float32),
+                np.full(17, marker + version, np.float32),
+                np.asarray(marker + version, np.float64),
+            ]
+
+        published = {}
+
+        def make(marker):
+            s = _quiet_server(param_delta=True)
+            for v in range(1, 4):
+                s.publish(snapshot(marker, v), notify=False)
+                published[(marker, v)] = snapshot(marker, v)
+            return s
+
+        s1, s2 = make(1000.0), make(2000.0)
+        proxy = Redirector("127.0.0.1", s1.port)
+        try:
+            client = ResilientActorClient(
+                "127.0.0.1", proxy.port,
+                retry=_mk_policy(), idle_timeout_s=5.0,
+            )
+            stop = threading.Event()
+            bad = []
+            fetches = [0]
+
+            def spin():
+                while not stop.is_set():
+                    try:
+                        version, leaves = client.fetch_params()
+                    except Exception as e:  # noqa: BLE001
+                        bad.append(f"fetch raised {e!r}")
+                        return
+                    fetches[0] += 1
+                    vals = {float(np.asarray(l).reshape(-1)[0])
+                            for l in leaves}
+                    if len(vals) != 1:
+                        bad.append(f"torn payload v{version}: {vals}")
+                        return
+                    marker = vals.pop() - version
+                    want = published.get((marker, version))
+                    if want is None:
+                        bad.append(
+                            f"stale/unknown payload v{version} "
+                            f"marker {marker}"
+                        )
+                        return
+                    for a, b in zip(leaves, want):
+                        if a.dtype != b.dtype or not np.array_equal(a, b):
+                            bad.append(f"corrupt leaves at v{version}")
+                            return
+
+            t = threading.Thread(target=spin, daemon=True)
+            t.start()
+            ports = [s2.port, s1.port]
+            for i in range(10):
+                time.sleep(0.05)
+                proxy.redirect("127.0.0.1", ports[i % 2])
+            stop.set()
+            # The final fetch may ride out a full reconnect-with-
+            # backoff cycle (retry deadline 15 s) before it observes
+            # the stop flag.
+            t.join(timeout=25.0)
+            assert not t.is_alive()
+            assert not bad, bad
+            assert fetches[0] >= 10
+            client.close()
+        finally:
+            proxy.close()
+            s1.close()
+            s2.close()
+
+
+def test_bf16_wire_is_opt_in_and_role_scoped():
+    """Default: bit-exact f32 to everyone. With param_bf16 on, ACTOR
+    fetches get bf16-rounded floats (ints untouched); STANDBY fetches
+    still get full precision — their copy seeds a takeover learner."""
+    rng = np.random.default_rng(8)
+    leaves = _leaves(rng)
+
+    # Default OFF: equality preserved (the acceptance pin).
+    server = _quiet_server()
+    try:
+        server.publish(leaves, notify=False)
+        client = ActorClient(
+            "127.0.0.1", server.port, hello=(0, 0, ROLE_ACTOR)
+        )
+        _, got = client.fetch_params()
+        _assert_leaves_equal(got, leaves)
+        client.close()
+    finally:
+        server.close()
+
+    server = _quiet_server(param_delta=True, param_bf16=True)
+    try:
+        server.publish(leaves, notify=False)
+        actor = ActorClient(
+            "127.0.0.1", server.port, hello=(0, 0, ROLE_ACTOR)
+        )
+        _, got = actor.fetch_params()
+        np.testing.assert_array_equal(
+            got[0], codec.bf16_unpack(codec.bf16_pack(leaves[0]))
+        )
+        _assert_leaves_equal(got[2:], leaves[2:])  # non-f32 exact
+        # The bf16 stream deltas too, and stays bf16-consistent.
+        new = _perturb(leaves, rng)
+        server.publish(new, notify=False)
+        _, got = actor.fetch_params()
+        np.testing.assert_array_equal(
+            got[0], codec.bf16_unpack(codec.bf16_pack(new[0]))
+        )
+        actor.close()
+
+        standby = ActorClient(
+            "127.0.0.1", server.port, hello=(9, 0, ROLE_STANDBY)
+        )
+        _, got = standby.fetch_params()
+        _assert_leaves_equal(got, new)  # full precision
+        standby.close()
+    finally:
+        server.close()
+
+
+def test_outbound_metrics_account_param_sends():
+    """transport_mb_out / transport_param_sends make the codec win
+    observable in the same log stream it optimizes."""
+    rng = np.random.default_rng(9)
+    server = _quiet_server(param_delta=True)
+    try:
+        leaves = _leaves(rng)
+        server.publish(leaves, notify=False)
+        client = ActorClient("127.0.0.1", server.port)
+        m0 = server.metrics()
+        assert m0["transport_mb_out"] == 0.0
+        assert m0["transport_param_sends"] == 0
+        client.fetch_params()
+        client.push_trajectory([np.ones((2, 2), np.float32)])
+        m = server.metrics()
+        assert m["transport_param_sends"] == 1
+        # The full first fetch carries at least the payload bytes.
+        payload_mb = sum(x.nbytes for x in leaves) / 1e6
+        assert m["transport_param_mb_out"] >= payload_mb
+        # mb_out also counts the tiny ACK the push got.
+        assert m["transport_mb_out"] > m["transport_param_mb_out"]
+        client.close()
+    finally:
+        server.close()
+
+
+# ---------------------------------------------------------------------
+# Push-based publish discovery (KIND_PARAMS_NOTIFY).
+# ---------------------------------------------------------------------
+
+def test_publish_notify_wakes_waiting_client():
+    with time_limit(30, "notify wake"):
+        rng = np.random.default_rng(10)
+        server = _quiet_server(param_delta=True)
+        try:
+            v1 = _leaves(rng)
+            server.publish(v1, notify=False)
+            client = ActorClient("127.0.0.1", server.port)
+            client.fetch_params()
+            got = {}
+
+            def waiter():
+                got["version"] = client.wait_params_notify(10.0)
+
+            t = threading.Thread(target=waiter, daemon=True)
+            t.start()
+            time.sleep(0.2)
+            v2 = _perturb(v1, rng)
+            server.publish(v2)  # notify=True default
+            t.join(timeout=10.0)
+            assert got.get("version") == 2
+            assert server.metrics()["transport_notifies_sent"] == 1
+            version, leaves = client.fetch_params()
+            assert version == 2
+            _assert_leaves_equal(leaves, v2)
+            client.close()
+        finally:
+            server.close()
+
+
+def test_poll_notified_drains_already_arrived_notifies():
+    rng = np.random.default_rng(11)
+    server = _quiet_server(param_delta=True)
+    try:
+        cur = _leaves(rng)
+        server.publish(cur, notify=False)
+        client = ActorClient("127.0.0.1", server.port)
+        client.fetch_params()
+        # Nothing pending: the fetch itself satisfies version 1, so
+        # the poll reports a version the caller already holds (the
+        # caller's `notified != held` check is what decides a fetch).
+        assert client.poll_notified() == 1
+        for _ in range(3):
+            cur = _perturb(cur, rng)
+            server.publish(cur)
+        deadline = time.monotonic() + 5.0
+        # Newest-wins: three pending notifies collapse to version 4.
+        while client.poll_notified() < 4:
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        version, leaves = client.fetch_params()
+        assert version == 4
+        _assert_leaves_equal(leaves, cur)
+        client.close()
+    finally:
+        server.close()
+
+
+# ---------------------------------------------------------------------
+# Cross-host step-lag metric (STEP_REPORT during HEALTHY training).
+# ---------------------------------------------------------------------
+
+def test_leader_surfaces_coord_step_lag_from_periodic_reports():
+    with time_limit(30, "step lag"):
+        leader = PreemptionLeader(
+            n_followers=2, host="127.0.0.1", log=lambda m: None
+        )
+        try:
+            f1 = PreemptionFollower("127.0.0.1", leader.port)
+            f2 = PreemptionFollower("127.0.0.1", leader.port)
+            leader.report_step(80)
+            f1.report_step(100)
+            f2.report_step(94)
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                m = leader.lag_metrics()
+                if m.get("coord_hosts_reporting") == 3:
+                    break
+                time.sleep(0.02)
+            assert m["coord_hosts_reporting"] == 3
+            assert m["coord_step_lag"] == 20  # max 100 - min 80
+            # Telemetry is monotonic per host, newest wins.
+            f1.report_step(101)
+            deadline = time.monotonic() + 5.0
+            while leader.lag_metrics().get("coord_step_lag") != 21:
+                assert time.monotonic() < deadline
+                time.sleep(0.02)
+
+            # The SAME connections still carry the preemption
+            # consensus afterwards: periodic frames never poison it.
+            agreed = {}
+
+            def decide(f, step):
+                agreed[step] = f.decide(step, timeout_s=10.0)
+
+            t1 = threading.Thread(
+                target=decide, args=(f1, 7), daemon=True
+            )
+            t2 = threading.Thread(
+                target=decide, args=(f2, 11), daemon=True
+            )
+            t1.start()
+            t2.start()
+            assert leader.decide(5, timeout_s=10.0) == 11
+            t1.join(timeout=10.0)
+            t2.join(timeout=10.0)
+            assert agreed == {7: 11, 11: 11}
+            f1.close()
+            f2.close()
+        finally:
+            leader.close()
+
+
+# ---------------------------------------------------------------------
+# Hot standby: param tail + early serving + sink adoption.
+# ---------------------------------------------------------------------
+
+def test_param_tailer_follows_publish_stream():
+    with time_limit(30, "param tailer"):
+        rng = np.random.default_rng(12)
+        server = _quiet_server(param_delta=True)
+        tailer = None
+        try:
+            cur = _leaves(rng)
+            server.publish(cur, notify=False)
+            tailer = ParamTailer(
+                "127.0.0.1", server.port,
+                poll_interval_s=0.2, log=lambda m: None,
+            )
+            deadline = time.monotonic() + 10.0
+            while tailer.newest()[0] < 1:
+                assert time.monotonic() < deadline
+                time.sleep(0.02)
+            for _ in range(3):
+                cur = _perturb(cur, rng)
+                server.publish(cur)
+            deadline = time.monotonic() + 10.0
+            while tailer.newest()[0] < 4:
+                assert time.monotonic() < deadline
+                time.sleep(0.02)
+            version, leaves = tailer.newest()
+            assert version == 4
+            _assert_leaves_equal(leaves, cur)
+            # Steady-state tailing rides the delta codec.
+            assert server.metrics()["transport_param_delta_sends"] >= 1
+        finally:
+            if tailer is not None:
+                tailer.close()
+            server.close()
+
+
+def test_param_tailer_republishes_into_standby_server():
+    """The hot-standby wiring: the tail's on_params re-publishes into
+    the standby's own (pre-takeover) listener, so actors already
+    parked there fetch live weights before any takeover."""
+    with time_limit(30, "tailer republish"):
+        rng = np.random.default_rng(13)
+        primary = _quiet_server(param_delta=True)
+        standby = _quiet_server(param_delta=True)
+        tailer = None
+        try:
+            tailer = ParamTailer(
+                "127.0.0.1", primary.port,
+                poll_interval_s=0.2,
+                on_params=lambda v, leaves: standby.publish(leaves),
+                log=lambda m: None,
+            )
+            cur = _leaves(rng)
+            primary.publish(cur)
+            parked = ActorClient("127.0.0.1", standby.port)
+            deadline = time.monotonic() + 10.0
+            while standby.version < 1:
+                assert time.monotonic() < deadline
+                time.sleep(0.02)
+            _, leaves = parked.fetch_params()
+            _assert_leaves_equal(leaves, cur)
+            parked.close()
+        finally:
+            if tailer is not None:
+                tailer.close()
+            standby.close()
+            primary.close()
+
+
+def test_takeover_freshness_orders_by_content_time(tmp_path):
+    """The takeover graft (run_impala_standby) grafts tailed params
+    over the restored checkpoint only when the publish stream is the
+    fresher source, comparing ``ParamTailer.newest_seen_t`` against
+    ``CheckpointTailer.newest_seen_t``. The checkpoint side must carry
+    CONTENT time (the writer's step-dir mtime), not restore-completion
+    time: a checkpoint written long before the last publish but
+    restored just now (poll + restore lag) would otherwise masquerade
+    as fresher and suppress the graft — and the reverse error (a tail
+    frozen by an outage outranking a genuinely newer dying save)
+    would silently regress the weights."""
+    import jax
+    import jax.numpy as jnp
+
+    from actor_critic_algs_on_tensorflow_tpu.distributed.controlplane import (
+        CheckpointTailer,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
+        Checkpointer,
+    )
+
+    with time_limit(60, "content time"):
+        state = {"w": jnp.arange(4.0), "step": jnp.asarray(1)}
+        writer = Checkpointer(tmp_path / "ck", async_save=False)
+        writer.save(1, state)
+        writer.wait()
+        # Backdate the step dir: the "primary" wrote this 100 s ago.
+        past = time.time() - 100.0
+        os.utime(tmp_path / "ck" / "1", (past, past))
+        assert writer.step_written_at(1) == pytest.approx(past, abs=2.0)
+        assert writer.step_written_at(999) is None
+
+        reader = Checkpointer(tmp_path / "ck", async_save=False)
+        template = jax.tree_util.tree_map(np.asarray, state)
+        ck_tailer = CheckpointTailer(
+            reader, template, poll_interval_s=0.05, log=lambda m: None
+        )
+        server = _quiet_server(param_delta=True)
+        ptailer = None
+        try:
+            deadline = time.monotonic() + 10.0
+            while ck_tailer.newest()[0] != 1:
+                assert time.monotonic() < deadline
+                time.sleep(0.02)
+            # Restored NOW, stamped with the writer's 100 s-old mtime.
+            assert ck_tailer.newest_seen_t == pytest.approx(past, abs=2.0)
+
+            ptailer = ParamTailer(
+                "127.0.0.1", server.port,
+                poll_interval_s=0.1, log=lambda m: None,
+            )
+            assert ptailer.newest_seen_t == float("-inf")  # nothing yet
+            server.publish(_leaves(np.random.default_rng(0)))
+            deadline = time.monotonic() + 10.0
+            while ptailer.newest()[0] < 1:
+                assert time.monotonic() < deadline
+                time.sleep(0.02)
+            # The publish fetched just now outranks the old checkpoint:
+            # the graft comparison takeover runs must prefer the tail.
+            assert ptailer.newest_seen_t > ck_tailer.newest_seen_t
+            assert ptailer.newest_seen_t == pytest.approx(
+                time.time(), abs=5.0
+            )
+        finally:
+            if ptailer is not None:
+                ptailer.close()
+            server.close()
+            ck_tailer.close(final_poll=False)
+            writer.close()
+            reader.close()
+
+
+def test_redirector_fallback_lands_actors_on_standby():
+    """When the primary's listener is GONE, the redirector routes new
+    upstream connections to the fallback (the standby's early
+    listener) on the FIRST retry — the reconnect backoff is paid
+    before any takeover."""
+    with time_limit(30, "fallback route"):
+        primary = _quiet_server()
+        primary.publish([np.zeros(4, np.float32)], notify=False)
+        absorbed = []
+        standby = _quiet_server(
+            sink=lambda t, e: absorbed.append(1) or True,
+            param_delta=True,
+        )
+        standby.publish([np.ones(4, np.float32)], notify=False)
+        proxy = Redirector("127.0.0.1", primary.port)
+        try:
+            proxy.set_fallback("127.0.0.1", standby.port)
+            client = ResilientActorClient(
+                "127.0.0.1", proxy.port,
+                retry=_mk_policy(), idle_timeout_s=5.0,
+            )
+            _, leaves = client.fetch_params()
+            np.testing.assert_array_equal(leaves[0], np.zeros(4, np.float32))
+
+            # The primary DIES (no goodbye frame): listener gone, live
+            # links reset — the crash the fallback route exists for.
+            primary.close(graceful=False)
+            # The next operations land on the standby via the fallback
+            # route: pushes are absorbed (ACKed + discarded), fetches
+            # serve the standby's (tailed) params.
+            client.push_trajectory([np.array([5], np.int64)])
+            _, leaves = client.fetch_params()
+            np.testing.assert_array_equal(leaves[0], np.ones(4, np.float32))
+            assert absorbed
+            assert proxy.fallback_connections >= 1
+            client.close()
+        finally:
+            proxy.close()
+            standby.close()
+
+
+def test_trajectory_sink_swap_adopts_live_stream():
+    """run_impala_distributed(server=...) adoption semantics: the
+    standby's discard sink is swapped for the real queue on a LIVE
+    server without dropping the connection."""
+    with time_limit(30, "sink swap"):
+        absorbed, consumed = [], []
+        server = _quiet_server(
+            sink=lambda t, e: absorbed.append(int(t[0][0])) or True
+        )
+        try:
+            server.publish([np.zeros(1, np.float32)], notify=False)
+            client = ActorClient("127.0.0.1", server.port)
+            client.push_trajectory([np.array([1], np.int64)])
+            assert absorbed == [1]
+            server.set_trajectory_sink(
+                lambda t, e: consumed.append(int(t[0][0])) or True
+            )
+            client.push_trajectory([np.array([2], np.int64)])
+            assert consumed == [2] and absorbed == [1]
+            client.close()
+        finally:
+            server.close()
+
+
+# ---------------------------------------------------------------------
+# Bench wiring (BENCH_PARAMS=1): tier-1 smoke + slow full leg.
+# ---------------------------------------------------------------------
+
+def _bench_module():
+    import importlib
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, root)
+    return importlib.import_module("bench")
+
+
+def test_measure_params_smoke(monkeypatch):
+    """Fast tier-1 smoke of the bench leg: tiny stream, real wire."""
+    monkeypatch.setenv("BENCH_PARAMS_VERSIONS", "4")
+    monkeypatch.setenv("BENCH_PARAMS_NOTIFIES", "2")
+    out = _bench_module().measure_params()
+    assert out["versions"] == 4
+    assert out["full_kib_per_fetch"] > 0
+    assert out["delta_kib_per_fetch"] > 0
+    assert out["wire_reduction"] == pytest.approx(
+        out["full_kib_per_fetch"] / out["delta_kib_per_fetch"], rel=0.05
+    )
+    assert "notify_visible_ms_p50" in out
+
+
+@pytest.mark.slow
+def test_bench_params_full_leg_subprocess():
+    """The BENCH_PARAMS=1 contract end-to-end: child-mode bench.py
+    prints one JSON line whose delta wire bytes beat full frames by
+    the acceptance margin (>= 2x) on a converging CartPole stream."""
+    import json
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_PARAMS_VERSIONS="30")
+    child = subprocess.run(
+        [sys.executable, os.path.join(root, "bench.py"), "--measure-params"],
+        capture_output=True, text=True, cwd=root, timeout=560, env=env,
+    )
+    assert child.returncode == 0, child.stderr[-2000:]
+    out = json.loads(child.stdout.strip().splitlines()[-1])
+    assert out["wire_reduction"] >= 2.0, out
