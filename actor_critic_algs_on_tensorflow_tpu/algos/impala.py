@@ -177,6 +177,21 @@ class ImpalaConfig:
     # param tailers always receive full precision — their copy seeds a
     # takeover learner. Default OFF: full-precision wire.
     param_bf16_wire: bool = False
+    # --- trajectory data plane (distributed.codec) --------------------
+    # Columnar per-leaf compression of actor->learner trajectory
+    # frames (KIND_TRAJ_CODED): byte-plane shuffle + zlib-1 with
+    # per-leaf smaller-of-coded-or-plain selection, so the codec is a
+    # no-op exactly where it does not pay (e.g. float CartPole obs
+    # ride plain inside the same frame). Learner-side the frame is
+    # decoded DIRECTLY into host-arena slot views — the compressed
+    # bytes are the only thing queued, and no assembled-trajectory
+    # staging copy exists between the wire and the arena.
+    traj_codec: bool = True
+    # Temporal delta along the rollout axis for uint8 (image)
+    # observations before the shuffle: adjacent frames differ in few
+    # pixels, so the mod-256 difference is near-zero almost everywhere
+    # and DEFLATE collapses it. Lossless (exact wraparound inverse).
+    traj_obs_delta: bool = True
     # --- hot standby (run_impala_standby) ----------------------------
     # Bind the takeover listener at standby START: actors that lose
     # the primary land here immediately (via the redirector's fallback
@@ -898,8 +913,10 @@ def _learner_loop(
     checkpoint_interval: int = 200,
     exec_lock: threading.Lock | None = None,
     ingest_plan=None,
+    part_specs=None,
     sentinel=None,
     validate=None,
+    validate_coded=None,
     stop_event: threading.Event | None = None,
     coordinator=None,
     catchup_deadline_s: float = 15.0,
@@ -943,6 +960,10 @@ def _learner_loop(
         LearnerPipeline,
         TimeSplit,
     )
+    from actor_critic_algs_on_tensorflow_tpu.distributed.codec import (
+        CodecError,
+        CodedTrajectory,
+    )
     from actor_critic_algs_on_tensorflow_tpu.utils.metrics import (
         device_get_metrics,
         format_metrics,
@@ -964,6 +985,44 @@ def _learner_loop(
 
     split = TimeSplit()
     it_box = [iters_done0]  # prefetch-thread health checks read this
+    treedef, axes_leaves, shardings_leaves = (
+        ingest_plan if ingest_plan is not None else (None, None, None)
+    )
+    max_decode_bytes = cfg.transport_max_frame_mb << 20
+
+    def decode_serial(traj, ep):
+        """Serial-path decode of a coded wire trajectory (no arena —
+        fresh leaves) + post-decode admission; None = dropped. Same
+        fault envelope as the pipeline's ``_decode_into``: a malformed
+        frame — including one whose leaf structure does not match this
+        learner's config — is dropped, never fatal, and the leaf-count
+        check runs BEFORE any inflate (decode_traj's aggregate size
+        cap bounds the rest)."""
+        try:
+            if (
+                treedef is not None
+                and len(traj.infos(max_leaf_bytes=max_decode_bytes))
+                != treedef.num_leaves
+            ):
+                raise CodecError(
+                    "coded trajectory leaf count does not match this "
+                    "learner's config"
+                )
+            leaves = traj.decode(max_leaf_bytes=max_decode_bytes)
+            tree = jax.tree_util.tree_unflatten(treedef, leaves)
+        except (CodecError, ValueError) as e:
+            print(
+                f"[impala] dropping undecodable coded trajectory "
+                f"from actor {traj.actor_id}: {e}",
+                flush=True,
+            )
+            return None
+        if validate_coded is not None and not validate_coded(
+            tree, ep, traj.actor_id
+        ):
+            return None
+        return tree
+
     pipe = None
     if cfg.pipeline:
 
@@ -974,9 +1033,6 @@ def _learner_loop(
             except queue_lib.Empty:
                 return ()
 
-        treedef, axes_leaves, shardings_leaves = (
-            ingest_plan if ingest_plan is not None else (None, None, None)
-        )
         pipe = LearnerPipeline(
             poll=poll,
             batch_parts=cfg.batch_trajectories,
@@ -987,6 +1043,9 @@ def _learner_loop(
             n_slots=max(2, cfg.pipeline_slots),
             exec_lock=exec_lock,
             validate=validate,
+            validate_coded=validate_coded,
+            max_decode_bytes=max_decode_bytes,
+            part_specs=part_specs,
         )
 
     def dispatch_step(state, make_batch):
@@ -1041,7 +1100,11 @@ def _learner_loop(
                 traj, ep = q.get(timeout=q_timeout)
             except queue_lib.Empty:  # re-check actor health
                 continue
-            if validate is not None and not validate(traj, ep):
+            if isinstance(traj, CodedTrajectory):
+                traj = decode_serial(traj, ep)
+                if traj is None:
+                    continue  # undecodable or validator-rejected
+            elif validate is not None and not validate(traj, ep):
                 continue  # dropped-and-recorded by the validator
             trajs.append(traj)
             eps.append(ep)
@@ -1449,11 +1512,15 @@ def _actor_process_main(
     Exits cleanly when the learner closes the connection.
     """
     jax.config.update("jax_platforms", "cpu")
+    from actor_critic_algs_on_tensorflow_tpu.distributed import (
+        codec as codec_lib,
+    )
     from actor_critic_algs_on_tensorflow_tpu.distributed.resilience import (
         ResilientActorClient,
         RetryPolicy,
     )
     from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+        CAP_TRAJ_CODED,
         ROLE_ACTOR,
         LearnerShutdown,
     )
@@ -1472,13 +1539,26 @@ def _actor_process_main(
     # identity is re-announced on every reconnect, so the learner's
     # connection registry keeps provenance through link churn AND
     # through a failover to a different learner.
+    # Trajectory wire codec (columnar per-leaf; see distributed.codec):
+    # encode once per rollout, announce the capability in the hello so
+    # the learner's registry shows who ships coded frames. Legacy
+    # actors simply never send KIND_TRAJ_CODED — the server accepts
+    # both kinds from one fleet.
+    encoder = (
+        codec_lib.TrajEncoder(obs_delta=cfg.traj_obs_delta)
+        if cfg.traj_codec else None
+    )
+    tdelta_ok = None
     client = ResilientActorClient(
         host, port,
         retry=RetryPolicy(deadline_s=cfg.transport_retry_deadline_s),
         heartbeat_interval_s=cfg.transport_heartbeat_s,
         idle_timeout_s=cfg.transport_idle_timeout_s,
         max_frame_bytes=cfg.transport_max_frame_mb << 20,
-        hello=(actor_id, generation, ROLE_ACTOR),
+        hello=(
+            actor_id, generation, ROLE_ACTOR,
+            CAP_TRAJ_CODED if cfg.traj_codec else 0,
+        ),
     )
     try:
         version, leaves = client.fetch_params()
@@ -1515,9 +1595,21 @@ def _actor_process_main(
             notified = client.poll_notified()
             if notified > 0 and notified != version:
                 refetch()
+            if encoder is not None and tdelta_ok is None:
+                # Time-major leaves (concat axis 1) carry the rollout
+                # on axis 0 — those are the temporal-delta candidates
+                # (uint8-ness is checked per leaf by the encoder).
+                tdelta_ok = [
+                    ax == 1
+                    for ax in jax.tree_util.tree_leaves(
+                        trajectory_batch_axes(traj)
+                    )
+                ]
             server_version = client.push_trajectory(
                 [np.asarray(x) for x in jax.tree_util.tree_leaves(traj)],
                 [np.asarray(x) for x in jax.tree_util.tree_leaves(ep)],
+                encoder=encoder,
+                tdelta_ok=tdelta_ok,
             )
             # ANY version change triggers a re-fetch — not just a
             # larger one: a failover lands the actor on a standby
@@ -1530,9 +1622,12 @@ def _actor_process_main(
     except LearnerShutdown:
         # Orderly KIND_CLOSE broadcast: the learner is done. Exit
         # quietly — this is the expected end of every run, not a fault.
+        stats = dict(client.stats())
+        if encoder is not None:
+            stats.update(encoder.stats())
         print(
             f"[impala-actor {actor_id}] learner closed the stream; "
-            f"exiting ({client.stats()})",
+            f"exiting ({stats})",
             flush=True,
         )
     except (ConnectionError, OSError) as e:
@@ -1628,6 +1723,9 @@ def run_impala_distributed(
     from actor_critic_algs_on_tensorflow_tpu.data.pipeline import (
         AsyncParamPublisher,
     )
+    from actor_critic_algs_on_tensorflow_tpu.distributed import (
+        codec as codec_lib,
+    )
     from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
         LearnerServer,
     )
@@ -1649,7 +1747,15 @@ def run_impala_distributed(
     # them in pre-derived so takeover skips the eval_shape traces.
     if wire_plan is None:
         wire_plan = _derive_wire_plan(programs, state.params)
-    traj_def, ep_def, ingest_plan, _ = wire_plan
+    traj_def, ep_def, ingest_plan, traj_shape = wire_plan
+    # Trusted arena layout from the LOCAL eval_shape trace: the wire
+    # must conform to this config, never define it — a stale-config
+    # actor's frame is rejected against it instead of establishing a
+    # poisoned layout when it happens to arrive first.
+    part_specs = [
+        (tuple(x.shape), np.dtype(x.dtype))
+        for x in jax.tree_util.tree_leaves(traj_shape)
+    ]
 
     q = TrajectoryQueue(cfg.queue_size)
     closing = threading.Event()
@@ -1665,18 +1771,49 @@ def run_impala_distributed(
         validator = _make_validator(cfg, programs)
 
     def on_trajectory(traj_leaves, ep_leaves, peer):
-        item = (
-            jax.tree_util.tree_unflatten(traj_def, traj_leaves),
-            jax.tree_util.tree_unflatten(ep_def, ep_leaves),
-        )
-        if validator is not None and not validator.admit(
-            # Hello-frame provenance outranks the episode-info leaf:
-            # the connection's identity cannot be scrambled by payload
-            # corruption, so quarantine lands on the right actor even
-            # when episode-info is the corrupt part.
-            *item, source_actor_id=peer.actor_id,
-        ):
-            return False
+        if isinstance(traj_leaves, codec_lib.CodedTrajectory):
+            # Coded frame: the payload stays COMPRESSED through the
+            # queue (CRC already verified the coded bytes at the
+            # wire); validation runs post-decode, at the moment the
+            # leaves materialize in the arena slot — hello provenance
+            # rides on the CodedTrajectory for quarantine attribution.
+            # A QUARANTINED actor's frames are still shed right here,
+            # like the plain path: quarantine membership needs no
+            # decoded leaves, and a poisoned actor must not keep
+            # costing queue slots and decode CPU.
+            if validator is not None and validator.drop_quarantined(
+                peer.actor_id
+            ):
+                return False
+            try:
+                item = (
+                    traj_leaves,
+                    jax.tree_util.tree_unflatten(ep_def, ep_leaves),
+                )
+            except ValueError:
+                # Episode-info structure from a different config: a
+                # REJECT (still ACKed, counted transport_rejected) —
+                # an uncaught raise here would kill the conn thread
+                # and send the resilient client into a re-push loop
+                # of the identical bytes.
+                return False
+        else:
+            try:
+                item = (
+                    jax.tree_util.tree_unflatten(traj_def, traj_leaves),
+                    jax.tree_util.tree_unflatten(ep_def, ep_leaves),
+                )
+            except ValueError:
+                return False  # structure mismatch: reject, don't die
+            if validator is not None and not validator.admit(
+                # Hello-frame provenance outranks the episode-info
+                # leaf: the connection's identity cannot be scrambled
+                # by payload corruption, so quarantine lands on the
+                # right actor even when episode-info is the corrupt
+                # part.
+                *item, source_actor_id=peer.actor_id,
+            ):
+                return False
         while not closing.is_set():
             try:
                 q.put(item, timeout=0.5)
@@ -1684,6 +1821,12 @@ def run_impala_distributed(
             except queue_lib.Full:
                 continue
         return True
+
+    # Post-decode admission for coded frames: the same validator, the
+    # same quarantine path — only the timing moves to where decoded
+    # leaves first exist (admit's third parameter is already the
+    # hello-frame source id).
+    validate_coded = validator.admit if validator is not None else None
 
     if server is not None:
         # Adopt the pre-takeover listener: actors connected while the
@@ -1838,7 +1981,9 @@ def run_impala_distributed(
             checkpoint_interval=checkpoint_interval,
             exec_lock=exec_lock,
             ingest_plan=ingest_plan,
+            part_specs=part_specs,
             sentinel=sentinel,
+            validate_coded=validate_coded,
             stop_event=stop_event,
             coordinator=coordinator,
         )

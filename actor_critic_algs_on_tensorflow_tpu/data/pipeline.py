@@ -33,6 +33,15 @@ Trajectory leaves arriving as numpy (the cross-process/DCN mode) take
 the arena path; leaves already device-resident (in-process actor
 threads) are stacked on device instead — re-staging them through the
 host would add two copies, not remove one.
+
+Coded wire trajectories (``distributed.codec.CodedTrajectory`` — the
+trajectory codec's compressed frames, PR 6) ride the queue STILL
+COMPRESSED and are decoded by the prefetch thread DIRECTLY into the
+arena part views (``HostArena.part_views``): the slot is the decode
+destination, so no assembled trajectory ever exists outside the arena
+and the queue holds ~10x fewer bytes for image observations. A part
+whose decode fails or whose post-decode validation rejects it is
+simply overwritten by the next polled item (torn-slot safety).
 """
 
 from __future__ import annotations
@@ -45,6 +54,10 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from actor_critic_algs_on_tensorflow_tpu.distributed.codec import (
+    CodecError,
+    CodedTrajectory,
+)
 from actor_critic_algs_on_tensorflow_tpu.utils.metrics import TimeSplit
 
 __all__ = [
@@ -66,7 +79,14 @@ class HostArena:
     slot. Shapes/dtypes come from the first trajectory seen.
     """
 
-    def __init__(self, axes: Sequence[int], n_parts: int, n_slots: int = 2):
+    def __init__(
+        self,
+        axes: Sequence[int],
+        n_parts: int,
+        n_slots: int = 2,
+        *,
+        part_specs: Optional[Sequence[Tuple[tuple, Any]]] = None,
+    ):
         if n_slots < 2:
             raise ValueError(f"need >= 2 slots to double-buffer, got {n_slots}")
         self.axes = list(axes)
@@ -74,24 +94,88 @@ class HostArena:
         self.n_slots = n_slots
         self._slots: List[Optional[List[np.ndarray]]] = [None] * n_slots
         self._part_shapes: Optional[List[tuple]] = None
+        self._part_dtypes: Optional[List[np.dtype]] = None
+        if part_specs is not None:
+            # Seed the layout from a TRUSTED local source (the wire
+            # plan's eval_shape trace) rather than the first frame off
+            # the wire: a stale-config actor whose frame happens to
+            # land first must be the one rejected, not the one that
+            # defines the layout every later legitimate frame is
+            # judged against.
+            if len(part_specs) != len(self.axes):
+                raise ValueError(
+                    f"{len(part_specs)} part specs for "
+                    f"{len(self.axes)} leaves"
+                )
+            self._part_shapes = [tuple(s) for s, _ in part_specs]
+            self._part_dtypes = [np.dtype(d) for _, d in part_specs]
 
-    def _ensure(self, slot: int, leaves: Sequence[np.ndarray]) -> List[np.ndarray]:
-        if len(leaves) != len(self.axes):
+    def ensure_slot(
+        self,
+        slot: int,
+        part_shapes: Sequence[tuple],
+        part_dtypes: Sequence[np.dtype],
+    ) -> List[np.ndarray]:
+        """Allocate slot ``slot``'s buffers from explicit per-leaf
+        layout (shapes/dtypes of ONE trajectory part) — the entry point
+        for ingest paths that know the layout before any decoded leaf
+        exists (a coded frame's meta, or the wire plan's eval_shape
+        trace)."""
+        if len(part_shapes) != len(self.axes):
             raise ValueError(
-                f"trajectory has {len(leaves)} leaves, arena expects "
-                f"{len(self.axes)}"
+                f"trajectory has {len(part_shapes)} leaves, arena "
+                f"expects {len(self.axes)}"
             )
+        shapes = [tuple(s) for s in part_shapes]
+        dtypes = [np.dtype(d) for d in part_dtypes]
         if self._part_shapes is None:
-            self._part_shapes = [tuple(np.shape(x)) for x in leaves]
+            self._part_shapes = shapes
+            self._part_dtypes = dtypes
+        elif shapes != self._part_shapes or dtypes != self._part_dtypes:
+            # The FIRST layout seen is the arena's layout for life; a
+            # later frame claiming a different one (corrupt meta, an
+            # actor on a stale config) must be dropped, never allowed
+            # to poison the established buffers or livelock every
+            # subsequent legitimate frame.
+            raise ValueError(
+                f"trajectory leaf layout {shapes} != arena part "
+                f"layout {self._part_shapes} (all actors must share "
+                f"one config)"
+            )
         bufs = self._slots[slot]
         if bufs is None:
             bufs = []
-            for x, ax in zip(leaves, self.axes):
-                shape = list(np.shape(x))
+            for s, dt, ax in zip(shapes, dtypes, self.axes):
+                shape = list(s)
                 shape[ax] *= self.n_parts
-                bufs.append(np.empty(shape, dtype=np.asarray(x).dtype))
+                bufs.append(np.empty(shape, dtype=dt))
             self._slots[slot] = bufs
         return bufs
+
+    def _ensure(self, slot: int, leaves: Sequence[np.ndarray]) -> List[np.ndarray]:
+        return self.ensure_slot(
+            slot,
+            [tuple(np.shape(x)) for x in leaves],
+            [np.asarray(x).dtype for x in leaves],
+        )
+
+    def part_views(self, slot: int, part: int) -> List[np.ndarray]:
+        """Per-leaf DESTINATION views of part ``part`` in slot ``slot``
+        (each shaped exactly like one trajectory leaf; strided along
+        the concat axis). These are what the trajectory codec decodes
+        INTO — the slot is the destination, so a decoded wire batch
+        never exists anywhere but the arena."""
+        bufs = self._slots[slot]
+        assert bufs is not None and self._part_shapes is not None, (
+            "slot never allocated"
+        )
+        views = []
+        for buf, ax, pshape in zip(bufs, self.axes, self._part_shapes):
+            w = pshape[ax]
+            sl = [slice(None)] * len(pshape)
+            sl[ax] = slice(part * w, (part + 1) * w)
+            views.append(buf[tuple(sl)])
+        return views
 
     def write_part(
         self, slot: int, part: int, leaves: Sequence[np.ndarray]
@@ -160,10 +244,21 @@ class LearnerPipeline:
         n_slots: int = 2,
         exec_lock: Optional[threading.Lock] = None,
         validate: Optional[Callable[[Any, Any], bool]] = None,
+        validate_coded: Optional[Callable[[Any, Any, int], bool]] = None,
+        max_decode_bytes: int = 1 << 30,
+        part_specs: Optional[Sequence[Tuple[tuple, Any]]] = None,
         name: str = "learner-pipeline",
     ):
         self._poll = poll
         self._validate = validate
+        # Post-decode validation for coded wire trajectories: they
+        # arrive compressed, so the poison check can only run once the
+        # leaves exist — which is the moment they land in the arena
+        # slot. Signature: (traj_tree, ep, source_actor_id) -> bool; a
+        # rejected part's slot space is simply reused by the next
+        # polled item.
+        self._validate_coded = validate_coded
+        self._max_decode_bytes = max_decode_bytes
         self._batch_parts = batch_parts
         self._treedef = treedef
         self._axes = axes_leaves
@@ -171,7 +266,9 @@ class LearnerPipeline:
         self._assemble_device = assemble_device
         self._exec_lock = exec_lock
         self._arena = (
-            HostArena(axes_leaves, batch_parts, n_slots)
+            HostArena(
+                axes_leaves, batch_parts, n_slots, part_specs=part_specs
+            )
             if axes_leaves is not None
             else None
         )
@@ -188,6 +285,13 @@ class LearnerPipeline:
         self._error: Optional[BaseException] = None
         self.split = TimeSplit()
         self.batches = 0
+        # Trajectory-codec decode accounting (the receive side of the
+        # inbound wire ledger: coded bytes in vs decoded bytes out).
+        self.coded_parts = 0
+        self.decode_errors = 0
+        self.decode_rejects = 0
+        self.traj_coded_bytes = 0
+        self.traj_decoded_bytes = 0
         self._thread = threading.Thread(
             target=self._run, name=name, daemon=True
         )
@@ -195,43 +299,69 @@ class LearnerPipeline:
 
     # -- prefetch thread ------------------------------------------------
 
+    def _filtered_poll(self, n: int) -> List[Tuple[Any, Any]]:
+        """Poll up to ``n`` items, applying the pre-arena validation
+        hook to DECODED trajectories. Coded wire trajectories pass
+        through unvalidated here — their leaves do not exist yet; the
+        post-decode hook runs once they land in the slot."""
+        out = []
+        for traj, ep in self._poll(n):
+            if (
+                self._validate is not None
+                and not isinstance(traj, CodedTrajectory)
+                and not self._validate(traj, ep)
+            ):
+                continue
+            out.append((traj, ep))
+        return out
+
     def _run(self) -> None:
         slot = 0
+        # Polled-but-not-yet-placed items: the arena path places parts
+        # incrementally (a rejected decode reuses its part index), so
+        # anything over-polled carries into the next batch.
+        pending: List[Tuple[Any, Any]] = []
         try:
             while not self._closed.is_set():
-                parts: List[Any] = []
-                eps: List[Any] = []
                 t0 = time.perf_counter()
-                while len(parts) < self._batch_parts:
+                while not pending:
                     if self._closed.is_set():
                         return
-                    for traj, ep in self._poll(self._batch_parts - len(parts)):
-                        # Pre-arena validation hook: a trajectory the
-                        # health validator rejects never touches an
-                        # arena slot (dropped-and-recorded by the
-                        # validator itself).
-                        if self._validate is not None and not self._validate(
-                            traj, ep
-                        ):
-                            continue
-                        parts.append(traj)
-                        eps.append(ep)
+                    pending.extend(self._filtered_poll(self._batch_parts))
                 self.split.add("queue_wait_s", time.perf_counter() - t0)
 
-                # Episode stats to numpy HERE (prefetch thread), so the
-                # learner loop's logging never touches device arrays.
-                eps_np = [
-                    {k: np.asarray(v) for k, v in ep.items()} for ep in eps
-                ]
-
-                first_leaves = jax.tree_util.tree_leaves(parts[0])
-                use_arena = self._arena is not None and all(
-                    isinstance(x, np.ndarray) for x in first_leaves
+                first = pending[0][0]
+                use_arena = self._arena is not None and (
+                    isinstance(first, CodedTrajectory)
+                    or all(
+                        isinstance(x, np.ndarray)
+                        for x in jax.tree_util.tree_leaves(first)
+                    )
                 )
                 if use_arena:
-                    batch, handle = self._assemble_arena(parts, slot)
+                    item = self._assemble_arena(pending, slot)
                     slot = (slot + 1) % self._n_slots
                 else:
+                    t0 = time.perf_counter()
+                    while len(pending) < self._batch_parts:
+                        if self._closed.is_set():
+                            return
+                        pending.extend(
+                            self._filtered_poll(
+                                self._batch_parts - len(pending)
+                            )
+                        )
+                    self.split.add("queue_wait_s", time.perf_counter() - t0)
+                    parts = [t for t, _ in pending[: self._batch_parts]]
+                    eps = [e for _, e in pending[: self._batch_parts]]
+                    del pending[: self._batch_parts]
+                    # Episode stats to numpy HERE (prefetch thread), so
+                    # the learner loop's logging never touches device
+                    # arrays.
+                    eps_np = [
+                        {k: np.asarray(v) for k, v in ep.items()}
+                        for ep in eps
+                    ]
                     t0 = time.perf_counter()
                     if self._exec_lock is not None:
                         with self._exec_lock:
@@ -240,10 +370,9 @@ class LearnerPipeline:
                     else:
                         batch = self._assemble_device(parts)
                     self.split.add("assemble_s", time.perf_counter() - t0)
-                    handle = None
+                    item = (batch, eps_np, None)
+                    del batch, parts, eps, eps_np
 
-                item = (batch, eps_np, handle)
-                del batch, parts, eps, eps_np  # ready queue owns them now
                 while not self._closed.is_set():
                     try:
                         self._ready.put(item, timeout=0.2)
@@ -251,13 +380,52 @@ class LearnerPipeline:
                         break
                     except queue_lib.Full:
                         continue
+                del item  # ready queue owns it now
         except _PipelineClosed:
             pass  # ordered shutdown observed mid-assembly; not an error
         except BaseException as e:
             self._error = e
             self._closed.set()
 
-    def _assemble_arena(self, parts: List[Any], slot: int):
+    def _decode_into(self, slot: int, part: int, coded: CodedTrajectory):
+        """Decode a coded wire trajectory DIRECTLY into the arena part
+        views — the zero-copy receive contract: the slot is the
+        destination, no assembled-trajectory staging buffer exists
+        between the (CRC-verified) wire bytes and the arena. Returns
+        the decoded pytree (leaves alias the slot), or ``None`` when
+        the frame is undecodable / shaped for a different config — the
+        part index is simply reused by the next polled item, so a
+        failed decode can never leave a torn part inside a batch."""
+        try:
+            infos = coded.infos(max_leaf_bytes=self._max_decode_bytes)
+            if len(infos) != len(self._axes):
+                raise CodecError(
+                    f"coded trajectory has {len(infos)} leaves, arena "
+                    f"expects {len(self._axes)}"
+                )
+            self._arena.ensure_slot(
+                slot,
+                [i.shape for i in infos],
+                [i.dtype for i in infos],
+            )
+            leaves = coded.decode(
+                self._arena.part_views(slot, part),
+                max_leaf_bytes=self._max_decode_bytes,
+            )
+        except (CodecError, ValueError) as e:
+            self.decode_errors += 1
+            print(
+                f"[learner-pipeline] dropping undecodable coded "
+                f"trajectory from actor {coded.actor_id}: {e}",
+                flush=True,
+            )
+            return None
+        self.coded_parts += 1
+        self.traj_coded_bytes += coded.coded_nbytes
+        self.traj_decoded_bytes += sum(int(x.nbytes) for x in leaves)
+        return jax.tree_util.tree_unflatten(self._treedef, leaves)
+
+    def _assemble_arena(self, pending: List[Tuple[Any, Any]], slot: int):
         # Wait until this slot's previous batch fully retired: its
         # consumer step's token is device-ready (covers the transfer
         # too — the step read the transferred buffers).
@@ -275,13 +443,64 @@ class LearnerPipeline:
             jax.block_until_ready(token)
         self.split.add("slot_wait_s", time.perf_counter() - t0)
 
-        t0 = time.perf_counter()
-        for j, traj in enumerate(parts):
-            self._arena.write_part(
-                slot, j, jax.tree_util.tree_leaves(traj)
-            )
-        self.split.add("assemble_s", time.perf_counter() - t0)
+        # Incremental fill: each polled item is placed (decoded or
+        # strided-written) the moment it is available; a part whose
+        # decode fails or whose post-decode validation rejects it is
+        # overwritten by the next item, so only fully-landed,
+        # admitted parts ever make up a batch (torn-slot safety).
+        eps: List[Any] = []
+        placed = 0
+        while placed < self._batch_parts:
+            t0 = time.perf_counter()
+            while not pending:
+                if self._closed.is_set():
+                    raise _PipelineClosed()
+                pending.extend(
+                    self._filtered_poll(self._batch_parts - placed)
+                )
+            self.split.add("queue_wait_s", time.perf_counter() - t0)
+            traj, ep = pending.pop(0)
+            if isinstance(traj, CodedTrajectory):
+                t0 = time.perf_counter()
+                tree = self._decode_into(slot, placed, traj)
+                self.split.add("decode_s", time.perf_counter() - t0)
+                if tree is None:
+                    continue
+                if self._validate_coded is not None and not (
+                    self._validate_coded(tree, ep, traj.actor_id)
+                ):
+                    # Dropped-and-recorded by the validator; the slot
+                    # space is reused, nothing downstream ever sees it.
+                    self.decode_rejects += 1
+                    continue
+            else:
+                t0 = time.perf_counter()
+                try:
+                    self._arena.write_part(
+                        slot, placed, jax.tree_util.tree_leaves(traj)
+                    )
+                except ValueError as e:
+                    # Same fault envelope as the coded path: a plain
+                    # frame whose layout does not match this learner's
+                    # config (stale-config legacy actor) is dropped
+                    # and its part index reused — never fatal.
+                    self.decode_errors += 1
+                    print(
+                        f"[learner-pipeline] dropping mis-laid-out "
+                        f"plain trajectory: {e}",
+                        flush=True,
+                    )
+                    self.split.add(
+                        "assemble_s", time.perf_counter() - t0
+                    )
+                    continue
+                self.split.add("assemble_s", time.perf_counter() - t0)
+            eps.append(ep)
+            placed += 1
 
+        eps_np = [
+            {k: np.asarray(v) for k, v in ep.items()} for ep in eps
+        ]
         t0 = time.perf_counter()
         dev_leaves = [
             jax.device_put(buf, s)
@@ -293,7 +512,7 @@ class LearnerPipeline:
         jax.block_until_ready(dev_leaves)
         self.split.add("transfer_s", time.perf_counter() - t0)
         batch = jax.tree_util.tree_unflatten(self._treedef, dev_leaves)
-        return batch, slot
+        return batch, eps_np, slot
 
     # -- consumer side --------------------------------------------------
 
@@ -330,6 +549,18 @@ class LearnerPipeline:
         m = self.split.window()
         m["pipeline_batches"] = self.batches
         m["pipeline_depth"] = self._ready.qsize()
+        if self.coded_parts or self.decode_errors:
+            # Inbound codec ledger (lifetime): what the coded parts
+            # cost on the wire vs what they expanded to in the arena.
+            m["pipeline_coded_parts"] = self.coded_parts
+            m["pipeline_decode_errors"] = self.decode_errors
+            m["pipeline_decode_rejects"] = self.decode_rejects
+            m["traj_coded_mb"] = round(self.traj_coded_bytes / 1e6, 6)
+            m["traj_decoded_mb"] = round(self.traj_decoded_bytes / 1e6, 6)
+            if self.traj_coded_bytes:
+                m["traj_codec_ratio"] = round(
+                    self.traj_decoded_bytes / self.traj_coded_bytes, 2
+                )
         return m
 
     def close(self) -> None:

@@ -1,4 +1,4 @@
-"""Param wire codec: delta + compression for weight publication.
+"""Wire codecs: param delta + trajectory columnar compression.
 
 IMPALA-class systems fan every published version out to the whole
 actor fleet; with K actors and publish-per-step learners the wire cost
@@ -38,13 +38,24 @@ held base — the client must hold bit-identical wire leaves for
 state with the connection (a reconnect may land on a DIFFERENT
 learner whose version counter collides numerically).
 
+The trajectory direction (actor -> learner) is covered by the second
+half of this module (see ``TrajEncoder``/``decode_traj``): consecutive
+trajectories share no base to XOR against, so the scheme is columnar
+per-leaf — an optional temporal delta along the rollout axis for uint8
+image observations, the same byte-plane shuffle, zlib level 1, and
+per-leaf smaller-of-coded-or-plain selection. Both directions share
+ONE byte-plane core (:func:`byteplane_shuffle` /
+:func:`byteplane_unshuffle`).
+
 numpy + zlib only; nothing here imports jax.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import time
 import zlib
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -60,16 +71,21 @@ FLAG_DELTA = 1 << 1  # payload is zlib(XOR bytes vs the held base leaf)
 ZLIB_LEVEL = 1
 
 
-def _shuffle(xored: np.ndarray, itemsize: int) -> np.ndarray:
-    """Byte-plane transpose of XOR bytes (itemsize > 1): word-aligned
-    zero bytes become contiguous zero runs. Pure permutation —
-    losslessly undone by :func:`_unshuffle`."""
-    if itemsize <= 1 or xored.size % itemsize:
-        return xored
-    return np.ascontiguousarray(xored.reshape(-1, itemsize).T).reshape(-1)
+def byteplane_shuffle(flat: np.ndarray, itemsize: int) -> np.ndarray:
+    """Byte-plane transpose of a flat byte stream (itemsize > 1): all
+    byte-0s of every word, then all byte-1s, ... (the HDF5 "shuffle"
+    filter). Word-aligned near-constant bytes — XOR-delta zeros in the
+    param direction, sign/exponent bytes of adjacent floats, the high
+    bytes of small ints — become contiguous runs DEFLATE collapses far
+    better than interleaved ones. Pure permutation — losslessly undone
+    by :func:`byteplane_unshuffle`. Shared by the param delta codec
+    and the trajectory codec (one core, two directions)."""
+    if itemsize <= 1 or flat.size % itemsize:
+        return flat
+    return np.ascontiguousarray(flat.reshape(-1, itemsize).T).reshape(-1)
 
 
-def _unshuffle(flat: np.ndarray, itemsize: int) -> np.ndarray:
+def byteplane_unshuffle(flat: np.ndarray, itemsize: int) -> np.ndarray:
     if itemsize <= 1 or flat.size % itemsize:
         return flat
     return np.ascontiguousarray(flat.reshape(itemsize, -1).T).reshape(-1)
@@ -203,7 +219,7 @@ def encode_delta(
                 memoryview(np.ascontiguousarray(b)).cast("B"),
             )
             comp = zlib.compress(
-                _shuffle(xored, a.dtype.itemsize), level
+                byteplane_shuffle(xored, a.dtype.itemsize), level
             )
             if len(comp) < a.nbytes:
                 out.append(np.frombuffer(comp, np.uint8))
@@ -252,7 +268,9 @@ def decode(
                 f"{base.nbytes}"
             )
         new = np.bitwise_xor(
-            _unshuffle(np.frombuffer(raw, np.uint8), base.dtype.itemsize),
+            byteplane_unshuffle(
+                np.frombuffer(raw, np.uint8), base.dtype.itemsize
+            ),
             memoryview(base).cast("B"),
         )
         out.append(new.view(base.dtype).reshape(base.shape))
@@ -263,3 +281,404 @@ def frame_nbytes(arrays: Sequence[np.ndarray]) -> int:
     """Payload bytes of a frame's arrays (the codec-visible size; the
     transport adds ~30 header bytes per array on top)."""
     return int(sum(np.asarray(a).nbytes for a in arrays))
+
+
+# =====================================================================
+# Trajectory codec (actor -> learner direction).
+#
+# No XOR base exists between consecutive trajectories (each rollout is
+# fresh data), so the scheme is columnar per leaf:
+#
+#   encode = zlib1(byteplane_shuffle(temporal_delta?(leaf bytes)))
+#   decode = undelta(unshuffle(inflate(payload)))  -> straight into the
+#            caller-supplied destination (an arena slot view)
+#
+# Temporal delta applies only to uint8 leaves whose axis 0 is the
+# rollout time axis (image observations): adjacent frames of an
+# Atari-class env differ in a few hundred pixels, so the per-pixel
+# difference (mod-256, lossless by uint8 wraparound) is near-zero
+# almost everywhere and DEFLATE collapses it. Float leaves rarely pay
+# — per-leaf smaller-of-coded-or-plain selection makes the codec a
+# no-op exactly where it does not help, so enabling it can never
+# inflate the wire.
+# =====================================================================
+
+TRAJ_CODEC_VERSION = 1
+
+# Per-leaf flags in the trajectory meta vector.
+TFLAG_CODED = 1        # payload is zlib(shuffled (maybe delta'd) bytes)
+TFLAG_TDELTA = 1 << 2  # temporal delta along axis 0 applied pre-shuffle
+
+# Leaves below this size ride plain without even attempting
+# compression: the zlib call + per-array wire header overhead dwarfs
+# any conceivable win on scalar/episode-info-sized leaves.
+TRAJ_MIN_CODE_BYTES = 512
+
+_TRAJ_MAX_NDIM = 32
+
+
+@dataclasses.dataclass(frozen=True)
+class TrajLeafInfo:
+    """Decoded layout of one trajectory leaf, parsed from the meta
+    vector — the "decoded-size header" that lets the receiver hand the
+    inflate step an arena slot destination of the right size BEFORE
+    touching the payload."""
+
+    flags: int
+    dtype: np.dtype
+    shape: Tuple[int, ...]
+
+    @property
+    def nbytes(self) -> int:
+        n = self.dtype.itemsize
+        for d in self.shape:
+            n *= d
+        return n
+
+
+def traj_meta(infos: Sequence[TrajLeafInfo]) -> np.ndarray:
+    """Meta vector: ``[version, n_leaves]`` then per leaf
+    ``[flags, dtype_char, itemsize, ndim, *dims]`` (variable length,
+    parsed sequentially). ``dtype_char`` is ``np.dtype.char`` (a
+    unique ASCII code that round-trips through ``np.dtype(chr(c))``);
+    itemsize rides along as a cross-check."""
+    out: List[int] = [TRAJ_CODEC_VERSION, len(infos)]
+    for info in infos:
+        out += [
+            info.flags,
+            ord(info.dtype.char),
+            info.dtype.itemsize,
+            len(info.shape),
+            *info.shape,
+        ]
+    return np.asarray(out, np.int64)
+
+
+def parse_traj_meta(
+    meta: np.ndarray, *, max_leaf_bytes: int = 1 << 30
+) -> List[TrajLeafInfo]:
+    """Meta vector -> per-leaf decoded layouts, every field validated
+    BEFORE the decoder commits memory (the meta crossed the wire; CRC
+    catches corruption, these checks catch a hostile or buggy peer)."""
+    m = np.asarray(meta).reshape(-1)
+    if m.dtype.kind not in "iu":
+        # The meta is an int64 vector by construction; a float meta is
+        # corrupt or hostile, and int() over inf/nan would escape as
+        # OverflowError/ValueError instead of a clean drop.
+        raise CodecError(
+            f"trajectory meta has non-integer dtype {m.dtype.str}"
+        )
+    if m.size < 2 or int(m[0]) != TRAJ_CODEC_VERSION:
+        raise CodecError(f"bad trajectory codec meta (size {m.size})")
+    n = int(m[1])
+    if not 0 <= n <= 4096:
+        raise CodecError(f"trajectory meta claims {n} leaves")
+    infos: List[TrajLeafInfo] = []
+    pos = 2
+    for i in range(n):
+        if pos + 4 > m.size:
+            raise CodecError(f"trajectory meta truncated at leaf {i}")
+        flags, char, itemsize, ndim = (int(x) for x in m[pos : pos + 4])
+        pos += 4
+        if flags & ~(TFLAG_CODED | TFLAG_TDELTA):
+            # Unknown flag bits would decode to silently-wrong data;
+            # new transforms must bump TRAJ_CODEC_VERSION.
+            raise CodecError(
+                f"trajectory leaf {i} unknown flags {flags:#x}"
+            )
+        if not 0 <= ndim <= _TRAJ_MAX_NDIM:
+            raise CodecError(f"trajectory leaf {i} claims rank {ndim}")
+        if pos + ndim > m.size:
+            raise CodecError(f"trajectory meta truncated at leaf {i}")
+        shape = tuple(int(x) for x in m[pos : pos + ndim])
+        pos += ndim
+        if any(d < 0 for d in shape):
+            raise CodecError(f"trajectory leaf {i} negative dim {shape}")
+        try:
+            dtype = np.dtype(chr(char))
+        except (ValueError, TypeError, OverflowError) as e:
+            raise CodecError(
+                f"trajectory leaf {i} undecodable dtype char {char}"
+            ) from e
+        if dtype.kind not in "biufc":
+            # Numeric kinds only: trajectory leaves are tensors. An
+            # object/void/datetime dtype here is a hostile or corrupt
+            # meta, and downstream ops (.view, accumulate) would raise
+            # TypeError instead of a clean drop.
+            raise CodecError(
+                f"trajectory leaf {i} non-numeric dtype {dtype.str}"
+            )
+        if dtype.itemsize != itemsize:
+            raise CodecError(
+                f"trajectory leaf {i} itemsize {itemsize} != dtype "
+                f"{dtype.str} ({dtype.itemsize})"
+            )
+        if flags & TFLAG_TDELTA and (
+            not flags & TFLAG_CODED or ndim < 1
+        ):
+            # The encoder only ever emits TDELTA on coded, rank>=1
+            # leaves; anything else is malformed (a plain leaf with
+            # the flag would be silently mis-decoded, a 0-d one would
+            # crash the accumulate).
+            raise CodecError(
+                f"trajectory leaf {i} invalid TDELTA flags "
+                f"({flags:#x}, rank {ndim})"
+            )
+        info = TrajLeafInfo(flags, dtype, shape)
+        if info.nbytes > max_leaf_bytes:
+            raise CodecError(
+                f"trajectory leaf {i} claims {info.nbytes} bytes "
+                f"(limit {max_leaf_bytes})"
+            )
+        infos.append(info)
+    if pos != m.size:
+        raise CodecError(
+            f"trajectory meta carries {m.size - pos} trailing words"
+        )
+    return infos
+
+
+def _tdelta(a: np.ndarray) -> np.ndarray:
+    """Temporal delta along axis 0 (mod-256 for uint8 — exactly
+    inverted by the wrapping cumulative sum in the decoder)."""
+    d = a.copy()
+    d[1:] -= a[:-1]
+    return d
+
+
+class TrajEncoder:
+    """Actor-side trajectory encoder with lifetime counters.
+
+    ``encode(leaves, tdelta_ok)`` returns the coded frame's arrays,
+    ``[meta] + wire leaves``: per leaf, zlib-1 over the byte-plane
+    shuffled bytes (uint8 leaves flagged time-major in ``tdelta_ok``
+    get a temporal delta along axis 0 first), kept only when the
+    compressed payload is SMALLER than the plain leaf — otherwise the
+    plain leaf rides inside the same frame (flags 0), so the codec is
+    a per-leaf no-op where it does not pay. Plain leaves are passed by
+    reference (zero-copy); the caller must not mutate them until the
+    send completes (same contract as the plain push path).
+    """
+
+    def __init__(
+        self,
+        *,
+        obs_delta: bool = True,
+        level: int = ZLIB_LEVEL,
+        min_bytes: int = TRAJ_MIN_CODE_BYTES,
+    ):
+        self._obs_delta = obs_delta
+        self._level = level
+        self._min_bytes = min_bytes
+        self.frames = 0
+        self.raw_bytes = 0
+        self.wire_bytes = 0
+        self.coded_leaves = 0
+        self.plain_leaves = 0
+        self.encode_s = 0.0
+
+    def encode(
+        self,
+        leaves: Sequence[np.ndarray],
+        tdelta_ok: Optional[Sequence[bool]] = None,
+    ) -> List[np.ndarray]:
+        t0 = time.perf_counter()
+        infos: List[TrajLeafInfo] = []
+        wire: List[np.ndarray] = []
+        for i, leaf in enumerate(leaves):
+            a = np.asarray(leaf)
+            a = np.ascontiguousarray(a).reshape(a.shape)
+            flags = 0
+            coded = None
+            if a.nbytes >= self._min_bytes and a.dtype.char != "V":
+                work = a
+                if (
+                    self._obs_delta
+                    and a.dtype == np.uint8
+                    and a.ndim >= 1
+                    and a.shape[0] > 1
+                    and (tdelta_ok is None or tdelta_ok[i])
+                ):
+                    work = _tdelta(a)
+                    flags |= TFLAG_TDELTA
+                flat = work.reshape(-1).view(np.uint8)
+                comp = zlib.compress(
+                    byteplane_shuffle(flat, a.dtype.itemsize), self._level
+                )
+                if len(comp) < a.nbytes:
+                    coded = np.frombuffer(comp, np.uint8)
+                    flags |= TFLAG_CODED
+                else:
+                    flags = 0  # delta without compression gains nothing
+            infos.append(TrajLeafInfo(flags, a.dtype, a.shape))
+            wire.append(coded if coded is not None else a)
+            self.raw_bytes += a.nbytes
+            self.wire_bytes += wire[-1].nbytes
+            if coded is not None:
+                self.coded_leaves += 1
+            else:
+                self.plain_leaves += 1
+        self.frames += 1
+        self.encode_s += time.perf_counter() - t0
+        return [traj_meta(infos), *wire]
+
+    def stats(self) -> dict:
+        return {
+            "traj_encoded_frames": self.frames,
+            "traj_encode_s": round(self.encode_s, 4),
+            "traj_raw_mb": round(self.raw_bytes / 1e6, 6),
+            "traj_wire_mb": round(self.wire_bytes / 1e6, 6),
+            "traj_coded_leaves": self.coded_leaves,
+            "traj_plain_leaves": self.plain_leaves,
+        }
+
+
+def decode_traj(
+    arrays: Sequence[np.ndarray],
+    *,
+    out: Optional[Sequence[Optional[np.ndarray]]] = None,
+    max_leaf_bytes: int = 1 << 30,
+) -> List[np.ndarray]:
+    """Coded trajectory frame ``[meta] + wire leaves`` -> decoded
+    leaves, bit-identical to what a plain ``KIND_TRAJ`` frame would
+    have delivered.
+
+    ``out`` (optional) supplies per-leaf DESTINATIONS — typically host
+    arena slot views, possibly strided — and the decode writes its
+    final output directly into them (the zero-copy ingest contract:
+    the slot is the destination, there is no assembled-trajectory
+    staging buffer between inflate and the arena). Entries may be
+    ``None`` to let that leaf allocate fresh. Without ``out``, plain
+    leaves are returned by reference (zero-copy; possibly read-only
+    views of the wire buffers) and coded leaves decode into fresh
+    arrays. Shape/dtype mismatches against a destination raise
+    ``CodecError`` — the frame was built for a different config.
+
+    The inflate is bounded by the meta's decoded size (checked against
+    ``max_leaf_bytes`` BEFORE any allocation), so a hostile frame can
+    neither zip-bomb nor overrun a destination."""
+    if not len(arrays):
+        raise CodecError("empty coded trajectory frame")
+    infos = parse_traj_meta(arrays[0], max_leaf_bytes=max_leaf_bytes)
+    total = sum(info.nbytes for info in infos)
+    if total > max_leaf_bytes:
+        # The cap bounds the AGGREGATE decoded size too: many
+        # individually-legal leaves must not multiply into a
+        # multi-GB allocation from one small wire frame.
+        raise CodecError(
+            f"coded trajectory frame decodes to {total} bytes "
+            f"(limit {max_leaf_bytes})"
+        )
+    leaves = list(arrays[1:])
+    if len(leaves) != len(infos):
+        raise CodecError(
+            f"coded trajectory frame carries {len(leaves)} leaves, meta "
+            f"says {len(infos)}"
+        )
+    if out is not None and len(out) != len(infos):
+        raise CodecError(
+            f"{len(out)} destinations for {len(infos)} leaves"
+        )
+    results: List[np.ndarray] = []
+    for i, (wire, info) in enumerate(zip(leaves, infos)):
+        dst = out[i] if out is not None else None
+        if dst is not None and (
+            dst.dtype != info.dtype or tuple(dst.shape) != info.shape
+        ):
+            raise CodecError(
+                f"leaf {i} destination {dst.dtype.str}{tuple(dst.shape)} "
+                f"!= coded {info.dtype.str}{info.shape}"
+            )
+        if not info.flags & TFLAG_CODED:
+            wire = np.ascontiguousarray(wire).reshape(wire.shape)
+            if wire.dtype != info.dtype or tuple(wire.shape) != info.shape:
+                raise CodecError(
+                    f"plain leaf {i} arrived as "
+                    f"{wire.dtype.str}{tuple(wire.shape)}, meta says "
+                    f"{info.dtype.str}{info.shape}"
+                )
+            if dst is None:
+                results.append(wire)
+            else:
+                np.copyto(dst, wire)
+                results.append(dst)
+            continue
+        if wire.dtype != np.uint8 or wire.ndim != 1:
+            raise CodecError(
+                f"coded leaf {i} payload is {wire.dtype.str} rank "
+                f"{wire.ndim}, expected 1-D uint8"
+            )
+        # Bounded inflate: ask for exactly nbytes (+1 to detect
+        # overrun) so a corrupt/hostile stream cannot balloon.
+        d = zlib.decompressobj()
+        try:
+            raw = d.decompress(
+                memoryview(np.ascontiguousarray(wire)).cast("B"),
+                info.nbytes + 1,
+            )
+        except zlib.error as e:
+            raise CodecError(f"coded leaf {i} inflate failed: {e}") from e
+        if len(raw) != info.nbytes or not d.eof:
+            raise CodecError(
+                f"coded leaf {i} inflates to {len(raw)}+ bytes, meta "
+                f"says {info.nbytes}"
+            )
+        flat = byteplane_unshuffle(
+            np.frombuffer(raw, np.uint8), info.dtype.itemsize
+        )
+        arr = flat.view(info.dtype).reshape(info.shape)
+        if info.flags & TFLAG_TDELTA:
+            if dst is None:
+                dst = np.empty(info.shape, info.dtype)
+            # Wrapping cumulative sum along the rollout axis inverts
+            # the encoder's temporal delta exactly (mod-256 for uint8)
+            # — and its output lands DIRECTLY in the destination.
+            np.add.accumulate(arr, axis=0, dtype=info.dtype, out=dst)
+            results.append(dst)
+        elif dst is None:
+            results.append(arr)
+        else:
+            np.copyto(dst, arr)
+            results.append(dst)
+    return results
+
+
+def traj_frame_decoded_nbytes(meta: np.ndarray) -> int:
+    """Total decoded bytes a coded trajectory frame will expand to."""
+    return sum(info.nbytes for info in parse_traj_meta(meta))
+
+
+@dataclasses.dataclass
+class CodedTrajectory:
+    """A received-but-not-yet-decoded trajectory frame.
+
+    The transport hands this to the trajectory sink instead of decoded
+    leaves when a ``KIND_TRAJ_CODED`` frame arrives: the compressed
+    arrays are cheap to hold (they ARE the wire bytes, CRC-verified),
+    so the queue between the server threads and the learner pipeline
+    carries compressed data and the decode happens exactly once, at
+    the point where the destination arena slot is known.
+
+    ``actor_id`` is connection-level provenance from the hello frame
+    (the validator runs post-decode, so it needs attribution to ride
+    along with the payload)."""
+
+    arrays: List[np.ndarray]  # [meta] + wire leaves
+    actor_id: int = -1
+
+    def infos(self, *, max_leaf_bytes: int = 1 << 30) -> List[TrajLeafInfo]:
+        return parse_traj_meta(self.arrays[0], max_leaf_bytes=max_leaf_bytes)
+
+    def decode(
+        self,
+        out: Optional[Sequence[Optional[np.ndarray]]] = None,
+        *,
+        max_leaf_bytes: int = 1 << 30,
+    ) -> List[np.ndarray]:
+        return decode_traj(
+            self.arrays, out=out, max_leaf_bytes=max_leaf_bytes
+        )
+
+    @property
+    def coded_nbytes(self) -> int:
+        return frame_nbytes(self.arrays)

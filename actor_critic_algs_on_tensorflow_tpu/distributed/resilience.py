@@ -140,7 +140,7 @@ class ResilientActorClient:
         idle_timeout_s: float | None = 120.0,
         connect_timeout: float = 10.0,
         max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
-        hello: Tuple[int, int, int] | None = None,
+        hello: Sequence[int] | None = None,
         rng: random.Random | None = None,
     ):
         self._host, self._port = host, port
@@ -214,6 +214,9 @@ class ResilientActorClient:
         self,
         traj_leaves: Sequence[np.ndarray],
         ep_leaves: Sequence[np.ndarray] = (),
+        *,
+        encoder=None,
+        tdelta_ok: Sequence[bool] | None = None,
     ) -> int:
         """Push with at-least-once delivery.
 
@@ -226,7 +229,33 @@ class ResilientActorClient:
         caller's buffers are arena slots that get reused the moment a
         (spurious) earlier delivery unblocks the flow — pay the copy
         only when a fault already made the operation slow.
-        """
+
+        With ``encoder`` (a ``codec.TrajEncoder``) the rollout is
+        encoded ONCE, up front, and ships as a ``KIND_TRAJ_CODED``
+        frame; every retry re-sends the identical coded bytes (never
+        re-encodes). The same pin rule applies to the CODED buffer:
+        leaves the codec left plain still alias the caller's memory,
+        so the first fault snapshots the frame's arrays before any
+        re-push. ``tdelta_ok`` flags which leaves are time-major
+        (temporal-delta eligible)."""
+        if encoder is not None:
+            coded = encoder.encode(traj_leaves, tdelta_ok)
+            n_traj = len(traj_leaves)
+            leaves = {"coded": coded, "ep": ep_leaves, "pinned": False}
+
+            def pin_if_needed():
+                if not leaves["pinned"]:
+                    leaves["coded"] = [np.array(x) for x in leaves["coded"]]
+                    leaves["ep"] = [np.array(x) for x in leaves["ep"]]
+                    leaves["pinned"] = True
+
+            with self._lock:
+                return self._op(
+                    lambda c: c.push_trajectory_coded(
+                        leaves["coded"], n_traj, leaves["ep"]
+                    ),
+                    on_fault=pin_if_needed,
+                )
         leaves = {"traj": traj_leaves, "ep": ep_leaves, "pinned": False}
 
         def pin_if_needed():

@@ -24,7 +24,10 @@ send garbage data, not code):
 
 ``tag`` is message-dependent: the param version for PARAMS/ACK frames,
 the count of trajectory leaves (vs trailing episode-info leaves) for
-TRAJ frames. ``crc32`` is the zlib CRC-32 of the payload bytes,
+TRAJ frames — and for TRAJ_CODED frames, where the arrays are
+``[trajectory codec meta] + coded leaves + episode-info leaves`` and
+the payloads stay compressed until the learner pipeline decodes them
+into arena slots. ``crc32`` is the zlib CRC-32 of the payload bytes,
 verified by ``recv_msg`` BEFORE the arrays are handed upward: bit flips
 inside a payload (flaky DCN links, buggy middleboxes) surface as a
 clean ``ChecksumError`` at the wire instead of NaN-shaped garbage
@@ -89,10 +92,23 @@ KIND_PARAMS_CODED = 14   # learner -> peer: tag = version, arrays =
 KIND_PARAMS_NOTIFY = 15  # learner -> peer: tag = freshly published
 #                          version, no arrays — fetch now (push-based
 #                          publish discovery; newest wins)
+# --- trajectory data plane (distributed.codec) -----------------------
+KIND_TRAJ_CODED = 16     # actor -> learner: tag = n coded trajectory
+#                          leaves, arrays = [traj codec meta] + coded
+#                          leaves + trailing episode-info leaves (the
+#                          columnar per-leaf codec; decoded into arena
+#                          slots learner-side)
 
 # KIND_HELLO role field values.
 ROLE_ACTOR = 0
 ROLE_STANDBY = 1
+
+# KIND_HELLO capability bits (4th hello field; absent = 0 = legacy
+# peer). Capabilities are FORWARD declarations — the server accepts
+# both plain and coded trajectory frames from anyone, so an old actor
+# that never announces (or never sends) coded frames interoperates
+# with a codec-enabled learner in the same fleet unchanged.
+CAP_TRAJ_CODED = 1
 
 _HEADER = struct_lib.Struct(">4sBQI")
 _ARRAY_HEADER = struct_lib.Struct(">B")
@@ -349,6 +365,7 @@ class _Conn:
     actor_id: int = -1
     generation: int = -1
     role: int = ROLE_ACTOR
+    caps: int = 0
     send_lock: threading.Lock = dataclasses.field(
         default_factory=threading.Lock
     )
@@ -440,6 +457,14 @@ class LearnerServer:
         self._hellos = 0
         self._checksum_failures = 0
         self._handoffs_sent = 0
+        # Inbound trajectory-plane accounting (symmetric to the
+        # param-plane outbound counters): per-kind frame counts and
+        # payload bytes, so the codec's inbound win is visible in the
+        # same log stream it optimizes.
+        self._traj_plain_frames = 0
+        self._traj_coded_frames = 0
+        self._traj_bytes_in = 0
+        self._traj_coded_bytes_in = 0
         self._bytes_out = 0
         self._param_sends = 0
         self._param_delta_sends = 0
@@ -587,6 +612,20 @@ class LearnerServer:
                 "transport_mb_in": round(self._bytes_in / 1e6, 6),
                 "transport_trajectories": self._trajectories,
                 "transport_rejected": self._rejected,
+                # Inbound trajectory plane: plain vs coded frame counts
+                # and their payload bytes. traj_codec_wire_ratio is the
+                # receiver-side view of the codec's win (decoded bytes
+                # the plain path would have shipped / bytes actually
+                # received for coded frames is reported by the decode
+                # site — the pipeline — as traj_codec_ratio).
+                "transport_traj_frames": self._traj_plain_frames,
+                "transport_traj_coded_frames": self._traj_coded_frames,
+                "transport_traj_mb_in": round(
+                    self._traj_bytes_in / 1e6, 6
+                ),
+                "transport_traj_coded_mb_in": round(
+                    self._traj_coded_bytes_in / 1e6, 6
+                ),
                 "transport_pings": self._pings,
                 "transport_hellos": self._hellos,
                 "transport_checksum_failures": self._checksum_failures,
@@ -619,6 +658,7 @@ class LearnerServer:
                     "actor_id": c.actor_id,
                     "generation": c.generation,
                     "role": c.role,
+                    "caps": c.caps,
                 }
                 for c in self._conns.values()
             ]
@@ -791,23 +831,49 @@ class LearnerServer:
                     nbytes = sum(int(a.nbytes) for a in arrays)
                     c.bytes_in += nbytes
                     self._bytes_in += nbytes
-                    if kind == KIND_TRAJ:
+                    if kind in (KIND_TRAJ, KIND_TRAJ_CODED):
                         c.trajectories += 1
                         self._trajectories += 1
+                        self._traj_bytes_in += nbytes
+                        if kind == KIND_TRAJ_CODED:
+                            self._traj_coded_frames += 1
+                            self._traj_coded_bytes_in += nbytes
+                        else:
+                            self._traj_plain_frames += 1
                     elif kind == KIND_PING:
                         self._pings += 1
-                if kind == KIND_TRAJ:
+                if kind in (KIND_TRAJ, KIND_TRAJ_CODED):
+                    if kind == KIND_TRAJ_CODED:
+                        # Coded frame: [meta] + tag coded trajectory
+                        # leaves + episode-info leaves. The payload
+                        # stays COMPRESSED here — CRC already verified
+                        # the coded bytes in recv_msg, and the decode
+                        # happens exactly once, downstream, where the
+                        # destination arena slot is known. The sink
+                        # receives a CodedTrajectory in place of the
+                        # leaf list (hello provenance attached: the
+                        # validator runs post-decode).
+                        if len(arrays) < 1 + tag:
+                            raise ConnectionError(
+                                f"coded trajectory frame carries "
+                                f"{len(arrays)} arrays, tag claims "
+                                f"{tag} coded leaves"
+                            )
+                        traj = codec.CodedTrajectory(
+                            arrays[: 1 + tag], actor_id=c.actor_id
+                        )
+                        ep = arrays[1 + tag:]
+                    else:
+                        traj, ep = arrays[:tag], arrays[tag:]
                     on_trajectory, pass_peer = self._sink
                     if pass_peer:
                         with self._reg_lock:
                             peer = PeerInfo(
                                 c.cid, c.actor_id, c.generation, c.role
                             )
-                        ok = on_trajectory(
-                            arrays[:tag], arrays[tag:], peer
-                        )
+                        ok = on_trajectory(traj, ep, peer)
                     else:
-                        ok = on_trajectory(arrays[:tag], arrays[tag:])
+                        ok = on_trajectory(traj, ep)
                     if ok is False:
                         with self._reg_lock:
                             c.rejected += 1
@@ -820,7 +886,10 @@ class LearnerServer:
                 elif kind == KIND_PING:
                     self._send(c, KIND_PONG, tag)
                 elif kind == KIND_HELLO:
-                    # Identity announcement: [actor_id, generation, role].
+                    # Identity announcement: [actor_id, generation,
+                    # role, caps] — the trailing fields are optional so
+                    # a legacy 3-field hello (pre-capability actor)
+                    # parses unchanged with caps 0.
                     # One-way (no reply) so the client never blocks on it.
                     ident = (
                         np.asarray(arrays[0]).reshape(-1)
@@ -833,6 +902,8 @@ class LearnerServer:
                             c.generation = int(ident[1])
                         if ident.size >= 3:
                             c.role = int(ident[2])
+                        if ident.size >= 4:
+                            c.caps = int(ident[3])
                         self._hellos += 1
                 elif kind == KIND_CLOSE:
                     reason = "graceful"
@@ -971,7 +1042,7 @@ class ActorClient:
         heartbeat_interval_s: float | None = None,
         idle_timeout_s: float | None = None,
         max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
-        hello: Tuple[int, int, int] | None = None,
+        hello: Sequence[int] | None = None,
     ):
         self._sock = socket.create_connection(
             (host, port), timeout=connect_timeout
@@ -998,9 +1069,9 @@ class ActorClient:
         # was skipped from looking eternally unsatisfied.
         self.notified_version = 0
         if hello is not None:
-            # Announce (actor_id, generation, role) at connect time so
-            # the server has connection-level provenance before any
-            # payload arrives. Fire-and-forget: no reply to wait on.
+            # Announce (actor_id, generation, role[, caps]) at connect
+            # time so the server has connection-level provenance before
+            # any payload arrives. Fire-and-forget: no reply to wait on.
             self._send(
                 KIND_HELLO, 0, [np.asarray(list(hello), np.int64)]
             )
@@ -1147,6 +1218,26 @@ class ActorClient:
         arrays = [np.asarray(x) for x in traj_leaves]
         arrays += [np.asarray(x) for x in ep_leaves]
         self._send(KIND_TRAJ, len(traj_leaves), arrays)
+        kind, tag, _ = self._await_reply()
+        if kind != KIND_ACK:
+            raise ConnectionError(f"expected ACK, got kind {kind}")
+        return tag
+
+    def push_trajectory_coded(
+        self,
+        coded_arrays: Sequence[np.ndarray],
+        n_traj_leaves: int,
+        ep_leaves: Sequence[np.ndarray] = (),
+    ) -> int:
+        """Send one ALREADY-ENCODED rollout (``codec.TrajEncoder``
+        output: ``[meta] + n_traj_leaves wire leaves``); episode-info
+        leaves ride plain after it — they are scalar-sized and the
+        learner reads them before any decode. Returns the learner's
+        current param version from the ack, like ``push_trajectory``.
+        Encoding stays OUTSIDE this call so the retry layer re-sends
+        identical bytes instead of re-encoding per attempt."""
+        arrays = list(coded_arrays) + [np.asarray(x) for x in ep_leaves]
+        self._send(KIND_TRAJ_CODED, n_traj_leaves, arrays)
         kind, tag, _ = self._await_reply()
         if kind != KIND_ACK:
             raise ConnectionError(f"expected ACK, got kind {kind}")

@@ -33,6 +33,11 @@ from actor_critic_algs_on_tensorflow_tpu.envs.reacher import (  # noqa: F401
     ReacherParams,
     ReacherTPU,
 )
+from actor_critic_algs_on_tensorflow_tpu.envs.synthetic import (  # noqa: F401
+    SyntheticPixels,
+    SyntheticPixelsParams,
+    SyntheticPixelsSmall,
+)
 from actor_critic_algs_on_tensorflow_tpu.envs.wrappers import (  # noqa: F401
     AutoReset,
     EpisodeStats,
@@ -50,6 +55,8 @@ _REGISTRY = {
     "PongServeTPU-v0": PongServeTPU,
     "PongTPU-v0": PongTPU,
     "ReacherTPU-v0": ReacherTPU,
+    "SyntheticPixels-v0": SyntheticPixels,
+    "SyntheticPixelsSmall-v0": SyntheticPixelsSmall,
 }
 
 # Host envs are stateful (the simulator lives host-side), so repeated

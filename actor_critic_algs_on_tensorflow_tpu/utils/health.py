@@ -564,6 +564,18 @@ class TrajectoryValidator:
                         )
         return None
 
+    def drop_quarantined(self, source_actor_id: int = -1) -> bool:
+        """Ingress shed for payloads whose leaves do not exist yet
+        (coded wire trajectories are validated post-decode): True —
+        and counted as a drop, exactly like ``admit``'s gate — when
+        the source actor is quarantined, so a poisoned actor's frames
+        are shed before they cost a queue slot or a decode."""
+        with self._lock:
+            if int(source_actor_id) in self._quarantined:
+                self.dropped += 1
+                return True
+        return False
+
     def admit(self, traj: Any, ep: Any, source_actor_id: int = -1) -> bool:
         """``source_actor_id`` (when >= 0) is connection-level
         provenance from the transport hello frame — preferred over the

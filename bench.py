@@ -40,6 +40,14 @@ publish-fetch for the delta codec vs full frames, plus the
 publish->actor-visible latency through the notify broadcast. Merged
 under ``"param_plane"``; same off-by-default contract. (The leg runs
 on CPU — wire bytes are device-independent.)
+
+Optional trajectory wire leg (``BENCH_TRAJ=1``): a fourth subprocess
+pushes real pixel-obs rollouts (SyntheticPixels fixture) from a fleet
+of actor clients at one LearnerServer with the trajectory codec on vs
+off — inbound MB/s, bytes-per-frame reduction, per-frame encode/decode
+cost — plus a small end-to-end distributed run reporting learner stall
+share both ways. Merged under ``"traj_plane"``; same off-by-default
+contract (scripts/traj_bench.py owns the measurement helpers).
 """
 
 from __future__ import annotations
@@ -234,6 +242,35 @@ def measure_params() -> dict:
     return out
 
 
+def measure_traj() -> dict:
+    """Trajectory-plane wire leg (scripts/traj_bench.py owns the
+    helpers): fleet-push inbound MB/s + compression ratio with the
+    codec on vs off over real pixel-obs rollouts, and a small
+    distributed e2e run's stall share both ways."""
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts"),
+    )
+    import traj_bench as tb
+
+    out = {
+        "wire": tb.wire_leg(
+            n_actors=int(os.environ.get("BENCH_TRAJ_ACTORS", 16)),
+            pushes_per_actor=int(os.environ.get("BENCH_TRAJ_PUSHES", 8)),
+            rollout_length=int(os.environ.get("BENCH_TRAJ_ROLLOUT", 32)),
+            envs_per_actor=int(os.environ.get("BENCH_TRAJ_ENVS", 8)),
+            env=os.environ.get("BENCH_TRAJ_ENV", "SyntheticPixels-v0"),
+        )
+    }
+    if int(os.environ.get("BENCH_TRAJ_E2E", 1)):
+        out["e2e"] = tb.e2e_leg(
+            iters=int(os.environ.get("BENCH_TRAJ_E2E_ITERS", 12)),
+            env=os.environ.get("BENCH_TRAJ_ENV", "SyntheticPixels-v0"),
+            num_actors=int(os.environ.get("BENCH_TRAJ_E2E_ACTORS", 4)),
+        )
+    return out
+
+
 def _notify_latencies_ms(cpb, versions) -> list:
     """publish() -> fetch-complete latencies (ms); the harness itself
     lives in controlplane_bench (single source of truth)."""
@@ -257,6 +294,15 @@ def main() -> int:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         try:
             print(json.dumps(measure_params()))
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+            return 1
+        return 0
+
+    if len(sys.argv) > 1 and sys.argv[1] == "--measure-traj":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        try:
+            print(json.dumps(measure_traj()))
         except Exception:
             traceback.print_exc(file=sys.stderr)
             return 1
@@ -391,6 +437,27 @@ def main() -> int:
             sys.stderr.write(
                 "[bench] param plane leg failed\n"
                 + (child.stderr[-2000:] if "child" in dir() else "")
+            )
+    if os.environ.get("BENCH_TRAJ"):
+        # Distinct variable: `child` may still hold the PARAMS leg's
+        # subprocess, and a traj-leg failure must not print the wrong
+        # leg's stderr.
+        tchild = None
+        try:
+            tchild = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--measure-traj"],
+                capture_output=True,
+                text=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                timeout=int(os.environ.get("BENCH_CHILD_TIMEOUT", 900)),
+            )
+            payload["traj_plane"] = json.loads(
+                tchild.stdout.strip().splitlines()[-1]
+            )
+        except Exception:
+            sys.stderr.write(
+                "[bench] traj plane leg failed\n"
+                + (tchild.stderr[-2000:] if tchild is not None else "")
             )
     print(json.dumps(payload))
     return 0

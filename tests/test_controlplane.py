@@ -497,7 +497,18 @@ def test_learner_loop_consensus_two_inprocess_hosts(tmp_path):
     """Integration: two REAL run_impala learners (own actors, own
     checkpoint dirs) under one leader/follower pair, stopped at
     staggered moments -> both final checkpoints land at ONE agreed
-    step, verified by restores that assert step equality."""
+    step, verified by restores that assert step equality.
+
+    Deflake note (PR 6): the stop events are set from INSIDE each
+    host's ``log_fn`` — synchronous with its learner loop — not from a
+    main-thread watcher polling the logged-step lists. The watcher
+    version was load-flaky: post-compile CartPole iterations are
+    sub-millisecond, so one descheduled 50 ms poll window let a host
+    sprint through its ENTIRE env-step budget and return uninterrupted
+    (no final save -> empty checkpoint dir -> FileNotFoundError at the
+    restore). With the stop decision made on the learner thread at a
+    fixed logged-step count, interruption mid-run is guaranteed by
+    construction under any scheduler."""
     import jax
 
     from actor_critic_algs_on_tensorflow_tpu.algos import impala
@@ -521,15 +532,24 @@ def test_learner_loop_consensus_two_inprocess_hosts(tmp_path):
         stops = {"A": threading.Event(), "B": threading.Event()}
         results = {}
 
-        def host(name, seed, coordinator, stop, ckpt_dir):
+        def host(name, seed, coordinator, stop, ckpt_dir, stop_after):
             ckpt = Checkpointer(ckpt_dir, async_save=False)
+
+            def log_fn(s, m):
+                # Stagger the "SIGTERM" deterministically: the event is
+                # set on THIS thread once `stop_after` iterations have
+                # logged, so the loop observes it at the next iteration
+                # boundary — a mid-run preemption by construction.
+                steps = results.setdefault(f"{name}_steps", [])
+                steps.append(s)
+                if len(steps) >= stop_after:
+                    stop.set()
+
             try:
                 state, _ = impala.run_impala(
                     cfg_for(seed),
                     log_interval=1,
-                    log_fn=lambda s, m: results.setdefault(
-                        f"{name}_steps", []
-                    ).append(s),
+                    log_fn=log_fn,
                     checkpointer=ckpt, checkpoint_interval=10**9,
                     stop_event=stop, coordinator=coordinator,
                 )
@@ -540,32 +560,31 @@ def test_learner_loop_consensus_two_inprocess_hosts(tmp_path):
             finally:
                 ckpt.close()
 
+        # A stops early, B keeps training a while longer, so their
+        # local steps genuinely diverge and the consensus catch-up has
+        # real work to do.
         ta = threading.Thread(
             target=host,
-            args=("A", 0, leader, stops["A"], tmp_path / "a"),
+            args=("A", 0, leader, stops["A"], tmp_path / "a", 2),
             daemon=True,
         )
         tb = threading.Thread(
             target=host,
-            args=("B", 1, follower, stops["B"], tmp_path / "b"),
+            args=("B", 1, follower, stops["B"], tmp_path / "b", 5),
             daemon=True,
         )
         ta.start()
         tb.start()
-        # Stagger the "SIGTERM": A stops early, B keeps training a
-        # while longer, so their local steps genuinely diverge and the
-        # consensus catch-up has real work to do.
-        while len(results.get("A_steps", [])) < 2:
-            time.sleep(0.05)
-        stops["A"].set()
-        while len(results.get("B_steps", [])) < 5:
-            time.sleep(0.05)
-        stops["B"].set()
         ta.join(timeout=240.0)
         tb.join(timeout=240.0)
         assert not ta.is_alive() and not tb.is_alive()
         assert "A_error" not in results, results["A_error"]
         assert "B_error" not in results, results["B_error"]
+        # Both hosts must have been interrupted mid-run and saved; a
+        # missing save would resurface the pre-fix flake as an opaque
+        # FileNotFoundError below.
+        assert results.get("A_ckpt") is not None, "host A never saved"
+        assert results.get("B_ckpt") is not None, "host B never saved"
 
         # One agreed step: both dirs' final checkpoints restore to the
         # SAME step counter — no mixed-step restore possible.

@@ -1,0 +1,789 @@
+"""Trajectory data plane (ISSUE 6): columnar wire codec, zero-copy
+decode into host-arena slots, mixed-fleet negotiation, and the chaos
+path through a reconnect mid-coded-stream.
+
+Correctness here is pinned bit-exact: the codec is lossless by
+construction (an optional mod-256 temporal delta + a byte permutation
++ DEFLATE) and by these tests, and the aliasing tests prove the decode
+destination IS the arena slot — the zero-copy ingest contract the
+whole PR exists for.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from actor_critic_algs_on_tensorflow_tpu.data.pipeline import (
+    HostArena,
+    LearnerPipeline,
+)
+from actor_critic_algs_on_tensorflow_tpu.distributed import codec
+from actor_critic_algs_on_tensorflow_tpu.distributed.resilience import (
+    ChaosProxy,
+    ResilientActorClient,
+    RetryPolicy,
+)
+from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+    CAP_TRAJ_CODED,
+    ROLE_ACTOR,
+    ActorClient,
+    LearnerServer,
+)
+from tests.helpers import time_limit
+
+
+def _quiet_server(sink=None, **kw):
+    return LearnerServer(
+        sink if sink is not None else (lambda t, e: None),
+        log=lambda m: None,
+        **kw,
+    )
+
+
+def _pixel_leaves(rng, T=12, B=3, H=16, W=16):
+    """A trajectory-shaped leaf list around a temporally-coherent uint8
+    image stream: [obs, actions, rewards, dones, log_probs, last_obs]
+    with the obs big enough to code and the floats left incompressible
+    (random), so per-leaf selection is exercised both ways."""
+    base = (rng.integers(0, 256, (H, W))).astype(np.uint8)
+    obs = np.stack(
+        [np.roll(base, t, axis=1) for t in range(T)]
+    )[:, None, :, :].repeat(B, axis=1)
+    return [
+        obs,  # [T, B, H, W] uint8 — codes via temporal delta
+        rng.integers(0, 4, (T, B)).astype(np.int32),
+        rng.standard_normal((T, B)).astype(np.float32),
+        np.zeros((T, B), np.float32),
+        rng.standard_normal((T, B)).astype(np.float32),
+        obs[-1],  # last_obs [B, H, W] uint8
+    ]
+
+
+_PIXEL_TDELTA = [True, True, True, True, True, False]
+_PIXEL_AXES = [1, 1, 1, 1, 1, 0]
+
+
+# ---------------------------------------------------------------------
+# Codec units: shared byte-plane core + trajectory roundtrips.
+# ---------------------------------------------------------------------
+
+def test_byteplane_shuffle_roundtrip():
+    rng = np.random.default_rng(0)
+    for itemsize in (1, 2, 4, 8, 16):
+        flat = rng.integers(0, 256, 32 * itemsize).astype(np.uint8)
+        out = codec.byteplane_unshuffle(
+            codec.byteplane_shuffle(flat, itemsize), itemsize
+        )
+        np.testing.assert_array_equal(out, flat)
+    # Size not divisible by itemsize: the shuffle must pass through
+    # untouched (and its inverse too), never scramble.
+    odd = rng.integers(0, 256, 33).astype(np.uint8)
+    np.testing.assert_array_equal(codec.byteplane_shuffle(odd, 4), odd)
+    np.testing.assert_array_equal(codec.byteplane_unshuffle(odd, 4), odd)
+
+
+def test_traj_codec_roundtrip_fuzz():
+    """Bit-exact roundtrip over dtypes (incl. bool, complex, odd
+    itemsizes), shapes (0-d scalars, empty leaves, image obs), and
+    mixed temporal-delta flags."""
+    rng = np.random.default_rng(1)
+    leaves = [
+        # Compressible uint8 image stream (the design case).
+        np.tile(
+            (np.arange(4096) % 251).astype(np.uint8), (8, 1)
+        ).reshape(8, 1, 64, 64),
+        # Wrap-heavy uint8 (temporal delta crosses 255/0 constantly).
+        rng.integers(0, 256, (8, 2, 33)).astype(np.uint8),
+        (rng.standard_normal((8, 4)) * 100).astype(np.float64),
+        rng.standard_normal((8, 4)).astype(np.float32),
+        (rng.standard_normal((8, 4)) * 10).astype(np.float16),
+        rng.integers(-100, 100, (7, 3)).astype(np.int16),
+        rng.integers(0, 2, (8, 4)).astype(bool),
+        (rng.standard_normal((6,)) + 1j).astype(np.complex64),
+        np.empty((0, 5), np.float32),   # empty leaf
+        np.asarray(2.5, np.float32),    # 0-d scalar
+        np.zeros((2048,), np.float32),  # compressible float
+    ]
+    tdelta = [True, True, False, False, False, False, False, False,
+              False, False, False]
+    enc = codec.TrajEncoder()
+    arrays = enc.encode(leaves, tdelta)
+    decoded = codec.decode_traj(arrays)
+    assert len(decoded) == len(leaves)
+    for a, b in zip(leaves, decoded):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(a, b)
+    # The image stream must actually have coded (the frame is smaller
+    # than the raw leaves), and the incompressible floats ridden plain.
+    assert enc.coded_leaves >= 2
+    assert enc.plain_leaves >= 5
+    assert codec.frame_nbytes(arrays) < sum(x.nbytes for x in leaves)
+
+
+def test_traj_codec_noop_where_it_does_not_pay():
+    """Genuinely incompressible bytes (uniform-random uint8) must ride
+    PLAIN (flags 0, bytes unchanged) — enabling the codec can never
+    inflate the wire beyond the meta vector. (Random FLOATS are not
+    the no-op case: byte-plane shuffling clusters their near-constant
+    exponent/sign bytes, which zlib does squeeze a little — per-leaf
+    smaller-of selection keeps whichever won.)"""
+    rng = np.random.default_rng(2)
+    leaves = [rng.integers(0, 256, (64, 32)).astype(np.uint8)]
+    enc = codec.TrajEncoder(obs_delta=False)
+    arrays = enc.encode(leaves, [False])
+    infos = codec.parse_traj_meta(arrays[0])
+    assert infos[0].flags == 0
+    assert arrays[1].nbytes == leaves[0].nbytes
+    assert enc.coded_leaves == 0 and enc.plain_leaves == 1
+    overhead = codec.frame_nbytes(arrays) - leaves[0].nbytes
+    assert overhead == arrays[0].nbytes  # exactly the meta vector
+    # Float leaves may code (shuffled exponents compress ~10%), but
+    # selection guarantees the wire never grows.
+    f32 = [rng.standard_normal((64, 32)).astype(np.float32)]
+    coded = codec.TrajEncoder().encode(f32, [False])
+    assert codec.frame_nbytes(coded[1:]) <= f32[0].nbytes
+    np.testing.assert_array_equal(codec.decode_traj(coded)[0], f32[0])
+
+
+def test_traj_codec_tdelta_wraparound_exact():
+    """The uint8 temporal delta relies on mod-256 wraparound being
+    exactly inverted by the wrapping cumulative sum — pin it on a
+    stream engineered to cross 0/255 every step."""
+    steps = np.full((16, 1, 128), 37, np.uint8)
+    obs = np.cumsum(steps, axis=0, dtype=np.uint8)  # wraps repeatedly
+    enc = codec.TrajEncoder()
+    arrays = enc.encode([obs], [True])
+    infos = codec.parse_traj_meta(arrays[0])
+    assert infos[0].flags & codec.TFLAG_TDELTA
+    np.testing.assert_array_equal(codec.decode_traj(arrays)[0], obs)
+
+
+def test_traj_meta_rejects_garbage():
+    V = codec.TRAJ_CODEC_VERSION
+    for bad in (
+        np.asarray([], np.int64),
+        np.asarray([99, 1, 0, 0, 0, 0], np.int64),   # bad version
+        np.asarray([V, 2, 0], np.int64),             # truncated
+        np.asarray([V, 1, 0, ord("f"), 4, 40], np.int64),  # rank 40
+        # Hostile-but-CRC-valid metas must die as CodecError, never a
+        # TypeError that would kill the prefetch thread: object dtype,
+        # temporal delta on a 0-d leaf, TDELTA without CODED, and
+        # unknown flag bits.
+        np.asarray([V, 1, 0, ord("O"), 8, 1, 4], np.int64),
+        np.asarray(
+            [V, 1, codec.TFLAG_CODED | codec.TFLAG_TDELTA,
+             ord("B"), 1, 0], np.int64
+        ),
+        np.asarray(
+            [V, 1, codec.TFLAG_TDELTA, ord("B"), 1, 1, 4], np.int64
+        ),
+        np.asarray([V, 1, 1 << 7, ord("f"), 4, 1, 4], np.int64),
+        # Non-integer meta dtype: int() over inf/nan must never escape
+        # as OverflowError/ValueError past the parse.
+        np.asarray([V, np.inf, 0, 0, 0, 0], np.float64),
+        np.asarray([V, 1, 0, ord("f"), 4, 1, np.nan], np.float32),
+    ):
+        with pytest.raises(codec.CodecError):
+            codec.parse_traj_meta(bad)
+    # Decoded-size cap: a hostile meta claiming a huge leaf fails
+    # BEFORE any allocation.
+    huge = codec.traj_meta(
+        [codec.TrajLeafInfo(0, np.dtype(np.float32), (1 << 20, 1 << 14))]
+    )
+    with pytest.raises(codec.CodecError):
+        codec.parse_traj_meta(huge, max_leaf_bytes=1 << 20)
+    # Aggregate decode bomb: many individually-legal leaves whose SUM
+    # exceeds the cap must fail before any inflate — one small wire
+    # frame cannot force a multi-GB allocation.
+    rng = np.random.default_rng(0)
+    leaves = [rng.integers(0, 256, 2048).astype(np.uint8)] * 8
+    arrays = codec.TrajEncoder(min_bytes=1 << 30).encode(leaves)
+    assert len(codec.decode_traj(arrays, max_leaf_bytes=8 * 2048)) == 8
+    with pytest.raises(codec.CodecError):
+        codec.decode_traj(arrays, max_leaf_bytes=8 * 2048 - 1)
+
+
+# ---------------------------------------------------------------------
+# Decode-into-arena-slot: aliasing + torn-slot safety.
+# ---------------------------------------------------------------------
+
+def test_decode_into_arena_slot_aliasing():
+    """The acceptance contract: decoded leaves LIVE in the arena slot
+    (every returned leaf shares memory with the slot buffer — for
+    coded and plain-fallback leaves alike), and the assembled slot is
+    bit-identical to plain-frame assembly."""
+    rng = np.random.default_rng(3)
+    n_parts = 2
+    arena = HostArena(_PIXEL_AXES, n_parts)
+    parts = [_pixel_leaves(rng) for _ in range(n_parts)]
+    enc = codec.TrajEncoder()
+    for j, leaves in enumerate(parts):
+        arrays = enc.encode(leaves, _PIXEL_TDELTA)
+        infos = codec.parse_traj_meta(arrays[0])
+        arena.ensure_slot(
+            0, [i.shape for i in infos], [i.dtype for i in infos]
+        )
+        views = arena.part_views(0, j)
+        decoded = codec.decode_traj(arrays, out=views)
+        for buf, d in zip(arena.slot_leaves(0), decoded):
+            assert np.shares_memory(d, buf), (
+                "decoded leaf does not alias the arena slot"
+            )
+    # Reference assembly through the plain write path, bit-identical.
+    ref = HostArena(_PIXEL_AXES, n_parts)
+    for j, leaves in enumerate(parts):
+        ref.write_part(0, j, leaves)
+    for got, want in zip(arena.slot_leaves(0), ref.slot_leaves(0)):
+        np.testing.assert_array_equal(got, want)
+
+
+def test_decode_into_slot_rejects_mismatched_config():
+    """A frame built for a different trajectory layout must fail
+    cleanly (CodecError) without writing a byte pattern downstream
+    would trust."""
+    rng = np.random.default_rng(4)
+    arena = HostArena(_PIXEL_AXES, 1)
+    leaves = _pixel_leaves(rng)
+    arrays = codec.TrajEncoder().encode(leaves, _PIXEL_TDELTA)
+    infos = codec.parse_traj_meta(arrays[0])
+    arena.ensure_slot(
+        0, [i.shape for i in infos], [i.dtype for i in infos]
+    )
+    other = codec.TrajEncoder().encode(
+        [x[:4] for x in _pixel_leaves(rng, T=8)], _PIXEL_TDELTA
+    )
+    with pytest.raises(codec.CodecError):
+        codec.decode_traj(other, out=arena.part_views(0, 0))
+
+
+def test_arena_ensure_slot_rejects_layout_drift():
+    """The FIRST layout seen is the arena's layout for life: a later
+    ensure_slot claiming different shapes/dtypes (corrupt meta, stale
+    actor config) raises instead of silently keeping the old buffers —
+    the drop lands on the bad frame, not on every later good one."""
+    arena = HostArena([1, 0], 2)
+    arena.ensure_slot(0, [(8, 3), (3,)], [np.dtype("f4"), np.dtype("f4")])
+    with pytest.raises(ValueError, match="arena part"):
+        arena.ensure_slot(
+            0, [(8, 5), (3,)], [np.dtype("f4"), np.dtype("f4")]
+        )
+    with pytest.raises(ValueError, match="arena part"):
+        arena.ensure_slot(
+            1, [(8, 3), (3,)], [np.dtype("u1"), np.dtype("f4")]
+        )
+    # The established layout still works.
+    assert len(arena.part_views(0, 1)) == 2
+
+
+def test_validator_ingress_shed_for_quarantined_coded_source():
+    """Coded frames are validated post-decode, but a QUARANTINED
+    actor's frames must still be shed at ingress (no queue slot, no
+    decode) — quarantine membership needs no decoded leaves."""
+    from actor_critic_algs_on_tensorflow_tpu.utils import health
+
+    import types
+
+    v = health.TrajectoryValidator(
+        quarantine_threshold=1, log=lambda m: None
+    )
+    poison = types.SimpleNamespace(
+        rewards=np.full((4,), np.nan, np.float32)
+    )
+    assert not v.admit(poison, {}, source_actor_id=5)  # quarantines 5
+    dropped0 = v.metrics()["health_traj_dropped"]
+    assert v.drop_quarantined(5)
+    assert not v.drop_quarantined(6)
+    assert v.metrics()["health_traj_dropped"] == dropped0 + 1
+    # A fresh generation lifts the quarantine (probation): shed stops.
+    v.reset_actor(5)
+    assert not v.drop_quarantined(5)
+
+
+def test_arena_part_specs_seed_outranks_first_frame():
+    """Seeded from the trusted wire plan, the arena judges even the
+    FIRST wire frame against the local config — a stale-config actor
+    landing first is rejected, not enthroned."""
+    specs = [((8, 3), np.dtype("f4")), ((3,), np.dtype("f4"))]
+    arena = HostArena([1, 0], 2, part_specs=specs)
+    with pytest.raises(ValueError, match="arena part"):
+        arena.ensure_slot(0, [(8, 5), (3,)], [np.dtype("f4")] * 2)
+    arena.ensure_slot(0, [(8, 3), (3,)], [np.dtype("f4")] * 2)
+    assert arena.part_views(0, 0)[0].shape == (8, 3)
+
+
+def test_pipeline_torn_coded_frame_reuses_part():
+    """Pipeline-level torn-slot safety: an undecodable coded item
+    (compressed payload truncated in a way CRC could not see — e.g. a
+    buggy encoder) is dropped and its part index REUSED; the staged
+    batch holds only fully-decoded parts."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rng = np.random.default_rng(5)
+    good = [_pixel_leaves(rng) for _ in range(2)]
+    items = []
+    enc = codec.TrajEncoder()
+    bad = enc.encode(good[0], _PIXEL_TDELTA)
+    # Truncate the first CODED payload: inflate will fail cleanly.
+    coded_idx = next(
+        1 + i
+        for i, info in enumerate(codec.parse_traj_meta(bad[0]))
+        if info.flags & codec.TFLAG_CODED
+    )
+    bad = list(bad)
+    bad[coded_idx] = bad[coded_idx][: max(1, bad[coded_idx].size // 2)]
+    items.append((codec.CodedTrajectory(bad, actor_id=7), {"i": 0}))
+    for j, leaves in enumerate(good):
+        items.append(
+            (
+                codec.CodedTrajectory(
+                    enc.encode(leaves, _PIXEL_TDELTA), actor_id=j
+                ),
+                {"i": j + 1},
+            )
+        )
+
+    mesh = jax.sharding.Mesh(np.asarray(jax.devices()[:1]), ("data",))
+    shardings = [NamedSharding(mesh, P())] * len(_PIXEL_AXES)
+    treedef = jax.tree_util.tree_structure(list(range(len(_PIXEL_AXES))))
+    lock = threading.Lock()
+
+    def poll(n):
+        with lock:
+            out, items[:] = items[:n], items[n:]
+        if not out:
+            time.sleep(0.01)
+        return out
+
+    with time_limit(60, "torn coded frame"):
+        pipe = LearnerPipeline(
+            poll=poll,
+            batch_parts=2,
+            treedef=treedef,
+            axes_leaves=_PIXEL_AXES,
+            shardings_leaves=shardings,
+            assemble_device=None,
+        )
+        try:
+            batch, eps, handle = pipe.get(timeout=1.0)
+            assert pipe.decode_errors == 1
+            assert [int(e["i"]) for e in eps] == [1, 2]
+            got = jax.tree_util.tree_leaves(batch)
+            ref = HostArena(_PIXEL_AXES, 2)
+            for j, leaves in enumerate(good):
+                ref.write_part(0, j, leaves)
+            for g, w in zip(got, ref.slot_leaves(0)):
+                np.testing.assert_array_equal(np.asarray(g), w)
+            pipe.mark_consumed(handle, batch)
+        finally:
+            pipe.close()
+
+
+def test_pipeline_mislaid_plain_frame_reuses_part():
+    """A PLAIN wire frame whose layout mismatches the seeded arena
+    (stale-config legacy actor) is dropped with its part index reused
+    — same never-fatal envelope as the coded path."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rng = np.random.default_rng(11)
+    good = [_pixel_leaves(rng) for _ in range(2)]
+    stale = _pixel_leaves(rng, T=6)  # wrong rollout length
+    treedef = jax.tree_util.tree_structure(list(range(len(_PIXEL_AXES))))
+    items = [
+        (jax.tree_util.tree_unflatten(treedef, stale), {"i": 99}),
+        (jax.tree_util.tree_unflatten(treedef, good[0]), {"i": 0}),
+        (jax.tree_util.tree_unflatten(treedef, good[1]), {"i": 1}),
+    ]
+    mesh = jax.sharding.Mesh(np.asarray(jax.devices()[:1]), ("data",))
+    shardings = [NamedSharding(mesh, P())] * len(_PIXEL_AXES)
+    lock = threading.Lock()
+
+    def poll(n):
+        with lock:
+            out, items[:] = items[:n], items[n:]
+        if not out:
+            time.sleep(0.01)
+        return out
+
+    with time_limit(60, "mis-laid plain frame"):
+        pipe = LearnerPipeline(
+            poll=poll,
+            batch_parts=2,
+            treedef=treedef,
+            axes_leaves=_PIXEL_AXES,
+            shardings_leaves=shardings,
+            assemble_device=None,
+            part_specs=[
+                (tuple(x.shape), x.dtype) for x in good[0]
+            ],
+        )
+        try:
+            batch, eps, handle = pipe.get(timeout=1.0)
+            assert pipe.decode_errors == 1
+            assert [int(e["i"]) for e in eps] == [0, 1]
+            pipe.mark_consumed(handle, batch)
+        finally:
+            pipe.close()
+
+
+def test_pipeline_validate_coded_rejection_reuses_part():
+    """Post-decode validation: a poison coded trajectory (NaN rewards)
+    is rejected AFTER landing in the slot and its part space reused —
+    the staged batch carries only admitted parts, and the reject is
+    attributed to the hello-frame actor id."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rng = np.random.default_rng(6)
+    clean = [_pixel_leaves(rng) for _ in range(2)]
+    poison = _pixel_leaves(rng)
+    poison[2] = np.full_like(poison[2], np.nan)
+    enc = codec.TrajEncoder()
+    items = [
+        (codec.CodedTrajectory(
+            enc.encode(poison, _PIXEL_TDELTA), actor_id=3
+        ), {"i": 99}),
+        (codec.CodedTrajectory(
+            enc.encode(clean[0], _PIXEL_TDELTA), actor_id=0
+        ), {"i": 0}),
+        (codec.CodedTrajectory(
+            enc.encode(clean[1], _PIXEL_TDELTA), actor_id=1
+        ), {"i": 1}),
+    ]
+    rejected = []
+
+    def validate_coded(tree, ep, actor_id):
+        leaves = jax.tree_util.tree_leaves(tree)
+        ok = bool(np.isfinite(leaves[2]).all())
+        if not ok:
+            rejected.append(actor_id)
+        return ok
+
+    mesh = jax.sharding.Mesh(np.asarray(jax.devices()[:1]), ("data",))
+    shardings = [NamedSharding(mesh, P())] * len(_PIXEL_AXES)
+    treedef = jax.tree_util.tree_structure(list(range(len(_PIXEL_AXES))))
+    lock = threading.Lock()
+
+    def poll(n):
+        with lock:
+            out, items[:] = items[:n], items[n:]
+        if not out:
+            time.sleep(0.01)
+        return out
+
+    with time_limit(60, "coded validation"):
+        pipe = LearnerPipeline(
+            poll=poll,
+            batch_parts=2,
+            treedef=treedef,
+            axes_leaves=_PIXEL_AXES,
+            shardings_leaves=shardings,
+            assemble_device=None,
+            validate_coded=validate_coded,
+        )
+        try:
+            batch, eps, handle = pipe.get(timeout=1.0)
+            assert rejected == [3]
+            assert pipe.decode_rejects == 1
+            assert [int(e["i"]) for e in eps] == [0, 1]
+            assert bool(
+                np.isfinite(
+                    np.asarray(jax.tree_util.tree_leaves(batch)[2])
+                ).all()
+            )
+            pipe.mark_consumed(handle, batch)
+        finally:
+            pipe.close()
+
+
+# ---------------------------------------------------------------------
+# Wire: mixed fleet, bit-exactness, hello capability back-compat.
+# ---------------------------------------------------------------------
+
+def test_mixed_fleet_coded_and_plain_one_server():
+    """Acceptance: a codec-enabled actor and a legacy (plain, 3-field
+    hello) actor share one server — both trajectories delivered, the
+    coded one decoding bit-identical to the plain one's delivery, and
+    the registry records who announced the capability."""
+    rng = np.random.default_rng(7)
+    leaves = _pixel_leaves(rng)
+    ep = [np.asarray(1, np.int32)]
+    got = []
+    evt = threading.Event()
+
+    def sink(traj, ep_leaves, peer):
+        got.append((traj, ep_leaves, peer))
+        if len(got) == 2:
+            evt.set()
+        return True
+
+    with time_limit(30, "mixed fleet"):
+        server = _quiet_server(sink)
+        try:
+            new = ActorClient(
+                "127.0.0.1", server.port,
+                hello=(0, 0, ROLE_ACTOR, CAP_TRAJ_CODED),
+            )
+            legacy = ActorClient(
+                "127.0.0.1", server.port, hello=(1, 0, ROLE_ACTOR),
+            )
+            enc = codec.TrajEncoder()
+            new.push_trajectory_coded(
+                enc.encode(leaves, _PIXEL_TDELTA), len(leaves), ep
+            )
+            legacy.push_trajectory(leaves, ep)
+            assert evt.wait(10.0)
+            coded_item = next(
+                x for x in got
+                if isinstance(x[0], codec.CodedTrajectory)
+            )
+            plain_item = next(
+                x for x in got
+                if not isinstance(x[0], codec.CodedTrajectory)
+            )
+            # Bit-exact: coded delivery decodes to the plain delivery.
+            decoded = coded_item[0].decode()
+            for a, b in zip(decoded, plain_item[0]):
+                np.testing.assert_array_equal(a, b)
+            assert coded_item[0].actor_id == 0
+            # Capability negotiation via hello: announced by the new
+            # actor, absent (0) for the legacy 3-field hello.
+            caps = {
+                c["actor_id"]: c["caps"] for c in server.connections()
+            }
+            assert caps[0] == CAP_TRAJ_CODED and caps[1] == 0
+            m = server.metrics()
+            assert m["transport_traj_coded_frames"] == 1
+            assert m["transport_traj_frames"] == 1
+            assert m["transport_trajectories"] == 2
+            assert (
+                0 < m["transport_traj_coded_mb_in"]
+                < m["transport_traj_mb_in"]
+            )
+            new.close()
+            legacy.close()
+        finally:
+            server.close()
+
+
+@pytest.mark.chaos
+def test_chaos_reconnect_mid_coded_stream():
+    """Kill the link mid-coded-frame (truncate + RST): the resilient
+    client reconnects and re-pushes the SAME coded bytes; delivery is
+    bit-exact and — pin semantics — a caller mutating its buffers
+    after the faulted push returns never corrupts the retried frame."""
+    rng = np.random.default_rng(8)
+    delivered = []
+
+    def sink(traj, ep_leaves, peer):
+        delivered.append((traj, ep_leaves))
+        return True
+
+    with time_limit(60, "chaos coded reconnect"):
+        server = _quiet_server(sink)
+        proxy = ChaosProxy("127.0.0.1", server.port)
+        try:
+            client = ResilientActorClient(
+                "127.0.0.1", proxy.port,
+                retry=RetryPolicy(
+                    base_delay_s=0.01, max_delay_s=0.05, deadline_s=15.0
+                ),
+                heartbeat_interval_s=0.2, idle_timeout_s=5.0,
+                hello=(0, 0, ROLE_ACTOR, CAP_TRAJ_CODED),
+            )
+            leaves = _pixel_leaves(rng)
+            want = [x.copy() for x in leaves]
+            enc = codec.TrajEncoder()
+            # Size the cut to land MID-frame: half the coded frame's
+            # payload (a scratch encode of the same leaves).
+            frame_b = codec.frame_nbytes(
+                codec.TrajEncoder().encode(leaves, _PIXEL_TDELTA)
+            )
+            # The proxy registers links on its accept thread: wait for
+            # the client's connection to appear before injecting, or
+            # reset_all() can fire on an empty link list and the
+            # truncate arm can miss the original link too (a real
+            # race — observed as reconnects == 0).
+            deadline = time.monotonic() + 5.0
+            while proxy.live_links() == 0:
+                assert time.monotonic() < deadline, "link never appeared"
+                time.sleep(0.01)
+            # Truncate mid-frame on the NEXT link: the first push rides
+            # a fresh connection through the proxy, dies partway, and
+            # must be re-pushed whole on the reconnect.
+            proxy.reset_all()
+            proxy.set_truncate_after(frame_b // 2)
+            client.push_trajectory(
+                leaves, (), encoder=enc, tdelta_ok=_PIXEL_TDELTA
+            )
+            # The push returned: mutate the caller's buffers (arena
+            # reuse in real actors). A late re-send aliasing them would
+            # now ship garbage — the pin rule forbids it.
+            for x in leaves:
+                x.fill(0)
+            deadline = time.monotonic() + 10.0
+            while not delivered and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert delivered, "trajectory never delivered through chaos"
+            decoded = delivered[0][0].decode()
+            for a, b in zip(decoded, want):
+                np.testing.assert_array_equal(a, b)
+            assert client.reconnects >= 1
+            client.close()
+        finally:
+            proxy.close()
+            server.close()
+
+
+def test_resilient_coded_push_encodes_once():
+    """The retry layer re-sends the frame encoded at push entry — one
+    encode per rollout regardless of retries."""
+    rng = np.random.default_rng(9)
+    with time_limit(30, "encode once"):
+        server = _quiet_server(lambda t, e: True)
+        try:
+            client = ResilientActorClient("127.0.0.1", server.port)
+            enc = codec.TrajEncoder()
+            leaves = _pixel_leaves(rng)
+            for _ in range(3):
+                client.push_trajectory(
+                    leaves, (), encoder=enc, tdelta_ok=_PIXEL_TDELTA
+                )
+            assert enc.frames == 3  # one encode per push, not per send
+            client.close()
+        finally:
+            server.close()
+
+
+# ---------------------------------------------------------------------
+# End-to-end: distributed run on the pixel fixture, codec metrics.
+# ---------------------------------------------------------------------
+
+def test_distributed_pixel_fixture_codec_end_to_end():
+    """Acceptance: the full wire — jitted pixel rollouts, coded push,
+    CRC on coded bytes, decode into arena slots, post-decode
+    validation — trains with finite loss and reports the inbound
+    ledger (coded frames seen, ratio > 2x on image obs)."""
+    from actor_critic_algs_on_tensorflow_tpu.algos.impala import (
+        ImpalaConfig,
+        run_impala_distributed,
+    )
+
+    cfg = ImpalaConfig(
+        env="SyntheticPixelsSmall-v0",
+        num_actors=2,
+        envs_per_actor=2,
+        rollout_length=8,
+        batch_trajectories=2,
+        total_env_steps=2 * 8 * 2 * 5,
+        queue_size=4,
+        num_devices=1,
+        seed=1,
+    )
+    state, history = run_impala_distributed(
+        cfg, log_interval=1, log_fn=lambda s, m: None
+    )
+    assert int(state.step) == 5
+    m = history[-1][1]
+    assert np.isfinite(m["loss"])
+    assert m["transport_traj_coded_frames"] >= 5
+    assert m["transport_traj_frames"] == 0  # whole fleet announced coded
+    assert m["traj_codec_ratio"] > 2.0
+    assert m["pipeline_decode_errors"] == 0
+    assert m["health_traj_ok"] >= 5  # validator ran post-decode
+
+
+@pytest.mark.slow
+def test_distributed_serial_path_decodes_coded(tmp_path):
+    """cfg.pipeline=False: the serial drain decodes coded items (fresh
+    buffers, no arena) through the same validator."""
+    from actor_critic_algs_on_tensorflow_tpu.algos.impala import (
+        ImpalaConfig,
+        run_impala_distributed,
+    )
+
+    cfg = ImpalaConfig(
+        env="SyntheticPixelsSmall-v0",
+        num_actors=2,
+        envs_per_actor=2,
+        rollout_length=8,
+        batch_trajectories=2,
+        total_env_steps=2 * 8 * 2 * 4,
+        queue_size=4,
+        num_devices=1,
+        pipeline=False,
+        seed=2,
+    )
+    state, history = run_impala_distributed(
+        cfg, log_interval=1, log_fn=lambda s, m: None
+    )
+    assert int(state.step) == 4
+    m = history[-1][1]
+    assert np.isfinite(m["loss"])
+    assert m["transport_traj_coded_frames"] >= 4
+    assert m["health_traj_ok"] >= 4
+
+
+# ---------------------------------------------------------------------
+# Bench wiring (BENCH_TRAJ=1): tier-1 smoke + slow full leg.
+# ---------------------------------------------------------------------
+
+def test_bench_traj_wire_leg_smoke():
+    """Fast tier-1 smoke of the wire leg: tiny fleet, real server and
+    clients, and the acceptance floor — >= 2x inbound byte reduction
+    on pixel obs with the codec on."""
+    sys.path.insert(
+        0,
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts",
+        ),
+    )
+    import traj_bench as tb
+
+    out = tb.wire_leg(
+        n_actors=2,
+        pushes_per_actor=2,
+        rollout_length=8,
+        envs_per_actor=2,
+        env="SyntheticPixelsSmall-v0",
+    )
+    assert out["coded"]["wire_mb_in"] > 0
+    assert out["plain"]["wire_mb_in"] > 0
+    assert out["wire_reduction"] >= 2.0
+    assert out["decode_ms_per_frame"] >= 0
+
+
+@pytest.mark.slow
+def test_bench_traj_full_leg_subprocess():
+    """The BENCH_TRAJ=1 contract end-to-end: child-mode bench.py
+    prints one JSON object with the wire + e2e legs."""
+    import json
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        BENCH_TRAJ_ACTORS="4",
+        BENCH_TRAJ_PUSHES="2",
+        BENCH_TRAJ_ROLLOUT="16",
+        BENCH_TRAJ_ENVS="4",
+        BENCH_TRAJ_E2E="1",
+        BENCH_TRAJ_E2E_ITERS="4",
+        BENCH_TRAJ_E2E_ACTORS="2",
+        BENCH_TRAJ_ENV="SyntheticPixelsSmall-v0",
+    )
+    child = subprocess.run(
+        [sys.executable, os.path.join(root, "bench.py"), "--measure-traj"],
+        capture_output=True, text=True, cwd=root, env=env, timeout=600,
+    )
+    assert child.returncode == 0, child.stderr[-2000:]
+    out = json.loads(child.stdout.strip().splitlines()[-1])
+    assert out["wire"]["wire_reduction"] >= 2.0
+    assert "stall_share" in out["e2e"]["codec_on"]
