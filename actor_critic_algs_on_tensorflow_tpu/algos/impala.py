@@ -171,12 +171,15 @@ class ImpalaConfig:
     # leaves on the server.
     param_delta: bool = True
     param_delta_ring: int = 4
-    # Opt-in bf16 wire cast for float32 leaves on ACTOR fetches only
-    # (half the bytes BEFORE the delta pass; ~2^-8 rounding that
-    # V-trace's importance weighting already corrects). Standbys and
-    # param tailers always receive full precision — their copy seeds a
-    # takeover learner. Default OFF: full-precision wire.
-    param_bf16_wire: bool = False
+    # bf16 wire cast for float32 leaves on ACTOR fetches only (half
+    # the bytes BEFORE the delta pass; ~2^-8 rounding that V-trace's
+    # importance weighting already corrects). Standbys and param
+    # tailers always receive full precision — their copy seeds a
+    # takeover learner. Default ON since the PR-7 learning-curve A/B
+    # (CartPole + SyntheticPixels, 3 seeds each) put the rounding
+    # inside seed noise — PERF.md "Serving tier" ledger; set False to
+    # restore the bit-exact wire.
+    param_bf16_wire: bool = True
     # --- trajectory data plane (distributed.codec) --------------------
     # Columnar per-leaf compression of actor->learner trajectory
     # frames (KIND_TRAJ_CODED): byte-plane shuffle + zlib-1 with
@@ -192,6 +195,38 @@ class ImpalaConfig:
     # pixels, so the mod-256 difference is near-zero almost everywhere
     # and DEFLATE collapses it. Lossless (exact wraparound inverse).
     traj_obs_delta: bool = True
+    # --- central-inference serving tier (distributed.serving) ---------
+    # "fetch_params" (classic IMPALA): every actor holds a policy copy,
+    # runs jitted rollouts locally, and re-fetches weights on publish.
+    # "env_shim" (SEED-style): actors are thin env loops with NO policy
+    # — they ship per-step observations over KIND_OBS_REQ and an
+    # InferenceServer on the learner host batches act() across the
+    # whole fleet into one jitted dispatch per tick, assembling rollout
+    # segments server-side into the SAME trajectory path (the learner
+    # loop is unchanged; both modes can share one server). Distributed
+    # runner only; incompatible with recurrent=True (the LSTM carry
+    # would have to live server-side).
+    actor_mode: str = "fetch_params"
+    # Dynamic-batch knobs: a tick fires when this many requests are
+    # pending (0 = the fleet size, num_actors) or serve_max_wait_ms
+    # after the first pending arrival, whichever comes first.
+    serve_batch_max: int = 0
+    serve_max_wait_ms: float = 2.0
+    # Code the shim's observation requests with the PR-6 byte-plane
+    # core (per-leaf smaller-of selection: pixels compress, float
+    # CartPole obs ride plain). Costs one zlib pass inside the act
+    # round-trip, so it is opt-in for bandwidth-bound links.
+    serve_obs_codec: bool = False
+    # --- mid-rollout param fetch (classic actor mode) -----------------
+    # Fetch-params actors normally re-fetch weights only at rollout
+    # boundaries; with this knob the rollout runs as mid_rollout_chunks
+    # jitted chunks and the actor polls KIND_PARAMS_NOTIFY between
+    # them, switching weights MID-trajectory (V-trace's importance
+    # weights already correct per-step behaviour-policy drift — this
+    # trades another half-rollout of staleness for intra-rollout policy
+    # switching; measure with the param_staleness_steps metric).
+    mid_rollout_fetch: bool = False
+    mid_rollout_chunks: int = 2
     # --- hot standby (run_impala_standby) ----------------------------
     # Bind the takeover listener at standby START: actors that lose
     # the primary land here immediately (via the redirector's fallback
@@ -278,6 +313,11 @@ class ImpalaPrograms:
     copy_state: Any             # jitted FULL-state copy (sentinel snapshots)
     batch_time_axis: Any        # TIME_AXIS or None (the t-axis spec name)
     num_actions: Any = None     # discrete action count (validator bounds)
+    # Jitted batched ``act(params, obs, key) -> (actions, log_probs)``
+    # — the central-inference program the serving tier dispatches over
+    # the whole env-shim fleet's concatenated observations. None for
+    # recurrent policies (the carry would have to live server-side).
+    act: Any = None
 
     def __iter__(self):
         return iter(
@@ -475,6 +515,27 @@ def make_impala(cfg: ImpalaConfig):
         raise ValueError(
             f"correction must be 'vtrace' or 'none', got {cfg.correction!r}"
         )
+    if cfg.actor_mode not in ("fetch_params", "env_shim"):
+        raise ValueError(
+            f"actor_mode must be 'fetch_params' or 'env_shim', got "
+            f"{cfg.actor_mode!r}"
+        )
+    if cfg.actor_mode == "env_shim" and cfg.recurrent:
+        raise ValueError(
+            "actor_mode='env_shim' requires recurrent=False (the LSTM "
+            "carry would have to live on the inference server)"
+        )
+    if cfg.mid_rollout_fetch:
+        if cfg.mid_rollout_chunks < 2:
+            raise ValueError(
+                f"mid_rollout_chunks must be >= 2, got "
+                f"{cfg.mid_rollout_chunks}"
+            )
+        if cfg.rollout_length % cfg.mid_rollout_chunks:
+            raise ValueError(
+                f"rollout_length={cfg.rollout_length} not divisible by "
+                f"mid_rollout_chunks={cfg.mid_rollout_chunks}"
+            )
     if cfg.recurrent and cfg.time_shards > 1:
         raise ValueError(
             "recurrent IMPALA requires time_shards=1 (the LSTM replay "
@@ -563,6 +624,21 @@ def make_impala(cfg: ImpalaConfig):
         dist, value = dist_and_value(params, obs)
         action = dist.sample(key)
         return action, dist.log_prob(action), value
+
+    # Central-inference program (serving tier): one batched sample over
+    # the env-shim fleet's concatenated observations. Same policy head
+    # as the actor rollout, so env_shim and fetch_params fleets are
+    # behaviourally identical up to PRNG streams.
+    if cfg.recurrent:
+        act_program = None
+    else:
+
+        def central_act(params, obs, key):
+            dist, _ = dist_and_value(params, obs)
+            action = dist.sample(key)
+            return action, dist.log_prob(action)
+
+        act_program = jax.jit(central_act)
 
     def make_actor_programs(actor_id: int):
         """Jitted (rollout, reset) for ONE actor.
@@ -814,6 +890,7 @@ def make_impala(cfg: ImpalaConfig):
         copy_state=copy_tree,
         batch_time_axis=t_axis,
         num_actions=getattr(action_space, "n", None),
+        act=act_program,
     )
 
 
@@ -1341,6 +1418,12 @@ def run_impala(
         donation_supported,
     )
 
+    if cfg.actor_mode == "env_shim":
+        raise ValueError(
+            "actor_mode='env_shim' is the distributed serving topology "
+            "(run_impala_distributed / --actor-processes); in-process "
+            "actor threads already share the learner's device"
+        )
     programs = make_impala(cfg)
     init, learner_step, make_actor_programs, mesh = programs
     state = (
@@ -1498,6 +1581,38 @@ def run_impala(
 
 # ---- cross-process mode: actors over the socket transport (DCN leg) ----
 
+def _concat_time_chunks(parts) -> Tuple[ActorTrajectory, dict]:
+    """Stitch ``mid_rollout_chunks`` chunk rollouts into one wire
+    trajectory: time-major leaves concatenate on the rollout axis,
+    ``last_obs`` comes from the FINAL chunk (it is the bootstrap obs),
+    recurrent entry state from the FIRST (the segment's true entry).
+    Host-side numpy — the chunks are already fetched for the push, and
+    the result is byte-identical in layout to a single full-length
+    rollout, so the learner cannot tell the modes apart."""
+    trajs = [p[0] for p in parts]
+    eps = [p[1] for p in parts]
+    cat0 = lambda *xs: np.concatenate([np.asarray(x) for x in xs], axis=0)
+    to_np = lambda t: jax.tree_util.tree_map(np.asarray, t)
+    traj = ActorTrajectory(
+        obs=jax.tree_util.tree_map(cat0, *[t.obs for t in trajs]),
+        actions=cat0(*[t.actions for t in trajs]),
+        rewards=cat0(*[t.rewards for t in trajs]),
+        dones=cat0(*[t.dones for t in trajs]),
+        behaviour_log_probs=cat0(
+            *[t.behaviour_log_probs for t in trajs]
+        ),
+        last_obs=to_np(trajs[-1].last_obs),
+        entry_lstm=to_np(trajs[0].entry_lstm),
+        entry_prev_done=to_np(trajs[0].entry_prev_done),
+    )
+    ep = {
+        "actor_id": np.asarray(eps[0]["actor_id"]),
+        "episode_return": cat0(*[e["episode_return"] for e in eps]),
+        "done_episode": cat0(*[e["done_episode"] for e in eps]),
+    }
+    return traj, ep
+
+
 def _actor_process_main(
     cfg: ImpalaConfig, actor_id: int, host: str, port: int, seed: int,
     generation: int = 0,
@@ -1526,8 +1641,24 @@ def _actor_process_main(
     )
 
     # Single-CPU rollout process: never runs the (possibly
-    # time-sharded) learner, so both mesh knobs reset to 1.
-    acfg = dataclasses.replace(cfg, num_devices=1, time_shards=1)
+    # time-sharded) learner, so both mesh knobs reset to 1. With
+    # mid-rollout fetch, the rollout program is compiled at CHUNK
+    # length — the actor runs mid_rollout_chunks of them back to back,
+    # polling for publish notifies in the gaps, and concatenates the
+    # chunks into one wire trajectory (identical layout; the learner
+    # cannot tell).
+    n_chunks = cfg.mid_rollout_chunks if cfg.mid_rollout_fetch else 1
+    acfg = dataclasses.replace(
+        cfg,
+        num_devices=1,
+        time_shards=1,
+        rollout_length=cfg.rollout_length // n_chunks,
+        # The chunking is applied HERE (rollout_length above is already
+        # the chunk length); clear the knob so make_impala does not
+        # re-validate divisibility against the chunk length — e.g.
+        # rollout 8 / chunks 4 is valid, but 2 % 4 is not.
+        mid_rollout_fetch=False,
+    )
     init, _, make_actor_programs, _ = make_impala(acfg)
     rollout_fn, env_reset_fn = make_actor_programs(actor_id)
     params_def = jax.tree_util.tree_structure(
@@ -1582,10 +1713,30 @@ def _actor_process_main(
         key, k = jax.random.split(key)
         env_state, obs, carry = env_reset_fn(k)
         while True:
-            key, k = jax.random.split(key)
-            env_state, obs, carry, traj, ep = rollout_fn(
-                params, env_state, obs, carry, k
-            )
+            if n_chunks == 1:
+                key, k = jax.random.split(key)
+                env_state, obs, carry, traj, ep = rollout_fn(
+                    params, env_state, obs, carry, k
+                )
+            else:
+                # Mid-rollout fetch: the rollout runs as chunks with a
+                # notify poll in each gap, so a publish that lands
+                # mid-trajectory switches the behaviour policy NOW —
+                # half a rollout less staleness, at the cost of
+                # intra-trajectory policy switching (which V-trace's
+                # per-step importance weights already correct).
+                parts = []
+                for ci in range(n_chunks):
+                    if ci > 0:
+                        notified = client.poll_notified()
+                        if notified > 0 and notified != version:
+                            refetch()
+                    key, k = jax.random.split(key)
+                    env_state, obs, carry, traj_c, ep_c = rollout_fn(
+                        params, env_state, obs, carry, k
+                    )
+                    parts.append((traj_c, ep_c))
+                traj, ep = _concat_time_chunks(parts)
             # Push-based publish discovery: a KIND_PARAMS_NOTIFY that
             # landed during the rollout is in the socket buffer now —
             # fetch BEFORE pushing, so this push's ack round-trip (and
@@ -1845,6 +1996,67 @@ def run_impala_distributed(
             param_delta_ring=cfg.param_delta_ring,
             param_bf16=cfg.param_bf16_wire,
         )
+
+    # No actor threads here, but a multi-device CPU learner must still
+    # retire each collective-bearing dispatch before the next one
+    # (run_loop's serialize rule) — and the central act() program
+    # shares the same rule.
+    exec_lock = _cpu_mesh_exec_lock(mesh)
+
+    # Central-inference serving tier (SEED-style env_shim mode): the
+    # InferenceServer batches the shim fleet's per-step observation
+    # requests into one jitted act() per tick and writes completed
+    # rollout segments into the SAME on_trajectory path classic actors
+    # feed — validator, queue, and arena are reused unchanged.
+    serving = None
+    if cfg.actor_mode == "env_shim":
+        from actor_critic_algs_on_tensorflow_tpu.distributed.serving import (
+            InferenceServer,
+            request_specs_for,
+        )
+        from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+            ROLE_ACTOR,
+            PeerInfo,
+        )
+
+        if programs.act is None:
+            raise ValueError("actor_mode='env_shim' needs a non-recurrent "
+                             "policy (no central act program compiled)")
+        obs_treedef, request_specs = request_specs_for(
+            traj_shape.obs, cfg.envs_per_actor
+        )
+
+        def serve_sink(traj_leaves, ep_leaves, actor_id):
+            # Segments enter through the same admission path as a
+            # wire push: hello-grade provenance for the validator,
+            # bounded-queue backpressure for flow control.
+            return on_trajectory(
+                traj_leaves, ep_leaves,
+                PeerInfo(-1, actor_id, -1, ROLE_ACTOR),
+            )
+
+        serving = InferenceServer(
+            programs.act,
+            # ALWAYS a copy, never state.params itself: the donated
+            # learner_step recycles the state's buffers in place, and
+            # the serving tier would otherwise dispatch act() on
+            # deleted arrays in the window between the first step and
+            # the first publish (a permanent fleet deadlock when
+            # publish_interval > 1 — the learner waits for segments
+            # only a dead serving tier can produce).
+            programs.copy_params(state.params),
+            obs_treedef=obs_treedef,
+            request_specs=request_specs,
+            rollout_length=cfg.rollout_length,
+            batch_max=cfg.serve_batch_max or max(1, cfg.num_actors),
+            max_wait_s=cfg.serve_max_wait_ms / 1e3,
+            sink=serve_sink,
+            seed=cfg.seed + 20_017,
+            exec_lock=exec_lock,
+            max_decode_bytes=cfg.transport_max_frame_mb << 20,
+        )
+        server.set_inference_handler(serving.submit)
+
     server.publish(jax.tree_util.tree_leaves(jax.device_get(state.params)))
     if on_server_start is not None:
         # Listener bound, weights published: safe to point actors here.
@@ -1854,8 +2066,16 @@ def run_impala_distributed(
     connect_host = "127.0.0.1" if host in ("0.0.0.0", "") else host
 
     def spawn(i: int, generation: int):
+        if cfg.actor_mode == "env_shim":
+            from actor_critic_algs_on_tensorflow_tpu.distributed.serving import (
+                env_shim_actor_main,
+            )
+
+            target = env_shim_actor_main
+        else:
+            target = _actor_process_main
         p = ctx.Process(
-            target=_actor_process_main,
+            target=target,
             args=(
                 cfg, i, connect_host, server.port,
                 cfg.seed * 10_000 + generation * 1_000 + i,
@@ -1931,10 +2151,6 @@ def run_impala_distributed(
             )
             procs[idx] = spawn(idx, restarts)
 
-    # No actor threads here, but a multi-device CPU learner must still
-    # retire each collective-bearing step before the next dispatch
-    # (run_loop's serialize rule).
-    exec_lock = _cpu_mesh_exec_lock(mesh)
     donate = (
         cfg.donate_buffers and donation_supported() and exec_lock is None
     )
@@ -1952,11 +2168,40 @@ def run_impala_distributed(
     )
 
     def publish(params):
-        publisher.submit(
-            programs.copy_params(params) if donate else params
-        )
+        p = programs.copy_params(params) if donate else params
+        if serving is not None:
+            # Zero-staleness weight swap for central inference: the
+            # very next act() tick uses the new device params — no
+            # wire, no fetch; the remote KIND_PARAMS_NOTIFY broadcast
+            # (for any classic/standby peers) rides the publisher
+            # thread behind it.
+            serving.set_params(p)
+        publisher.submit(p)
 
     sentinel = _make_sentinel(cfg, programs, publish, exec_lock)
+
+    def extra_metrics():
+        # Transport liveness rides the same log stream as the learning
+        # metrics: disconnect/reconnect counts, per-actor liveness,
+        # byte/frame totals (LearnerServer.metrics()) — plus the
+        # serving tier's batch/latency counters in env_shim mode.
+        sm = server.metrics()
+        return {
+            "param_version": server.version,
+            "actor_restarts": restarts,
+            **sm,
+            # Staleness at fetch in LEARNER STEPS (versions are
+            # publishes, publish_interval steps apart): the
+            # mid-rollout-fetch A/B's measurable.
+            "param_staleness_steps": round(
+                sm["transport_param_staleness_mean"]
+                * cfg.publish_interval,
+                4,
+            ),
+            **publisher.metrics(),
+            **(serving.metrics() if serving is not None else {}),
+            **(validator.metrics() if validator is not None else {}),
+        }
 
     completed = False
     try:
@@ -1964,16 +2209,7 @@ def run_impala_distributed(
             cfg, state, learner_step, q,
             publish=publish,
             check_health=check_health,
-            # Transport liveness rides the same log stream as the
-            # learning metrics: disconnect/reconnect counts, per-actor
-            # liveness, byte/frame totals (LearnerServer.metrics()).
-            extra_metrics=lambda: {
-                "param_version": server.version,
-                "actor_restarts": restarts,
-                **server.metrics(),
-                **publisher.metrics(),
-                **(validator.metrics() if validator is not None else {}),
-            },
+            extra_metrics=extra_metrics,
             log_interval=log_interval,
             log_fn=log_fn,
             summary_writer=summary_writer,
@@ -1994,6 +2230,12 @@ def run_impala_distributed(
             publisher.close()
         except Exception:
             pass
+        if serving is not None:
+            # Stop the batching tick BEFORE the transport goodbye:
+            # in-flight requests are dropped (their shims read the
+            # KIND_CLOSE broadcast below and exit), and no tick can
+            # race the queue teardown.
+            serving.close()
         handed_off = 0
         preempted = stop_event is not None and stop_event.is_set()
         if preempted or not completed:

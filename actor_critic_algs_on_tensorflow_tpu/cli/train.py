@@ -452,8 +452,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "transport_retry_deadline_s= "
                         "transport_max_frame_mb=. Param-sync wire "
                         "codec: --set param_delta= param_delta_ring= "
-                        "param_bf16_wire= (bf16 is opt-in, actor "
-                        "fetches only)")
+                        "param_bf16_wire= (bf16 actor fetches only; "
+                        "default ON after the PR-7 A/B — see PERF.md). "
+                        "Central-inference serving tier (SEED-style): "
+                        "--set actor_mode=env_shim serve_batch_max= "
+                        "serve_max_wait_ms= serve_obs_codec= (actors "
+                        "become thin env shims; the learner batches "
+                        "act() across the fleet). Mid-rollout weight "
+                        "refresh for classic actors: --set "
+                        "mid_rollout_fetch=True mid_rollout_chunks= "
+                        "(watch param_staleness_steps)")
     return p
 
 

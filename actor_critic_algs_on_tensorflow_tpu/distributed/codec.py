@@ -19,13 +19,16 @@ them:
     interleaved ones. Lossless: decode is ``base XOR
     unshuffle(inflate(payload))`` — a pure permutation plus XOR,
     bit-exact by construction and by test.
-  - **bf16 wire cast (opt-in)**: float32 leaves ride as
-    round-to-nearest-even bfloat16 packed in uint16 — half the bytes
-    BEFORE the delta pass. Lossy (8 mantissa bits), so it is opt-in
-    for actor-side inference only: V-trace's importance weighting
-    already corrects behaviour-policy drift far larger than 2^-8
-    rounding. The learner's own params are never touched, and the
-    default stays full precision.
+  - **bf16 wire cast**: float32 leaves ride as round-to-nearest-even
+    bfloat16 packed in uint16 — half the bytes BEFORE the delta pass.
+    Lossy (8 mantissa bits), so it applies to actor-side inference
+    only: V-trace's importance weighting already corrects
+    behaviour-policy drift far larger than 2^-8 rounding. The
+    learner's own params are never touched, standbys/tailers always
+    receive full precision, and a PR-7 learning-curve A/B (CartPole +
+    SyntheticPixels, 3 seeds) put the rounding inside seed noise —
+    the trainer default is ON (`param_bf16_wire=False` restores the
+    bit-exact wire).
 
 Per-leaf framing: every encoded frame is ``[meta] + wire arrays``
 where ``meta`` is one int64 vector ``[codec_version, base_version,

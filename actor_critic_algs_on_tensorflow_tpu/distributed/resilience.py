@@ -270,6 +270,34 @@ class ResilientActorClient:
                 on_fault=pin_if_needed,
             )
 
+    def act_request(
+        self,
+        seq: int,
+        leaves: Sequence[np.ndarray],
+        *,
+        encoder=None,
+    ) -> List[np.ndarray]:
+        """Central-inference request with at-least-once delivery.
+
+        Safe to retry because ``seq`` is the server-side idempotency
+        key: a re-sent request for a step the serving tier already
+        acted on replays the CACHED actions — the env steps exactly
+        once per sequence number no matter how many times the wire
+        faults. With ``encoder`` (a ``codec.TrajEncoder``) the leaves
+        are encoded ONCE, up front; retries re-send identical coded
+        bytes (same contract as ``push_trajectory``). The leaves are
+        tiny (one step, not a rollout) so the re-push pin snapshot of
+        the trajectory path is unnecessary — the caller's buffers are
+        not reused until the actions come back."""
+        if encoder is not None:
+            coded = encoder.encode(leaves)
+            with self._lock:
+                return self._op(
+                    lambda c: c.act_request(seq, coded, coded=True)
+                )
+        with self._lock:
+            return self._op(lambda c: c.act_request(seq, leaves))
+
     def fetch_params(self) -> Tuple[int, List[np.ndarray]]:
         with self._lock:
             return self._op(lambda c: c.fetch_params())
@@ -475,6 +503,21 @@ class ChaosProxy:
     def live_links(self) -> int:
         with self._lock:
             return sum(1 for l in self._links if not l.closed)
+
+    def wait_links(self, n: int, timeout: float = 5.0) -> bool:
+        """Block until at least ``n`` links are live (or ``timeout``).
+
+        Links register on the accept thread, so a test (or a failover
+        drill) that injects a fault immediately after starting clients
+        can race the registration and miss every link — the PR-6 chaos
+        deflake. Polling here is the supported way to sequence "fleet
+        connected" before "inject"."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.live_links() >= n:
+                return True
+            time.sleep(0.01)
+        return self.live_links() >= n
 
     # -- plumbing -------------------------------------------------------
 

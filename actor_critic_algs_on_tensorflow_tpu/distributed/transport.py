@@ -98,6 +98,23 @@ KIND_TRAJ_CODED = 16     # actor -> learner: tag = n coded trajectory
 #                          leaves + trailing episode-info leaves (the
 #                          columnar per-leaf codec; decoded into arena
 #                          slots learner-side)
+# --- central-inference serving tier (distributed.serving) ------------
+KIND_OBS_REQ = 17        # env-shim actor -> learner: tag = per-step
+#                          sequence number (| OBS_REQ_CODED when the
+#                          arrays are a traj-codec coded frame), arrays
+#                          = [*obs leaves, reward, done, episode_return,
+#                          done_episode] for the step the actor just
+#                          observed — "act for me"
+KIND_ACT_RESP = 18       # learner -> env-shim actor: tag = the request
+#                          sequence number echoed back, arrays =
+#                          [actions] sampled by the batched central
+#                          act() program
+
+# KIND_OBS_REQ tag flag bit: the request's arrays are one coded
+# trajectory-codec frame ([meta] + wire leaves — the PR-6 byte-plane
+# core) instead of plain leaves. Rides the tag so plain and coded
+# requests share one kind; sequence numbers live in the low 62 bits.
+OBS_REQ_CODED = 1 << 62
 
 # KIND_HELLO role field values.
 ROLE_ACTOR = 0
@@ -109,6 +126,12 @@ ROLE_STANDBY = 1
 # that never announces (or never sends) coded frames interoperates
 # with a codec-enabled learner in the same fleet unchanged.
 CAP_TRAJ_CODED = 1
+# The peer is an env-shim actor that ships observations and expects
+# the central-inference tier to act for it (KIND_OBS_REQ/ACT_RESP).
+# Announced so the registry shows which connections belong to the
+# serving tier; the server accepts shim and classic actors on one
+# listener either way.
+CAP_INFERENCE = 2
 
 _HEADER = struct_lib.Struct(">4sBQI")
 _ARRAY_HEADER = struct_lib.Struct(">B")
@@ -413,6 +436,10 @@ class LearnerServer:
         log: Callable[[str], None] | None = None,
     ):
         self._sink = self._make_sink(on_trajectory)
+        # Central-inference handler (distributed.serving): when set,
+        # KIND_OBS_REQ frames are routed to it instead of being a
+        # protocol error. handler(peer, seq, arrays, coded, reply).
+        self._inference = None
         self._idle_timeout = idle_timeout_s
         # Param wire codec (distributed.codec): keep a small ring of
         # recent published versions' wire leaves and serve an XOR-delta
@@ -465,6 +492,19 @@ class LearnerServer:
         self._traj_coded_frames = 0
         self._traj_bytes_in = 0
         self._traj_coded_bytes_in = 0
+        # Serving-tier accounting: observation requests in, action
+        # replies out, and the request payload bytes (the serving
+        # analog of the trajectory-plane counters above).
+        self._obs_reqs = 0
+        self._obs_bytes_in = 0
+        self._act_resps = 0
+        # Param-staleness-at-fetch accounting (actors only, excluding
+        # the first fetch): how many publishes behind a fetching actor
+        # was when it asked. The mid-rollout-fetch A/B reads this as
+        # the ``param_staleness_steps`` metric (scaled by the
+        # trainer's publish_interval).
+        self._staleness_sum = 0
+        self._staleness_fetches = 0
         self._bytes_out = 0
         self._param_sends = 0
         self._param_delta_sends = 0
@@ -497,6 +537,18 @@ class LearnerServer:
         takes the stream over. One attribute store (GIL-atomic); frames
         in flight land on whichever sink they raced."""
         self._sink = self._make_sink(on_trajectory)
+
+    def set_inference_handler(self, handler) -> None:
+        """Install the central-inference request handler
+        (``distributed.serving.InferenceServer.submit``). Called as
+        ``handler(peer, seq, arrays, coded, reply)`` on the
+        connection's thread; ``reply(arrays)`` sends the
+        ``KIND_ACT_RESP`` for that request (from any thread — the
+        batching tick replies asynchronously) and returns False if the
+        connection is already gone. Without a handler, a
+        ``KIND_OBS_REQ`` is a protocol error (a shim actor pointed at
+        a non-serving learner fails loudly instead of hanging)."""
+        self._inference = handler
 
     @staticmethod
     def _crcs_of(arrays: Sequence[np.ndarray]) -> List[int]:
@@ -626,6 +678,21 @@ class LearnerServer:
                 "transport_traj_coded_mb_in": round(
                     self._traj_coded_bytes_in / 1e6, 6
                 ),
+                # Serving tier: observation requests in / action
+                # replies out (KIND_OBS_REQ / KIND_ACT_RESP).
+                "transport_obs_reqs": self._obs_reqs,
+                "transport_obs_mb_in": round(
+                    self._obs_bytes_in / 1e6, 6
+                ),
+                "transport_act_resps": self._act_resps,
+                # Mean publishes-behind at actor param fetches (first
+                # fetches excluded — "behind" is undefined before a
+                # version is held).
+                "transport_param_staleness_mean": round(
+                    self._staleness_sum
+                    / max(1, self._staleness_fetches),
+                    4,
+                ),
                 "transport_pings": self._pings,
                 "transport_hellos": self._hellos,
                 "transport_checksum_failures": self._checksum_failures,
@@ -718,6 +785,16 @@ class LearnerServer:
         ``KIND_PARAMS``. All payload CRCs are computed once per encode,
         never per peer."""
         encode_args = None
+        if c.role == ROLE_ACTOR and held_version > 0:
+            with self._reg_lock:
+                # Staleness at fetch (in publishes): the distance the
+                # actor fell behind before asking. Under notify-driven
+                # fetches this hovers near 1; the mid-rollout-fetch
+                # A/B moves it.
+                self._staleness_sum += max(
+                    0, self._version - held_version
+                )
+                self._staleness_fetches += 1
         with self._params_lock:
             version = self._version
             use16 = self._param_bf16 and c.role == ROLE_ACTOR
@@ -781,6 +858,20 @@ class LearnerServer:
             self._param_bytes_out += n
             if delta:
                 self._param_delta_sends += 1
+
+    def _reply_act(self, c: _Conn, seq: int, arrays) -> bool:
+        """Send one ``KIND_ACT_RESP`` on ``c`` (called by the serving
+        tier's batching tick, from its own thread). False when the
+        connection is already gone — the shim actor will retry the
+        request with the same sequence number and the serving tier's
+        idempotency guard replays the cached reply."""
+        try:
+            self._send(c, KIND_ACT_RESP, seq, arrays)
+        except (OSError, ValueError):
+            return False
+        with self._reg_lock:
+            self._act_resps += 1
+        return True
 
     def _retire(self, c: _Conn, reason: str) -> None:
         with self._reg_lock:
@@ -879,6 +970,36 @@ class LearnerServer:
                             c.rejected += 1
                             self._rejected += 1
                     self._send(c, KIND_ACK, self._version)
+                elif kind == KIND_OBS_REQ:
+                    handler = self._inference
+                    if handler is None:
+                        # A shim actor pointed at a learner that is
+                        # not serving inference: fail the connection
+                        # loudly (the actor's retries surface it in
+                        # its stderr) instead of letting it block on
+                        # a reply that will never come.
+                        raise ConnectionError(
+                            "KIND_OBS_REQ but central inference is "
+                            "not enabled on this learner "
+                            "(actor_mode mismatch?)"
+                        )
+                    coded = bool(tag & OBS_REQ_CODED)
+                    seq = int(tag & (OBS_REQ_CODED - 1))
+                    with self._reg_lock:
+                        self._obs_reqs += 1
+                        self._obs_bytes_in += nbytes
+                        peer = PeerInfo(
+                            c.cid, c.actor_id, c.generation, c.role
+                        )
+                    # Reply closure: the batching tick answers this
+                    # request asynchronously, on its own thread, via
+                    # the connection's send lock.
+                    handler(
+                        peer, seq, arrays, coded,
+                        lambda arrs, _c=c, _s=seq: self._reply_act(
+                            _c, _s, arrs
+                        ),
+                    )
                 elif kind == KIND_GET_PARAMS:
                     # tag = the version the client already holds (0 =
                     # none / legacy client): ring hit -> delta frame.
@@ -1242,6 +1363,37 @@ class ActorClient:
         if kind != KIND_ACK:
             raise ConnectionError(f"expected ACK, got kind {kind}")
         return tag
+
+    def act_request(
+        self,
+        seq: int,
+        arrays: Sequence[np.ndarray],
+        *,
+        coded: bool = False,
+    ) -> List[np.ndarray]:
+        """Central-inference request: ship this step's observation
+        leaves (``[*obs, reward, done, episode_return, done_episode]``,
+        or one traj-codec coded frame with ``coded``) and block for
+        the batched ``KIND_ACT_RESP``. ``seq`` is the actor's per-step
+        sequence number — the serving tier's idempotency key, so a
+        retry after a reconnect replays the cached actions instead of
+        double-stepping the server-side trajectory builder. Returns
+        the reply's arrays (``[actions]``)."""
+        if not 0 <= seq < OBS_REQ_CODED:
+            raise ValueError(f"act sequence number {seq} out of range")
+        tag = seq | (OBS_REQ_CODED if coded else 0)
+        self._send(KIND_OBS_REQ, tag, [np.asarray(a) for a in arrays])
+        kind, rtag, out = self._await_reply()
+        if kind != KIND_ACT_RESP:
+            raise ConnectionError(f"expected ACT_RESP, got kind {kind}")
+        if rtag != seq:
+            # A reply for some other step can only mean the stream
+            # desynced (it is strictly request/reply per connection):
+            # fail the connection, reconnect, re-ask with the same seq.
+            raise ConnectionError(
+                f"act reply for seq {rtag}, expected {seq}"
+            )
+        return out
 
     def fetch_params(self) -> Tuple[int, List[np.ndarray]]:
         """Fetch the newest published params, reporting the version

@@ -86,6 +86,78 @@ class Ewma:
         return self._acc / (1.0 - self.beta**self.n)
 
 
+def percentile(sorted_xs, q: float) -> float:
+    """Nearest-rank percentile of an ALREADY-SORTED sequence
+    (``q`` in [0, 100]); 0.0 for an empty one. Tiny and dependency-free
+    so hot paths (the serving tick) can afford it per call."""
+    n = len(sorted_xs)
+    if n == 0:
+        return 0.0
+    idx = min(n - 1, max(0, int(round(q / 100.0 * (n - 1)))))
+    return float(sorted_xs[idx])
+
+
+class LatencyStats:
+    """Bounded-reservoir latency recorder with p50/p99 summaries.
+
+    The shared helper behind every latency-shaped report in the repo
+    (serving-tier act latency, publish->visible notify latency, bench
+    legs): ``add_ms(x)`` records one sample, ``summary(prefix)``
+    returns ``{count, mean, p50, p99, max}`` in milliseconds. Keeps at
+    most ``capacity`` samples — once full, new samples overwrite
+    uniformly-random slots (reservoir sampling), so percentiles stay
+    representative of the whole run at O(1) memory. Thread-safe."""
+
+    def __init__(self, capacity: int = 4096, seed: int = 0):
+        if capacity <= 0:
+            raise ValueError(f"capacity must be positive, got {capacity}")
+        self._capacity = capacity
+        self._samples: list = []
+        self._lock = threading.Lock()
+        self._rng = np.random.RandomState(seed)
+        self.count = 0
+        self._sum = 0.0
+        self._max = 0.0
+
+    def reset(self) -> None:
+        """Drop all samples (e.g. a bench excluding its warmup)."""
+        with self._lock:
+            self._samples = []
+            self.count = 0
+            self._sum = 0.0
+            self._max = 0.0
+
+    def add_ms(self, ms: float) -> None:
+        ms = float(ms)
+        with self._lock:
+            self.count += 1
+            self._sum += ms
+            self._max = max(self._max, ms)
+            if len(self._samples) < self._capacity:
+                self._samples.append(ms)
+            else:
+                # Reservoir: keep each of the `count` samples with
+                # equal probability capacity/count.
+                j = int(self._rng.randint(0, self.count))
+                if j < self._capacity:
+                    self._samples[j] = ms
+
+    def add_s(self, seconds: float) -> None:
+        self.add_ms(seconds * 1e3)
+
+    def summary(self, prefix: str = "") -> Dict[str, float]:
+        with self._lock:
+            xs = sorted(self._samples)
+            count, total, mx = self.count, self._sum, self._max
+        return {
+            f"{prefix}count": count,
+            f"{prefix}mean_ms": round(total / count, 4) if count else 0.0,
+            f"{prefix}p50_ms": round(percentile(xs, 50), 4),
+            f"{prefix}p99_ms": round(percentile(xs, 99), 4),
+            f"{prefix}max_ms": round(mx, 4),
+        }
+
+
 def device_get_metrics(metrics: Mapping[str, jax.Array]) -> Dict[str, float]:
     """One host transfer for the whole metric dict."""
     flat = jax.device_get(dict(metrics))

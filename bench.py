@@ -48,6 +48,16 @@ off — inbound MB/s, bytes-per-frame reduction, per-frame encode/decode
 cost — plus a small end-to-end distributed run reporting learner stall
 share both ways. Merged under ``"traj_plane"``; same off-by-default
 contract (scripts/traj_bench.py owns the measurement helpers).
+
+Optional serving leg (``BENCH_SERVE=1``): a fifth subprocess runs the
+SEED-style central-inference tier — real LearnerServer +
+InferenceServer with the compiled act() program, env-shim client
+processes — at each ``BENCH_SERVE_FLEETS`` size and reports
+actions/sec plus client-observed and server-side act-latency p50/p99.
+Merged under ``"serve"``; same off-by-default contract
+(scripts/serve_bench.py owns the measurement helpers;
+``BENCH_SERVE_LIGHT=1`` switches to scripted in-process clients to
+isolate the serving path from client env CPU on small hosts).
 """
 
 from __future__ import annotations
@@ -271,6 +281,35 @@ def measure_traj() -> dict:
     return out
 
 
+def measure_serve() -> dict:
+    """Central-inference serving leg (scripts/serve_bench.py owns the
+    helpers): actions/sec vs fleet size plus client-observed and
+    server-side act-latency p50/p99, with real env-shim client
+    processes by default (``BENCH_SERVE_LIGHT=1`` switches to scripted
+    in-process clients — the serving path isolated from env CPU)."""
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts"),
+    )
+    import serve_bench as sb
+
+    fleets = tuple(
+        int(x)
+        for x in os.environ.get("BENCH_SERVE_FLEETS", "2,8").split(",")
+    )
+    light = bool(int(os.environ.get("BENCH_SERVE_LIGHT", 0)))
+    return sb.serve_leg(
+        fleets,
+        steps_per_actor=int(os.environ.get("BENCH_SERVE_STEPS", 200)),
+        envs_per_actor=int(os.environ.get("BENCH_SERVE_ENVS", 8)),
+        env=os.environ.get("BENCH_SERVE_ENV", "CartPole-v1"),
+        max_wait_ms=float(os.environ.get("BENCH_SERVE_WAIT_MS", 2.0)),
+        obs_codec=bool(int(os.environ.get("BENCH_SERVE_CODEC", 0))),
+        use_processes=not light,
+        real_env=not light,
+    )
+
+
 def _notify_latencies_ms(cpb, versions) -> list:
     """publish() -> fetch-complete latencies (ms); the harness itself
     lives in controlplane_bench (single source of truth)."""
@@ -303,6 +342,15 @@ def main() -> int:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         try:
             print(json.dumps(measure_traj()))
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+            return 1
+        return 0
+
+    if len(sys.argv) > 1 and sys.argv[1] == "--measure-serve":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        try:
+            print(json.dumps(measure_serve()))
         except Exception:
             traceback.print_exc(file=sys.stderr)
             return 1
@@ -458,6 +506,24 @@ def main() -> int:
             sys.stderr.write(
                 "[bench] traj plane leg failed\n"
                 + (tchild.stderr[-2000:] if tchild is not None else "")
+            )
+    if os.environ.get("BENCH_SERVE"):
+        schild = None
+        try:
+            schild = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--measure-serve"],
+                capture_output=True,
+                text=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                timeout=int(os.environ.get("BENCH_CHILD_TIMEOUT", 900)),
+            )
+            payload["serve"] = json.loads(
+                schild.stdout.strip().splitlines()[-1]
+            )
+        except Exception:
+            sys.stderr.write(
+                "[bench] serve leg failed\n"
+                + (schild.stderr[-2000:] if schild is not None else "")
             )
     print(json.dumps(payload))
     return 0

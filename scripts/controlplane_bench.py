@@ -446,13 +446,19 @@ def notify_leg(n_publishes: int = 50):
     wake; measured from the publish call to fetch-complete. The
     pre-notify world paid up to a full rollout+push round before the
     piggybacked ack even revealed the version."""
+    from actor_critic_algs_on_tensorflow_tpu.utils.metrics import (
+        LatencyStats,
+    )
+
     versions, _ = _converging_param_stream(8)
-    lat = _notify_latencies(versions, n_publishes)
-    lat_ms = np.asarray(sorted(lat)) * 1e3
+    stats = LatencyStats()
+    for s in _notify_latencies(versions, n_publishes):
+        stats.add_s(s)
+    m = stats.summary()
     print(
-        f"PARAM_NOTIFY publish->visible p50={np.percentile(lat_ms, 50):.2f}ms "
-        f"p95={np.percentile(lat_ms, 95):.2f}ms max={lat_ms.max():.2f}ms "
-        f"(notify wake + delta fetch, n={len(lat)})",
+        f"PARAM_NOTIFY publish->visible p50={m['p50_ms']:.2f}ms "
+        f"p99={m['p99_ms']:.2f}ms max={m['max_ms']:.2f}ms "
+        f"(notify wake + delta fetch, n={m['count']})",
         flush=True,
     )
 

@@ -1,0 +1,316 @@
+"""Serving-tier bench: central-inference actions/sec vs fleet size.
+
+Measures the SEED-style serving path in isolation — a real
+``LearnerServer`` + ``InferenceServer`` with the real compiled
+CartPole ``act()`` program, driven by shim clients running the real
+jitted env loop — with no learner loop competing for the device, so
+the numbers are the serving tier's own: how many env steps per second
+the batched central ``act()`` sustains at each fleet size, and the
+client-observed act round-trip p50/p99 (the latency an env step pays
+for not owning a policy).
+
+Clients are PROCESSES running the REAL env loop by default — the
+production topology, one shim per process. On small benchmark hosts
+the numbers then include client-side env CPU (which can dominate and
+even invert the fleet-size scaling when cores < fleet); two flags
+isolate pieces of the stack: ``real_env=False`` replaces the env with
+a scripted numpy payload (pure serving-path measurement), and
+``use_processes=False`` keeps clients as threads (fast to start, but
+CPython's GIL then adds scheduler latency to the client-observed
+round-trips — the server-side ``serve_act_*`` percentiles stay
+honest). The warmup/timed phases are coordinated with a barrier so
+every client pays its jit compiles (one act() bucket per power-of-two
+batch size) outside the timed window. ``bench.py --measure-serve``
+(``BENCH_SERVE=1``) runs this in a subprocess and merges the dict
+into the bench JSON line.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import numpy as np  # noqa: E402
+
+
+def _quiet(msg):  # server logs stay out of the measurement output
+    pass
+
+
+def _shim_worker(
+    actor_id: int,
+    host: str,
+    port: int,
+    env: str,
+    b: int,
+    steps: int,
+    warmup: int,
+    obs_codec: bool,
+    real_env: bool,
+    obs_specs,
+    barrier,
+    out_q,
+) -> None:
+    """One shim client driving the request/response protocol.
+
+    ``real_env=True`` runs the real jitted env loop (the full env-shim
+    actor, env stepping included — a per-HOST cost that saturates small
+    benchmark machines); ``real_env=False`` is the scripted client: the
+    observation payload is synthesized in numpy, so the measurement
+    isolates the SERVING tier (wire + batch coalescing + one dispatch
+    per tick + reply fan-out) from the actor hosts' env CPU. Runs
+    ``warmup`` steps, waits on the barrier twice around the timed
+    phase, and ships its per-step act latencies (ms) back via
+    ``out_q``.
+    """
+    from actor_critic_algs_on_tensorflow_tpu.distributed import codec
+    from actor_critic_algs_on_tensorflow_tpu.distributed.serving import (
+        N_STEP_LEAVES,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+        CAP_INFERENCE,
+        ROLE_ACTOR,
+        ActorClient,
+    )
+
+    try:
+        if real_env:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            from actor_critic_algs_on_tensorflow_tpu import (
+                envs as envs_lib,
+            )
+
+            venv, venv_params = envs_lib.make(env, num_envs=b)
+            reset_fn = jax.jit(venv.reset)
+            step_fn = jax.jit(venv.step)
+            key = jax.random.PRNGKey(actor_id)
+            key, k = jax.random.split(key)
+            env_state, obs = reset_fn(k, venv_params)
+            obs_leaves = [
+                np.asarray(x) for x in jax.tree_util.tree_leaves(obs)
+            ]
+        else:
+            obs_leaves = [
+                np.zeros(shape, np.dtype(dt)) for shape, dt in obs_specs
+            ]
+        client = ActorClient(
+            host, port, hello=(actor_id, 0, ROLE_ACTOR, CAP_INFERENCE)
+        )
+        enc = codec.TrajEncoder(obs_delta=False) if obs_codec else None
+        step_leaves = [np.zeros(b, np.float32)] * N_STEP_LEAVES
+        seq = 0
+        lat_ms = []
+
+        def one_step(record: bool):
+            nonlocal env_state, obs_leaves, step_leaves, seq, key
+            leaves = [*obs_leaves, *step_leaves]
+            t0 = time.perf_counter()
+            if enc is not None:
+                acts = client.act_request(
+                    seq, enc.encode(leaves), coded=True
+                )
+            else:
+                acts = client.act_request(seq, leaves)
+            if record:
+                lat_ms.append((time.perf_counter() - t0) * 1e3)
+            seq += 1
+            if real_env:
+                key, k = jax.random.split(key)
+                env_state_, obs_, r, d, info = step_fn(
+                    k, env_state, acts[0], venv_params
+                )
+                env_state = env_state_
+                obs_leaves = [
+                    np.asarray(x)
+                    for x in jax.tree_util.tree_leaves(obs_)
+                ]
+                step_leaves = [
+                    np.asarray(r, np.float32),
+                    np.asarray(d, np.float32),
+                    np.asarray(info["episode_return"], np.float32),
+                    np.asarray(info["done_episode"], np.float32),
+                ]
+            else:
+                # Scripted "env": next obs varies with the step so the
+                # payload is not constant; rewards/dones stay zero.
+                for leaf in obs_leaves:
+                    leaf.flat[0] = float(seq % 251)
+
+        if not real_env:
+            env_state = key = None  # unused; keep the nonlocal happy
+        for _ in range(warmup):
+            one_step(False)
+        barrier.wait()
+        for _ in range(steps):
+            one_step(True)
+        barrier.wait()
+        client.close()
+        out_q.put((actor_id, lat_ms))
+    except Exception as e:  # surfaced by the parent
+        try:
+            barrier.abort()
+        except Exception:
+            pass
+        out_q.put((actor_id, e))
+
+
+def serve_leg(
+    fleet_sizes=(2, 8),
+    *,
+    steps_per_actor: int = 200,
+    warmup_steps: int = 20,
+    envs_per_actor: int = 8,
+    env: str = "CartPole-v1",
+    max_wait_ms: float = 2.0,
+    obs_codec: bool = False,
+    use_processes: bool = True,
+    real_env: bool = True,
+) -> dict:
+    """One serving measurement per fleet size; returns the merged dict.
+
+    actions/sec counts TIMED env steps actually acted on (requests x
+    envs_per_actor / wall); the act p50/p99 are client-observed
+    round-trips pooled across the fleet.
+    """
+    import multiprocessing as mp
+
+    import jax
+
+    from actor_critic_algs_on_tensorflow_tpu.algos.impala import (
+        ImpalaConfig,
+        _derive_wire_plan,
+        make_impala,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.distributed.serving import (
+        InferenceServer,
+        request_specs_for,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+        LearnerServer,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.utils.metrics import (
+        LatencyStats,
+    )
+
+    cfg = ImpalaConfig(
+        env=env, envs_per_actor=envs_per_actor, num_devices=1
+    )
+    programs = make_impala(cfg)
+    params = programs.init(jax.random.PRNGKey(0)).params
+    traj_shape = _derive_wire_plan(programs, params)[3]
+    b = envs_per_actor
+    obs_treedef, request_specs = request_specs_for(traj_shape.obs, b)
+
+    ctx = mp.get_context("spawn")
+    out = {
+        "fleet_sizes": list(fleet_sizes),
+        "envs_per_actor": b,
+        "env": env,
+        "processes": bool(use_processes),
+        "real_env": bool(real_env),
+        "actions_per_sec": [],
+        "act_p50_ms": [],
+        "act_p99_ms": [],
+        "serve_p50_ms": [],   # server-side submit->reply (GIL-immune)
+        "serve_p99_ms": [],
+        "segments": [],
+        "batch_mean": [],
+    }
+    for n in fleet_sizes:
+        segments = [0]
+        server = LearnerServer(lambda t, e: True, log=_quiet)
+        serving = InferenceServer(
+            programs.act,
+            params,
+            obs_treedef=obs_treedef,
+            request_specs=request_specs,
+            rollout_length=cfg.rollout_length,
+            batch_max=n,
+            max_wait_s=max_wait_ms / 1e3,
+            sink=lambda tl, el, aid: segments.__setitem__(
+                0, segments[0] + 1
+            ),
+            seed=0,
+            log=_quiet,
+        )
+        server.set_inference_handler(serving.submit)
+        obs_specs = [
+            (shape, np.dtype(dt).str)
+            for shape, dt in request_specs[: obs_treedef.num_leaves]
+        ]
+        wargs = lambda i: (
+            i, "127.0.0.1", server.port, env, b,
+            steps_per_actor, warmup_steps, obs_codec, real_env,
+            obs_specs, barrier, out_q,
+        )
+        if use_processes:
+            barrier = ctx.Barrier(n + 1)
+            out_q = ctx.Queue()
+            workers = [
+                ctx.Process(
+                    target=_shim_worker, args=wargs(i), daemon=True
+                )
+                for i in range(n)
+            ]
+        else:
+            barrier = threading.Barrier(n + 1)
+            out_q = __import__("queue").Queue()
+            workers = [
+                threading.Thread(
+                    target=_shim_worker, args=wargs(i), daemon=True
+                )
+                for i in range(n)
+            ]
+        for w in workers:
+            w.start()
+        barrier.wait()  # all clients warmed (jit compiles paid)
+        serving.reset_act_latency()
+        t0 = time.perf_counter()
+        barrier.wait()  # all timed steps done
+        wall = time.perf_counter() - t0
+        lat = LatencyStats(capacity=n * steps_per_actor)
+        for _ in range(n):
+            aid, payload = out_q.get(timeout=60.0)
+            if isinstance(payload, Exception):
+                raise payload
+            for ms in payload:
+                lat.add_ms(ms)
+        for w in workers:
+            w.join(timeout=10.0)
+        sm = serving.metrics()
+        serving.close()
+        server.close()
+        summary = lat.summary()
+        aps = n * steps_per_actor * b / max(wall, 1e-9)
+        out["actions_per_sec"].append(round(aps, 1))
+        out["act_p50_ms"].append(summary["p50_ms"])
+        out["act_p99_ms"].append(summary["p99_ms"])
+        out["serve_p50_ms"].append(sm["serve_act_p50_ms"])
+        out["serve_p99_ms"].append(sm["serve_act_p99_ms"])
+        out["segments"].append(segments[0])
+        out["batch_mean"].append(sm["serve_batch_mean"])
+        print(
+            f"SERVE fleet={n} actions/sec={aps:.0f} "
+            f"act p50={summary['p50_ms']:.2f}ms "
+            f"p99={summary['p99_ms']:.2f}ms "
+            f"batch_mean={sm['serve_batch_mean']} "
+            f"segments={segments[0]}",
+            flush=True,
+        )
+    return out
+
+
+if __name__ == "__main__":
+    sizes = (
+        tuple(int(x) for x in sys.argv[1].split(","))
+        if len(sys.argv) > 1 else (2, 8)
+    )
+    serve_leg(sizes)

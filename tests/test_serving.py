@@ -1,0 +1,753 @@
+"""Central-inference serving tier (ISSUE 7): batched act() on the
+learner, env-shim actors, per-step sequence idempotency, CAP_INFERENCE
+hello negotiation, and the chaos path through a server restart behind
+the Redirector.
+
+The correctness spine: the serving-side ``_TrajBuilder`` must emit
+segments byte-compatible with what a classic fetch-params actor pushes
+(same leaf order, shapes, dtypes, and reward/step alignment), and the
+sequence guard must keep env steps exactly-once across reconnects —
+both pinned here against scripted request streams where every value
+encodes its step index.
+"""
+
+import queue as queue_lib
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from actor_critic_algs_on_tensorflow_tpu.algos import impala
+from actor_critic_algs_on_tensorflow_tpu.algos.impala import (
+    ActorTrajectory,
+    ImpalaConfig,
+    run_impala_distributed,
+)
+from actor_critic_algs_on_tensorflow_tpu.distributed.resilience import (
+    ResilientActorClient,
+    RetryPolicy,
+)
+from actor_critic_algs_on_tensorflow_tpu.distributed.serving import (
+    N_STEP_LEAVES,
+    InferenceServer,
+)
+from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+    CAP_INFERENCE,
+    CAP_TRAJ_CODED,
+    ROLE_ACTOR,
+    ActorClient,
+    LearnerServer,
+    PeerInfo,
+)
+from actor_critic_algs_on_tensorflow_tpu.utils.metrics import (
+    LatencyStats,
+    percentile,
+)
+from tests.helpers import time_limit
+
+B, D = 2, 3  # env rows per request / obs feature dim in the unit tests
+
+
+def _quiet_server(sink=None, **kw):
+    return LearnerServer(
+        sink if sink is not None else (lambda t, e: True),
+        log=lambda m: None,
+        **kw,
+    )
+
+
+def _fake_act(params, obs, key):
+    """Deterministic numpy act(): the action encodes the obs content,
+    so segment tests can assert action/step alignment end to end."""
+    obs = np.asarray(obs)
+    return (
+        obs[:, 0].astype(np.int32),
+        np.full(obs.shape[0], 0.25, np.float32),
+    )
+
+
+def _mk_serving(sink, *, T=3, batch_max=4, max_wait_s=0.05, act=_fake_act):
+    obs_treedef = jax.tree_util.tree_structure(np.zeros(1))
+    specs = [((B, D), np.dtype(np.float32))] + [
+        ((B,), np.dtype(np.float32))
+    ] * N_STEP_LEAVES
+    return InferenceServer(
+        act,
+        None,
+        obs_treedef=obs_treedef,
+        request_specs=specs,
+        rollout_length=T,
+        batch_max=batch_max,
+        max_wait_s=max_wait_s,
+        sink=sink,
+        seed=0,
+        log=lambda m: None,
+    )
+
+
+def _request_leaves(t: int):
+    """Scripted request for step ``t``: the obs value IS the step
+    index; reward/ep stats belong to the previous step (env
+    semantics), so they carry ``t - 1``."""
+    return [
+        np.full((B, D), float(t), np.float32),
+        np.full((B,), float(t - 1), np.float32),
+        np.zeros((B,), np.float32),
+        np.full((B,), float(t - 1), np.float32),
+        np.zeros((B,), np.float32),
+    ]
+
+
+def _drive(serving, peer, seq, *, timeout=5.0):
+    """Submit one scripted request and block for its (async) reply."""
+    box = []
+    done = threading.Event()
+
+    def reply(arrays):
+        box.append(arrays)
+        done.set()
+        return True
+
+    serving.submit(peer, seq, _request_leaves(seq), False, reply)
+    assert done.wait(timeout), f"no reply for seq {seq}"
+    return box[0]
+
+
+# ---------------------------------------------------------------------
+# LatencyStats (the shared p50/p99 helper).
+# ---------------------------------------------------------------------
+
+def test_latency_stats_percentiles():
+    assert percentile([], 50) == 0.0
+    assert percentile([3.0], 99) == 3.0
+    stats = LatencyStats()
+    for x in range(1, 101):
+        stats.add_ms(float(x))
+    m = stats.summary("act_")
+    assert m["act_count"] == 100
+    assert m["act_p50_ms"] == pytest.approx(50.0, abs=2.0)
+    assert m["act_p99_ms"] == pytest.approx(99.0, abs=2.0)
+    assert m["act_max_ms"] == 100.0
+    assert m["act_mean_ms"] == pytest.approx(50.5, abs=0.01)
+    stats.reset()
+    assert stats.summary()["count"] == 0
+    # Reservoir bound holds under overflow; percentiles stay sane.
+    small = LatencyStats(capacity=64)
+    for x in range(10_000):
+        small.add_ms(float(x % 100))
+    assert len(small._samples) == 64
+    assert 0.0 <= small.summary()["p50_ms"] <= 100.0
+
+
+# ---------------------------------------------------------------------
+# Builder semantics + sequence guard (direct submit, no sockets).
+# ---------------------------------------------------------------------
+
+def test_builder_segment_alignment_matches_classic_layout():
+    """The emitted segment must be byte-compatible with a classic
+    actor's push: obs[t] paired with the reward/done that arrived one
+    request LATER, bootstrap last_obs from the boundary request, and
+    the boundary request carried over as step 0 of the next segment."""
+    segs = []
+    serving = _mk_serving(
+        lambda tl, el, aid: segs.append((aid, tl, el)), T=3
+    )
+    try:
+        peer = PeerInfo(0, 7, 0, ROLE_ACTOR)
+        for t in range(7):  # two full segments: steps 0-2 and 3-5
+            out = _drive(serving, peer, t)
+            # _fake_act echoes obs[:, 0] as the action.
+            np.testing.assert_array_equal(
+                out[0], np.full((B,), t, np.int32)
+            )
+        assert len(segs) == 2
+        aid, traj_leaves, ep_leaves = segs[0]
+        assert aid == 7
+        # ActorTrajectory leaf order: obs, actions, rewards, dones,
+        # behaviour_log_probs, last_obs.
+        obs, actions, rewards, dones, logp, last_obs = traj_leaves
+        np.testing.assert_array_equal(
+            obs[:, 0, 0], np.asarray([0.0, 1.0, 2.0], np.float32)
+        )
+        np.testing.assert_array_equal(
+            actions[:, 0], np.asarray([0, 1, 2], np.int32)
+        )
+        # Reward for step t arrives with request t+1 and carries t.
+        np.testing.assert_array_equal(
+            rewards[:, 0], np.asarray([0.0, 1.0, 2.0], np.float32)
+        )
+        assert float(last_obs[0, 0]) == 3.0
+        np.testing.assert_array_equal(
+            logp, np.full((3, B), 0.25, np.float32)
+        )
+        # Episode-info leaves in tree order (sorted dict keys):
+        # actor_id, done_episode, episode_return.
+        assert ep_leaves[0].shape == () and int(ep_leaves[0]) == 7
+        np.testing.assert_array_equal(
+            ep_leaves[2][:, 0], np.asarray([0.0, 1.0, 2.0], np.float32)
+        )
+        # Second segment continues seamlessly from the boundary.
+        _, traj2, _ = segs[1]
+        np.testing.assert_array_equal(
+            traj2[0][:, 0, 0], np.asarray([3.0, 4.0, 5.0], np.float32)
+        )
+        assert float(traj2[5][0, 0]) == 6.0
+    finally:
+        serving.close()
+
+
+def test_seq_guard_replays_duplicates_without_double_stepping():
+    segs = []
+    serving = _mk_serving(
+        lambda tl, el, aid: segs.append(tl), T=3
+    )
+    try:
+        peer = PeerInfo(0, 1, 0, ROLE_ACTOR)
+        first = _drive(serving, peer, 0)
+        # A retry of the SAME seq (reconnect after a lost reply)
+        # replays the cached actions and never advances the builder.
+        replay = _drive(serving, peer, 0)
+        np.testing.assert_array_equal(first[0], replay[0])
+        for t in range(1, 4):
+            _drive(serving, peer, t)
+        m = serving.metrics()
+        assert m["serve_dup_replays"] == 1
+        assert m["serve_requests"] == 4  # the dup never re-queued
+        assert len(segs) == 1
+        # No duplicated step inside the emitted segment.
+        np.testing.assert_array_equal(
+            segs[0][0][:, 0, 0], np.asarray([0.0, 1.0, 2.0], np.float32)
+        )
+    finally:
+        serving.close()
+
+
+def test_seq_discontinuity_resets_builder():
+    segs = []
+    serving = _mk_serving(lambda tl, el, aid: segs.append(tl), T=3)
+    try:
+        peer = PeerInfo(0, 2, 0, ROLE_ACTOR)
+        for t in (0, 1):
+            _drive(serving, peer, t)
+        # Jump: a restarted server-side view / lost alignment. The
+        # partial segment must be dropped, not stitched across.
+        for t in (10, 11, 12, 13):
+            _drive(serving, peer, t)
+        m = serving.metrics()
+        assert m["serve_seq_resets"] == 1
+        assert len(segs) == 1
+        np.testing.assert_array_equal(
+            segs[0][0][:, 0, 0],
+            np.asarray([10.0, 11.0, 12.0], np.float32),
+        )
+        # A fresh GENERATION resets too (actor respawn restarts seqs).
+        peer2 = PeerInfo(0, 2, 1, ROLE_ACTOR)
+        _drive(serving, peer2, 0)
+        assert serving.metrics()["serve_lanes"] == 1
+    finally:
+        serving.close()
+
+
+def test_failed_tick_rewinds_lane_so_retry_recovers():
+    """An act() dispatch that throws must not wedge its lane: the
+    shim's retry (same seq) re-enters as a fresh request."""
+    calls = [0]
+
+    def flaky_act(params, obs, key):
+        calls[0] += 1
+        if calls[0] == 1:
+            raise RuntimeError("injected act failure")
+        return _fake_act(params, obs, key)
+
+    serving = _mk_serving(lambda tl, el, aid: None, act=flaky_act)
+    try:
+        peer = PeerInfo(0, 3, 0, ROLE_ACTOR)
+        box = []
+        serving.submit(
+            peer, 0, _request_leaves(0), False,
+            lambda a: box.append(a) or True,
+        )
+        deadline = time.monotonic() + 5
+        while calls[0] == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.1)
+        assert calls[0] == 1 and not box  # tick failed, no reply
+        out = _drive(serving, peer, 0)  # the retry path
+        np.testing.assert_array_equal(
+            out[0], np.full((B,), 0, np.int32)
+        )
+        assert serving.metrics()["serve_dup_replays"] == 0
+    finally:
+        serving.close()
+
+
+def test_rejects_wrong_shape_and_unknown_handler():
+    serving = _mk_serving(lambda tl, el, aid: None)
+    try:
+        peer = PeerInfo(0, 0, 0, ROLE_ACTOR)
+        with pytest.raises(ConnectionError, match="stale config"):
+            serving.submit(
+                peer, 0,
+                [np.zeros((B, D + 1), np.float32)]
+                + [np.zeros(B, np.float32)] * N_STEP_LEAVES,
+                False, lambda a: True,
+            )
+        with pytest.raises(ConnectionError, match="leaves"):
+            serving.submit(
+                peer, 0, [np.zeros((B, D), np.float32)], False,
+                lambda a: True,
+            )
+        assert serving.metrics()["serve_rejected"] == 2
+    finally:
+        serving.close()
+
+    # A shim pointed at a NON-serving learner fails loudly (protocol
+    # error kills the connection) instead of hanging forever.
+    server = _quiet_server()
+    try:
+        client = ActorClient(
+            "127.0.0.1", server.port,
+            hello=(0, 0, ROLE_ACTOR, CAP_INFERENCE),
+        )
+        with time_limit(10, "unserved act request"):
+            with pytest.raises(ConnectionError):
+                client.act_request(0, _request_leaves(0))
+        client.abort()
+    finally:
+        server.close()
+
+
+# ---------------------------------------------------------------------
+# Wire path: batching across connections, caps negotiation, chaos.
+# ---------------------------------------------------------------------
+
+def test_act_requests_batch_across_connections():
+    """Concurrent requests from separate connections coalesce into one
+    act() dispatch (the SEED batching claim, in miniature)."""
+    serving = _mk_serving(
+        lambda tl, el, aid: None, T=100, batch_max=4, max_wait_s=0.5
+    )
+    server = _quiet_server()
+    server.set_inference_handler(serving.submit)
+    try:
+        clients = [
+            ActorClient(
+                "127.0.0.1", server.port,
+                hello=(i, 0, ROLE_ACTOR, CAP_INFERENCE),
+            )
+            for i in range(4)
+        ]
+        outs = [None] * 4
+        with time_limit(20, "batched act"):
+            ts = []
+            for i, c in enumerate(clients):
+                t = threading.Thread(
+                    target=lambda i=i, c=c: outs.__setitem__(
+                        i, c.act_request(0, _request_leaves(5))
+                    )
+                )
+                t.start()
+                ts.append(t)
+            for t in ts:
+                t.join(timeout=15)
+        for out in outs:
+            np.testing.assert_array_equal(
+                out[0], np.full((B,), 5, np.int32)
+            )
+        m = serving.metrics()
+        assert m["serve_requests"] == 4
+        assert m["serve_batches"] == 1, m
+        assert m["serve_batch_mean"] == 4.0
+        sm = server.metrics()
+        assert sm["transport_obs_reqs"] == 4
+        assert sm["transport_act_resps"] == 4
+        for c in clients:
+            c.close()
+    finally:
+        serving.close()
+        server.close()
+
+
+def test_hello_caps_mixed_fleet_and_reconnect_reannounce():
+    """One server, three hello vintages: an env shim (CAP_INFERENCE),
+    a codec actor (CAP_TRAJ_CODED), and a legacy 3-field hello — all
+    registered with the right caps; a reconnect re-announces."""
+    got = []
+    serving = _mk_serving(lambda tl, el, aid: None, T=100)
+    server = _quiet_server(
+        sink=lambda t, e: got.append(len(t)) or True
+    )
+    server.set_inference_handler(serving.submit)
+    try:
+        shim = ActorClient(
+            "127.0.0.1", server.port,
+            hello=(0, 0, ROLE_ACTOR, CAP_INFERENCE),
+        )
+        coded = ActorClient(
+            "127.0.0.1", server.port,
+            hello=(1, 0, ROLE_ACTOR, CAP_TRAJ_CODED),
+        )
+        legacy = ActorClient(
+            "127.0.0.1", server.port, hello=(2, 0, ROLE_ACTOR),
+        )
+        shim.act_request(0, _request_leaves(0))
+        legacy.push_trajectory(
+            [np.zeros((4, B), np.float32)], [np.zeros(B, np.float32)]
+        )
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            conns = {c["actor_id"]: c for c in server.connections()}
+            if len(conns) == 3 and all(
+                c["actor_id"] >= 0 for c in conns.values()
+            ):
+                break
+            time.sleep(0.02)
+        assert conns[0]["caps"] == CAP_INFERENCE
+        assert conns[1]["caps"] == CAP_TRAJ_CODED
+        assert conns[2]["caps"] == 0  # legacy 3-field hello -> caps 0
+        assert got == [1]
+        # Reconnect re-announces: same identity, fresh connection.
+        shim.close()
+        shim2 = ActorClient(
+            "127.0.0.1", server.port,
+            hello=(0, 1, ROLE_ACTOR, CAP_INFERENCE),
+        )
+        shim2.act_request(1, _request_leaves(1))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            fresh = [
+                c for c in server.connections()
+                if c["actor_id"] == 0 and c["generation"] == 1
+            ]
+            if fresh:
+                break
+            time.sleep(0.02)
+        assert fresh and fresh[0]["caps"] == CAP_INFERENCE
+        shim2.close()
+        coded.close()
+        legacy.close()
+    finally:
+        serving.close()
+        server.close()
+
+
+@pytest.mark.chaos
+def test_shim_survives_server_restart_through_redirector():
+    """The acceptance chaos drill: an env-shim client streams steps
+    through the Redirector; the inference server dies hard and a
+    replacement comes up on a NEW port; the redirector re-points; the
+    shim reconnects and keeps stepping. Exactly-once is asserted the
+    strong way: every emitted segment's obs counters are strictly
+    consecutive — a duplicated env step would repeat a counter, a
+    stitch across the restart would skip inside a segment."""
+    from actor_critic_algs_on_tensorflow_tpu.distributed.controlplane import (
+        Redirector,
+    )
+
+    def mk_server(segs):
+        serving = _mk_serving(
+            lambda tl, el, aid: segs.append(tl), T=4
+        )
+        server = _quiet_server()
+        server.set_inference_handler(serving.submit)
+        return server, serving
+
+    segs_a, segs_b = [], []
+    server_a, serving_a = mk_server(segs_a)
+    redirector = Redirector(
+        "127.0.0.1", server_a.port, host="127.0.0.1", port=0
+    )
+    steps_done = [0]
+    stop = threading.Event()
+    errors = []
+
+    def shim():
+        client = ResilientActorClient(
+            "127.0.0.1", redirector.port,
+            retry=RetryPolicy(deadline_s=30.0),
+            heartbeat_interval_s=0.2,
+            idle_timeout_s=2.0,
+            hello=(0, 0, ROLE_ACTOR, CAP_INFERENCE),
+        )
+        try:
+            for t in range(40):
+                client.act_request(t, _request_leaves(t))
+                steps_done[0] = t + 1
+            stats = client.stats()
+            assert stats["reconnects"] >= 1, stats
+        except Exception as e:
+            errors.append(e)
+        finally:
+            stop.set()
+            client.close()
+
+    with time_limit(60, "shim restart chaos"):
+        t = threading.Thread(target=shim, daemon=True)
+        t.start()
+        while steps_done[0] < 10 and not stop.is_set():
+            time.sleep(0.01)
+        # Hard kill: no goodbye frame, mid-protocol.
+        server_a.close(graceful=False)
+        serving_a.close()
+        server_b, serving_b = mk_server(segs_b)
+        redirector.redirect("127.0.0.1", server_b.port)
+        t.join(timeout=45)
+        assert not t.is_alive()
+    try:
+        assert not errors, errors
+        assert steps_done[0] == 40
+        assert segs_a and segs_b, (len(segs_a), len(segs_b))
+        for segs in (segs_a, segs_b):
+            for traj_leaves in segs:
+                counters = traj_leaves[0][:, 0, 0]
+                assert np.all(np.diff(counters) == 1.0), counters
+        # The replacement server never saw seq 0: its lane starts at
+        # the reconnect seq, builder fresh (no stitched segment).
+        first_b = segs_b[0][0][0, 0, 0]
+        assert first_b >= 9.0, first_b
+    finally:
+        serving_b.close()
+        server_b.close()
+        redirector.close()
+
+
+# ---------------------------------------------------------------------
+# End-to-end: env_shim mode through the real runner.
+# ---------------------------------------------------------------------
+
+def _shim_cfg(**kw):
+    base = dict(
+        env="CartPole-v1",
+        num_actors=2,
+        envs_per_actor=4,
+        rollout_length=16,
+        batch_trajectories=2,
+        total_env_steps=4 * 16 * 2 * 4,  # 4 learner steps
+        queue_size=8,
+        num_devices=1,
+        seed=3,
+        actor_mode="env_shim",
+    )
+    base.update(kw)
+    return ImpalaConfig(**base)
+
+
+def test_run_impala_distributed_env_shim_end_to_end():
+    """Env-shim actors drive CartPole through central inference; the
+    learner trains on server-assembled segments, loss finite, serving
+    metrics in the log stream."""
+    state, history = run_impala_distributed(_shim_cfg(), log_interval=2)
+    assert int(state.step) == 4
+    last = history[-1][1]
+    assert np.isfinite(last["loss"])
+    assert last["serve_segments"] >= 8  # 2 batches x 2 trajectories + lead
+    assert last["serve_requests"] > last["serve_segments"]
+    assert last["transport_obs_reqs"] == last["serve_requests"]
+    assert last["serve_rejected"] == 0
+    assert last["serve_param_swaps"] >= 2
+    assert last["serve_act_p50_ms"] > 0
+
+
+def test_env_shim_coded_obs_requests_end_to_end():
+    """serve_obs_codec: pixel observations ride the byte-plane codec
+    inside KIND_OBS_REQ; decode lands in the same request path."""
+    cfg = _shim_cfg(
+        env="SyntheticPixelsSmall-v0",
+        num_actors=2,
+        envs_per_actor=2,
+        rollout_length=8,
+        batch_trajectories=2,
+        total_env_steps=2 * 8 * 2 * 3,
+        seed=7,
+        serve_obs_codec=True,
+        # Regression guard: with donation on, the serving tier must
+        # hold a COPY of the initial params — publish_interval > 1
+        # widens the window where acting on the donated (deleted)
+        # state buffers would deadlock the fleet.
+        publish_interval=3,
+    )
+    state, history = run_impala_distributed(cfg, log_interval=2)
+    assert int(state.step) == 3
+    last = history[-1][1]
+    assert np.isfinite(last["loss"])
+    assert last["serve_segments"] >= 6
+    assert last["serve_rejected"] == 0
+    # Coded requests must arrive SMALLER than the raw pixel payload
+    # (SyntheticPixelsSmall obs = 576-byte flattened uint8 raster per
+    # env; the 4 step leaves add 4 x 4 bytes per env).
+    raw_request_mb = last["transport_obs_reqs"] * 2 * (576 + 16) / 1e6
+    assert last["transport_obs_mb_in"] < 0.75 * raw_request_mb
+
+
+@pytest.mark.slow
+def test_env_shim_learns_cartpole():
+    """Learning parity gate for the serving tier: central inference
+    with server-assembled segments must LEARN, not just run — greedy
+    eval after a modest budget clears the same bar the classic
+    fetch-params mode does at this scale (the full A/B curves are in
+    PERF.md's PR-7 ledger)."""
+    from tests.helpers import greedy_cartpole_return
+
+    cfg = _shim_cfg(
+        num_actors=2,
+        envs_per_actor=8,
+        rollout_length=16,
+        batch_trajectories=4,
+        total_env_steps=200_000,
+        queue_size=16,
+        lr=1e-3,
+        seed=0,
+    )
+    state, history = run_impala_distributed(cfg, log_interval=50)
+    mean_ret, frac_done = greedy_cartpole_return(state.params)
+    assert frac_done == 1.0
+    assert mean_ret >= 120.0, mean_ret
+
+
+# ---------------------------------------------------------------------
+# Mid-rollout fetch satellite.
+# ---------------------------------------------------------------------
+
+def test_concat_time_chunks_layout():
+    def chunk(t0, T=4, B_=3):
+        r = np.arange(t0, t0 + T, dtype=np.float32)
+        tb = np.tile(r[:, None], (1, B_))
+        return (
+            ActorTrajectory(
+                obs=tb[..., None].repeat(2, axis=-1),
+                actions=tb.astype(np.int32),
+                rewards=tb,
+                dones=np.zeros_like(tb),
+                behaviour_log_probs=tb,
+                last_obs=np.full((B_, 2), float(t0 + T), np.float32),
+            ),
+            {
+                "actor_id": np.int32(5),
+                "episode_return": tb,
+                "done_episode": np.zeros_like(tb),
+            },
+        )
+
+    traj, ep = impala._concat_time_chunks([chunk(0), chunk(4)])
+    assert traj.obs.shape == (8, 3, 2)
+    np.testing.assert_array_equal(
+        traj.rewards[:, 0], np.arange(8, dtype=np.float32)
+    )
+    np.testing.assert_array_equal(
+        traj.last_obs, np.full((3, 2), 8.0, np.float32)
+    )
+    np.testing.assert_array_equal(
+        ep["episode_return"][:, 1], np.arange(8, dtype=np.float32)
+    )
+    assert int(ep["actor_id"]) == 5
+
+
+def test_mid_rollout_fetch_end_to_end():
+    cfg = ImpalaConfig(
+        env="CartPole-v1",
+        num_actors=2,
+        envs_per_actor=4,
+        rollout_length=16,
+        batch_trajectories=2,
+        total_env_steps=4 * 16 * 2 * 4,
+        queue_size=8,
+        num_devices=1,
+        seed=5,
+        mid_rollout_fetch=True,
+        # 8 chunks of length 2: ALSO a regression guard — the actor
+        # process derives its programs from a chunk-length config, and
+        # an earlier draft left mid_rollout_fetch set there, so
+        # make_impala re-validated 2 % 8 and killed every actor.
+        mid_rollout_chunks=8,
+    )
+    state, history = run_impala_distributed(cfg, log_interval=2)
+    assert int(state.step) == 4
+    last = history[-1][1]
+    assert np.isfinite(last["loss"])
+    # The staleness metric is present and sane (mean publishes-behind
+    # at fetch, scaled to learner steps).
+    assert "param_staleness_steps" in last
+    assert last["param_staleness_steps"] >= 0
+
+
+def test_mid_rollout_chunks_validation():
+    with pytest.raises(ValueError, match="divisible"):
+        impala.make_impala(
+            ImpalaConfig(
+                rollout_length=16, mid_rollout_fetch=True,
+                mid_rollout_chunks=3,
+            )
+        )
+    with pytest.raises(ValueError, match="mid_rollout_chunks"):
+        impala.make_impala(
+            ImpalaConfig(mid_rollout_fetch=True, mid_rollout_chunks=1)
+        )
+
+
+# ---------------------------------------------------------------------
+# Config plumbing + bench smoke.
+# ---------------------------------------------------------------------
+
+def test_actor_mode_validation():
+    with pytest.raises(ValueError, match="actor_mode"):
+        impala.make_impala(ImpalaConfig(actor_mode="nope"))
+    with pytest.raises(ValueError, match="recurrent"):
+        impala.make_impala(
+            ImpalaConfig(actor_mode="env_shim", recurrent=True)
+        )
+    with pytest.raises(ValueError, match="distributed"):
+        impala.run_impala(ImpalaConfig(actor_mode="env_shim"))
+
+
+def test_cli_set_coerces_serving_knobs():
+    from actor_critic_algs_on_tensorflow_tpu.cli.train import (
+        apply_overrides,
+    )
+
+    cfg = apply_overrides(
+        ImpalaConfig(),
+        [
+            "actor_mode=env_shim",
+            "serve_batch_max=16",
+            "serve_max_wait_ms=0.5",
+            "serve_obs_codec=True",
+            "mid_rollout_fetch=True",
+        ],
+    )
+    assert cfg.actor_mode == "env_shim"
+    assert cfg.serve_batch_max == 16
+    assert cfg.serve_max_wait_ms == 0.5
+    assert cfg.serve_obs_codec is True
+    assert cfg.mid_rollout_fetch is True
+
+
+def test_serve_bench_smoke():
+    """Tier-1 smoke of the BENCH_SERVE leg: in-process scripted
+    clients, two fleet sizes, sane outputs."""
+    import sys as _sys
+    from pathlib import Path
+
+    _sys.path.insert(
+        0, str(Path(__file__).resolve().parents[1] / "scripts")
+    )
+    import serve_bench
+
+    out = serve_bench.serve_leg(
+        (1, 2),
+        steps_per_actor=30,
+        warmup_steps=5,
+        envs_per_actor=4,
+        use_processes=False,
+        real_env=False,
+    )
+    assert out["fleet_sizes"] == [1, 2]
+    assert len(out["actions_per_sec"]) == 2
+    assert all(a > 0 for a in out["actions_per_sec"])
+    assert all(p >= 0 for p in out["act_p50_ms"])
+    assert all(
+        p99 >= p50
+        for p50, p99 in zip(out["act_p50_ms"], out["act_p99_ms"])
+    )
+    assert all(s > 0 for s in out["segments"])
