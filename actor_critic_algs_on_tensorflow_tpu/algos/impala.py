@@ -55,6 +55,7 @@ from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
     DATA_AXIS,
     device_count,
     make_mesh,
+    put_replicated_tree,
     shard_map,
 )
 from actor_critic_algs_on_tensorflow_tpu.utils import health as health_lib
@@ -238,6 +239,32 @@ class ImpalaConfig:
     # FRESHER weights than the last checkpoint (training state still
     # resumes from the checkpoint — optimizer state is not published).
     standby_tail_params: bool = True
+    # --- sharded learner (distributed.sharding) -----------------------
+    # Data-parallel learner sharding: run shard_count independent
+    # ingest stacks (each its own LearnerServer + TrajectoryQueue +
+    # HostArena/LearnerPipeline, each ingesting a DISJOINT slice of
+    # the actor fleet and serving delta publishes to only that slice),
+    # all feeding the one shard_map-over-the-mesh learner_step whose
+    # gradients pmean over the data axis (params replicated, batch
+    # sharded). 1 = the classic single-stack topology. In-process
+    # shape: shard_count stacks in this process over device slices of
+    # the mesh (run_impala_distributed auto-builds the plan). Per-host
+    # shape: one shard per learner host via --shard K/N@HOST:PORT
+    # (jax.distributed + the per-step barrier below). Requires
+    # pipeline=True, time_shards=1, actor_mode="fetch_params", and
+    # batch_trajectories/num_actors/devices divisible by shard_count.
+    shard_count: int = 1
+    # Per-step lockstep barrier for PER-HOST shards, grown out of the
+    # STEP_REPORT/STOP_STEP preemption consensus: every host announces
+    # ready-to-dispatch between collecting its batch and entering the
+    # cross-host collective, so a wedged/dead host surfaces as a loud
+    # ShardDesync within shard_barrier_timeout_s instead of an
+    # unbounded hang inside the collective — and a preempting host
+    # pulls the whole fleet into the coordinated-stop consensus. The
+    # in-process shape needs no socket barrier (its analog is the
+    # stitch join, surfaced as pipeline_barrier_wait_s).
+    shard_step_barrier: bool = True
+    shard_barrier_timeout_s: float = 60.0
     compute_dtype: str = "float32"  # "bfloat16" runs the torso on the MXU in bf16
     use_pallas_scan: bool = False   # fused Pallas VMEM kernel for V-trace
     # Recurrent (LSTM) policy — the IMPALA-paper model family. Actors
@@ -726,7 +753,11 @@ def make_impala(cfg: ImpalaConfig):
             opt_state=tx.init(params),
             step=jnp.zeros((), jnp.int32),
         )
-        return jax.device_put(state, NamedSharding(mesh, P()))
+        # Multi-host aware placement: on a mesh that spans processes
+        # (per-host learner shards) every host contributes its own
+        # replica — same seed, same config, same values — instead of
+        # device_put addressing non-addressable devices.
+        return put_replicated_tree(state, mesh)
 
     mesh_axes = (
         (DATA_AXIS, TIME_AXIS) if cfg.time_shards > 1 else (DATA_AXIS,)
@@ -998,6 +1029,8 @@ def _learner_loop(
     coordinator=None,
     catchup_deadline_s: float = 15.0,
     corrupt_batch=None,
+    ingest=None,
+    step_barrier=None,
 ) -> Tuple[LearnerState, List[Tuple[int, Dict[str, float]]]]:
     """Shared learner loop of the in-process and cross-process modes.
 
@@ -1032,6 +1065,17 @@ def _learner_loop(
     output; proven by test). Either way the per-window time split is
     surfaced as ``pipeline_*`` metrics next to the queue/transport
     counters.
+
+    Sharded learner hooks (``distributed.sharding``): ``ingest`` is a
+    pre-built batch source with the pipeline's consumer interface
+    (the in-process shard stitcher, or a per-host pipeline with the
+    process-local transfer) — when given, the loop builds no pipe of
+    its own. ``step_barrier(it, stop_evt) -> "ok" | "stop"`` is the
+    per-host lockstep gate, called between collecting a batch and
+    dispatching the cross-host collective; ``"stop"`` means a
+    preemption is under way somewhere in the fleet and this host must
+    join the stop-step consensus instead of dispatching (the wait is
+    accounted as ``pipeline_barrier_wait_s``).
     """
     from actor_critic_algs_on_tensorflow_tpu.data.pipeline import (
         LearnerPipeline,
@@ -1100,8 +1144,8 @@ def _learner_loop(
             return None
         return tree
 
-    pipe = None
-    if cfg.pipeline:
+    pipe = ingest
+    if pipe is None and cfg.pipeline:
 
         def poll(n):
             check_health(it_box[0])
@@ -1149,7 +1193,22 @@ def _learner_loop(
             return make_batch
         return lambda: corrupt_batch(it, make_batch())
 
-    def collect_and_step(state, stop_evt, it, *, q_timeout=1.0):
+    def hold_lockstep(it, stop_evt) -> bool:
+        """Per-host shard barrier between batch collection and the
+        collective dispatch: every host announces ready-to-dispatch
+        and waits for the release, so nobody enters a collective a
+        wedged peer can never join (ShardDesync raises out instead).
+        False = a preemption is under way fleet-wide — the caller
+        returns None and the loop joins the stop-step consensus."""
+        if step_barrier is None:
+            return True
+        tb = time.perf_counter()
+        outcome = step_barrier(it, stop_evt)
+        split.add("barrier_wait_s", time.perf_counter() - tb)
+        return outcome != "stop"
+
+    def collect_and_step(state, stop_evt, it, *, q_timeout=1.0,
+                         lockstep=True):
         """Collect one batch (pipelined or serial queue drain) and
         dispatch the learner step — the ONLY batch-collect machinery;
         the preemption catch-up reuses it so the two paths cannot
@@ -1157,12 +1216,18 @@ def _learner_loop(
         ``stop_evt`` fired before a full batch arrived. (During
         catch-up ``check_health`` is a no-op — stop_event is set — and
         the poison hook simply keeps firing on the catch-up iteration
-        ids, consistent with guards staying armed.)"""
+        ids, consistent with guards staying armed. ``lockstep=False``
+        skips the shard barrier there too: in lockstep topologies the
+        agreed stop step equals every host's local step, so catch-up
+        trains no steps — and the barrier peers are already inside the
+        consensus exchange.)"""
         if pipe is not None:
             got = pipe.get(stop=stop_evt)
             if got is None:
                 return None
             batch, eps, handle = got
+            if lockstep and not hold_lockstep(it, stop_evt):
+                return None
             state, metrics = dispatch_step(state, poison(it, lambda: batch))
             pipe.mark_consumed(handle, metrics)
             del batch  # donated or pipeline-owned; never reused here
@@ -1186,6 +1251,8 @@ def _learner_loop(
             trajs.append(traj)
             eps.append(ep)
         split.add("queue_wait_s", time.perf_counter() - tq0)
+        if lockstep and not hold_lockstep(it, stop_evt):
+            return None
         state, metrics = dispatch_step(
             state, poison(it, lambda: stack_trajectories(trajs))
         )
@@ -1269,13 +1336,13 @@ def _learner_loop(
                     # (assemble + transfer) hidden under compute this
                     # window. stall = learner blocked waiting for a
                     # staged batch (ingest NOT hidden, or actors slow).
-                    ingest = pm.get("pipeline_assemble_s", 0.0) + pm.get(
-                        "pipeline_transfer_s", 0.0
-                    )
+                    ingest_s = pm.get(
+                        "pipeline_assemble_s", 0.0
+                    ) + pm.get("pipeline_transfer_s", 0.0)
                     stall = pm.get("pipeline_stall_s", 0.0)
-                    if ingest > 0:
+                    if ingest_s > 0:
                         pm["pipeline_overlap_frac"] = round(
-                            max(0.0, 1.0 - stall / ingest), 4
+                            max(0.0, 1.0 - stall / ingest_s), 4
                         )
                     m.update(pm)
                 if sentinel is not None:
@@ -1326,7 +1393,8 @@ def _learner_loop(
                     and not give_up.is_set()
                 ):
                     got = collect_and_step(
-                        state, give_up, cu_it, q_timeout=0.25
+                        state, give_up, cu_it, q_timeout=0.25,
+                        lockstep=False,
                     )
                     if got is None:
                         break
@@ -1423,6 +1491,12 @@ def run_impala(
             "actor_mode='env_shim' is the distributed serving topology "
             "(run_impala_distributed / --actor-processes); in-process "
             "actor threads already share the learner's device"
+        )
+    if cfg.shard_count > 1:
+        raise ValueError(
+            "shard_count > 1 is the sharded-learner topology "
+            "(run_impala_distributed / --actor-processes); in-process "
+            "actor threads already feed one learner stack"
         )
     programs = make_impala(cfg)
     init, learner_step, make_actor_programs, mesh = programs
@@ -1837,12 +1911,28 @@ def run_impala_distributed(
     coordinator=None,
     wire_plan=None,
     server=None,
+    shard=None,
 ) -> Tuple[LearnerState, List[Tuple[int, Dict[str, float]]]]:
     """IMPALA with actors in separate PROCESSES streaming trajectories
     through ``distributed.transport`` — the same topology that spans
     hosts over DCN (actors on actor hosts, learner on the TPU slice).
     ``host``/``port`` bind the learner's listener (port 0 = ephemeral;
     bind a routable address to accept actors from other hosts).
+
+    Sharded learner (``shard`` = ``distributed.sharding.ShardPlan``,
+    auto-built from ``cfg.shard_count > 1``): the learner plane runs
+    data-parallel as N ingest shards — each shard its own
+    ``LearnerServer`` + ``TrajectoryQueue`` + arena/pipeline, each
+    ingesting a DISJOINT slice of the actor fleet and serving (delta)
+    param publishes to only that slice — all feeding the one
+    global-mesh ``learner_step`` (params replicated, batch sharded,
+    gradients pmean'd). In-process shape (``shard_id=None``): every
+    stack lives here, bound to a device slice, stitched by
+    ``ShardedIngest``. Per-host shape (``shard_id=k`` under
+    ``jax.distributed``): this host runs stack ``k`` only, wraps its
+    local slice with ``make_array_from_process_local_data``, holds
+    lockstep through ``coordinator.step_barrier`` (required), and
+    checkpoints are owned by shard 0 (``ShardCheckpointer``).
 
     The learner-side ``TrajectoryQueue`` (bounded, watchdogged) sits
     between the server threads and the learner loop, so backpressure
@@ -1873,24 +1963,71 @@ def run_impala_distributed(
 
     from actor_critic_algs_on_tensorflow_tpu.data.pipeline import (
         AsyncParamPublisher,
+        LearnerPipeline,
     )
     from actor_critic_algs_on_tensorflow_tpu.distributed import (
         codec as codec_lib,
     )
+    from actor_critic_algs_on_tensorflow_tpu.distributed import (
+        sharding as sharding_lib,
+    )
     from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
         LearnerServer,
     )
+    from actor_critic_algs_on_tensorflow_tpu.parallel import multihost
     from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
         donation_supported,
+        spans_processes,
     )
+
+    if shard is None and cfg.shard_count > 1:
+        shard = sharding_lib.ShardPlan(cfg.shard_count)
+    if shard is not None and shard.shard_count <= 1:
+        shard = None
+    if shard is not None:
+        if not cfg.pipeline:
+            raise ValueError(
+                "sharded learner requires cfg.pipeline=True (the "
+                "per-shard arenas ARE the ingest path)"
+            )
+        if cfg.actor_mode != "fetch_params":
+            raise ValueError(
+                "sharded learner supports actor_mode='fetch_params' "
+                "only (the central-inference tier is single-stack)"
+            )
+        if cfg.time_shards > 1:
+            raise ValueError(
+                "sharded learner requires time_shards=1 (the batch "
+                "slices split the data axis only)"
+            )
+        if server is not None or external_actors:
+            raise ValueError(
+                "sharded learner is incompatible with the standby "
+                "takeover hooks (server=/external_actors)"
+            )
+        # Fail loudly on bad topology before anything binds.
+        shard.local_parts(cfg.batch_trajectories)
+        shard.actor_slice(cfg.num_actors, 0)
 
     if programs is None:
         programs = make_impala(cfg)
     init, learner_step, make_actor_programs, mesh = programs
+    if shard is not None and shard.shard_id is None:
+        shard.device_slice(mesh, 0)  # validate device divisibility
     state = (
         initial_state if initial_state is not None
         else init(jax.random.PRNGKey(cfg.seed))
     )
+    if (
+        initial_state is not None
+        and shard is not None
+        and shard.multihost
+        and spans_processes(mesh)
+    ):
+        # A restored state arrives as plain single-device arrays; the
+        # global-mesh step needs it replicated across hosts (every
+        # shard restored the same checkpoint — shard 0 wrote it).
+        state = put_replicated_tree(jax.device_get(state), mesh)
 
     # Treedefs for rebuilding pytrees from wire leaves + the host-arena
     # ingest plan (preallocated per-leaf buffers, sharded device_put by
@@ -1908,7 +2045,15 @@ def run_impala_distributed(
         for x in jax.tree_util.tree_leaves(traj_shape)
     ]
 
-    q = TrajectoryQueue(cfg.queue_size)
+    # One trajectory queue per ingest shard (one total, unsharded):
+    # each shard's server threads feed only their own queue, so
+    # backpressure and starvation detection stay per-slice.
+    n_stacks = len(shard.local_shards()) if shard is not None else 1
+    queues = [TrajectoryQueue(cfg.queue_size) for _ in range(n_stacks)]
+    q = (
+        queues[0] if n_stacks == 1
+        else sharding_lib.QueueGroup(queues)
+    )
     closing = threading.Event()
 
     # Pre-arena quarantine: wire trajectories are numpy leaves already
@@ -1921,7 +2066,12 @@ def run_impala_distributed(
     if cfg.validate_trajectories:
         validator = _make_validator(cfg, programs)
 
-    def on_trajectory(traj_leaves, ep_leaves, peer):
+    def make_on_trajectory(q_k):
+        return lambda traj_leaves, ep_leaves, peer: on_trajectory(
+            traj_leaves, ep_leaves, peer, q_k
+        )
+
+    def on_trajectory(traj_leaves, ep_leaves, peer, q_k):
         if isinstance(traj_leaves, codec_lib.CodedTrajectory):
             # Coded frame: the payload stays COMPRESSED through the
             # queue (CRC already verified the coded bytes at the
@@ -1967,7 +2117,7 @@ def run_impala_distributed(
                 return False
         while not closing.is_set():
             try:
-                q.put(item, timeout=0.5)
+                q_k.put(item, timeout=0.5)
                 return True
             except queue_lib.Full:
                 continue
@@ -1979,23 +2129,45 @@ def run_impala_distributed(
     # hello-frame source id).
     validate_coded = validator.admit if validator is not None else None
 
-    if server is not None:
-        # Adopt the pre-takeover listener: actors connected while the
-        # standby was absorbing (and discarding) their pushes now feed
-        # the real queue. The publish below bumps the version and
-        # notifies them, so everyone re-fetches from the new learner.
-        server.set_trajectory_sink(on_trajectory)
-    else:
-        server = LearnerServer(
-            on_trajectory,
+    def make_server(q_k, bind_port):
+        return LearnerServer(
+            make_on_trajectory(q_k),
             host=host,
-            port=port,
+            port=bind_port,
             idle_timeout_s=cfg.transport_idle_timeout_s,
             max_frame_bytes=cfg.transport_max_frame_mb << 20,
             param_delta=cfg.param_delta,
             param_delta_ring=cfg.param_delta_ring,
             param_bf16=cfg.param_bf16_wire,
         )
+
+    if server is not None:
+        # Adopt the pre-takeover listener: actors connected while the
+        # standby was absorbing (and discarding) their pushes now feed
+        # the real queue. The publish below bumps the version and
+        # notifies them, so everyone re-fetches from the new learner.
+        server.set_trajectory_sink(make_on_trajectory(queues[0]))
+        servers = [server]
+    else:
+        # One listener per ingest shard: the param plane (publishes,
+        # delta encodes, notify broadcasts) and the trajectory receive
+        # path scale with the shard count instead of serializing
+        # through one socket. An explicit bind port maps to
+        # port, port+1, ... across shards (printed below).
+        servers = [
+            make_server(q_k, port if port == 0 else port + j)
+            for j, q_k in enumerate(queues)
+        ]
+        server = servers[0]
+        if len(servers) > 1:
+            print(
+                "[impala] sharded learner listeners: "
+                + " ".join(
+                    f"shard{j}={host}:{s.port}"
+                    for j, s in enumerate(servers)
+                ),
+                flush=True,
+            )
 
     # No actor threads here, but a multi-device CPU learner must still
     # retire each collective-bearing dispatch before the next one
@@ -2029,10 +2201,13 @@ def run_impala_distributed(
         def serve_sink(traj_leaves, ep_leaves, actor_id):
             # Segments enter through the same admission path as a
             # wire push: hello-grade provenance for the validator,
-            # bounded-queue backpressure for flow control.
+            # bounded-queue backpressure for flow control. (env_shim
+            # is single-stack — validated above — so queues[0] IS the
+            # learner's queue.)
             return on_trajectory(
                 traj_leaves, ep_leaves,
                 PeerInfo(-1, actor_id, -1, ROLE_ACTOR),
+                queues[0],
             )
 
         serving = InferenceServer(
@@ -2057,13 +2232,31 @@ def run_impala_distributed(
         )
         server.set_inference_handler(serving.submit)
 
-    server.publish(jax.tree_util.tree_leaves(jax.device_get(state.params)))
+    leaves0 = jax.tree_util.tree_leaves(jax.device_get(state.params))
+    for s in servers:
+        s.publish(leaves0)
+    del leaves0
     if on_server_start is not None:
-        # Listener bound, weights published: safe to point actors here.
-        on_server_start(host, server.port)
+        # Listener(s) bound, weights published: safe to point actors
+        # here (one call per shard listener — the unsharded/standby
+        # path sees exactly the single call it always did).
+        for s in servers:
+            on_server_start(host, s.port)
 
     ctx = mp.get_context("spawn")
     connect_host = "127.0.0.1" if host in ("0.0.0.0", "") else host
+
+    # Actor ownership: GLOBAL actor id -> the shard listener it feeds.
+    # Disjoint contiguous slices per shard; global ids keep quarantine
+    # provenance and logs unambiguous fleet-wide. A per-host shard
+    # spawns (and monitors) only its own slice.
+    if shard is not None:
+        actor_ports = {}
+        for j, sh in enumerate(shard.local_shards()):
+            for aid in shard.actor_slice(cfg.num_actors, sh):
+                actor_ports[aid] = servers[j].port
+    else:
+        actor_ports = {i: server.port for i in range(cfg.num_actors)}
 
     def spawn(i: int, generation: int):
         if cfg.actor_mode == "env_shim":
@@ -2077,7 +2270,7 @@ def run_impala_distributed(
         p = ctx.Process(
             target=target,
             args=(
-                cfg, i, connect_host, server.port,
+                cfg, i, connect_host, actor_ports[i],
                 cfg.seed * 10_000 + generation * 1_000 + i,
                 generation,
             ),
@@ -2087,10 +2280,16 @@ def run_impala_distributed(
         return p
 
     procs = (
-        [] if external_actors else
-        [spawn(i, 0) for i in range(cfg.num_actors)]
+        {} if external_actors else
+        {i: spawn(i, 0) for i in sorted(actor_ports)}
     )
     restarts = 0
+    # Sharded mode runs one prefetch thread per shard, each polling
+    # its own queue and ALL of them running the health check (a stack
+    # whose pipeline is the only one still polling must still restart
+    # dead actors); the check mutates procs/restarts, so it is
+    # serialized.
+    health_lock = threading.Lock()
 
     def check_health(it: int):
         nonlocal restarts
@@ -2099,20 +2298,26 @@ def run_impala_distributed(
             # process (it likely received the same SIGTERM) is expected;
             # respawning or raising here would race the final save.
             return
+        with health_lock:
+            _check_health_locked()
+
+    def _check_health_locked():
+        nonlocal restarts
         if validator is not None:
             # Quarantined actor processes are terminated and respawned
             # through the same generation mechanism as crashed ones
             # (and against the same restart budget); the quarantine
             # lifts once the fresh generation is up.
             for aid in validator.take_respawns():
-                if not 0 <= aid < len(procs):
+                if aid not in procs:
                     # Provenance came off the wire — the very data the
-                    # validator distrusts. An unmappable id still has
+                    # validator distrusts. An unmappable id (or, on a
+                    # per-host shard, another host's actor) still has
                     # its pushes dropped (quarantined); just don't let
                     # it terminate some healthy process or crash here.
                     print(
                         f"[impala] quarantined actor id {aid} maps to "
-                        f"no live process; dropping its pushes only",
+                        f"no local process; dropping its pushes only",
                         flush=True,
                     )
                     continue
@@ -2133,23 +2338,23 @@ def run_impala_distributed(
                 procs[aid].join(timeout=5.0)
                 procs[aid] = spawn(aid, restarts)
                 validator.reset_actor(aid)
-        for idx, p in enumerate(procs):
+        for aid, p in list(procs.items()):
             if p.is_alive():
                 continue
             if restarts >= cfg.max_actor_restarts:
                 raise RuntimeError(
-                    f"actor process {idx} died (exitcode {p.exitcode}) "
+                    f"actor process {aid} died (exitcode {p.exitcode}) "
                     f"and restart budget ({cfg.max_actor_restarts}) is "
                     f"exhausted"
                 )
             restarts += 1
             print(
-                f"[impala] actor process {idx} died "
+                f"[impala] actor process {aid} died "
                 f"(exitcode {p.exitcode}); restart "
                 f"{restarts}/{cfg.max_actor_restarts}",
                 flush=True,
             )
-            procs[idx] = spawn(idx, restarts)
+            procs[aid] = spawn(aid, restarts)
 
     donate = (
         cfg.donate_buffers and donation_supported() and exec_lock is None
@@ -2160,12 +2365,16 @@ def run_impala_distributed(
     # Weight broadcast off the critical path: the learner hands the
     # publisher thread a params reference (a device-side COPY when the
     # step donates its state buffers) and keeps training; the thread
-    # does the blocking device->host fetch + version bump.
-    publisher = AsyncParamPublisher(
-        lambda p: server.publish(
-            jax.tree_util.tree_leaves(jax.device_get(p))
-        )
-    )
+    # does the blocking device->host fetch + version bump. Sharded:
+    # ONE device->host fetch, then every shard listener publishes the
+    # same leaves to its own slice of the fleet (per-shard delta
+    # encode + notify — the param plane scales with the shard count).
+    def _publish_wire(p):
+        leaves = jax.tree_util.tree_leaves(jax.device_get(p))
+        for s in servers:
+            s.publish(leaves)
+
+    publisher = AsyncParamPublisher(_publish_wire)
 
     def publish(params):
         p = programs.copy_params(params) if donate else params
@@ -2180,12 +2389,62 @@ def run_impala_distributed(
 
     sentinel = _make_sentinel(cfg, programs, publish, exec_lock)
 
+    # Host attribution for multi-host/sharded runs: the process/shard
+    # topology rides every periodic log line, so a log stream is
+    # attributable to its host without any out-of-band context.
+    shard_info = {}
+    if shard is not None or multihost.process_count() > 1:
+        shard_info = dict(multihost.process_info())
+        if shard is not None:
+            shard_info["shard_count"] = shard.shard_count
+            if shard.shard_id is not None:
+                shard_info["shard_id"] = shard.shard_id
+        print(f"[impala] topology {shard_info}", flush=True)
+
+    def _merged_server_metrics():
+        if len(servers) == 1:
+            return server.metrics()
+        out: Dict[str, Any] = {}
+        for sm in (s.metrics() for s in servers):
+            for k, v in sm.items():
+                if not isinstance(v, (int, float)):
+                    out[k] = v
+                elif k.endswith("_mean"):
+                    # Gauges average across shards; counters sum.
+                    out[k] = round(out.get(k, 0.0) + v / len(servers), 6)
+                else:
+                    out[k] = round(out.get(k, 0) + v, 6)
+        return out
+
+    def _per_shard_metrics():
+        # Per-stack ingest attribution (sharded only): connection
+        # count, trajectories, and how many connected ROLE_ACTOR peers
+        # are OUTSIDE the stack's assigned slice — the disjointness
+        # witness the sharded tests pin (always 0 in healthy fleets).
+        from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+            ROLE_ACTOR,
+        )
+
+        out = {}
+        for j, (sh, s) in enumerate(zip(shard.local_shards(), servers)):
+            conns = s.connections()
+            slice_ = shard.actor_slice(cfg.num_actors, sh)
+            actors = [c for c in conns if c["role"] == ROLE_ACTOR]
+            out[f"shard{sh}_conns"] = len(actors)
+            out[f"shard{sh}_foreign_peers"] = sum(
+                1 for c in actors if c["actor_id"] not in slice_
+            )
+            out[f"shard{sh}_trajectories"] = s.metrics()[
+                "transport_trajectories"
+            ]
+        return out
+
     def extra_metrics():
         # Transport liveness rides the same log stream as the learning
         # metrics: disconnect/reconnect counts, per-actor liveness,
         # byte/frame totals (LearnerServer.metrics()) — plus the
         # serving tier's batch/latency counters in env_shim mode.
-        sm = server.metrics()
+        sm = _merged_server_metrics()
         return {
             "param_version": server.version,
             "actor_restarts": restarts,
@@ -2201,7 +2460,99 @@ def run_impala_distributed(
             **publisher.metrics(),
             **(serving.metrics() if serving is not None else {}),
             **(validator.metrics() if validator is not None else {}),
+            **(_per_shard_metrics() if shard is not None else {}),
+            **shard_info,
         }
+
+    # Sharded ingest: pre-built per-shard pipelines (the loop then
+    # builds none of its own). Each pipeline polls ITS shard's queue
+    # (running the shared health check) and transfers onto its device
+    # slice; in-process shards are joined by the stitcher, a per-host
+    # shard feeds the loop directly through the process-local wrap.
+    ingest = None
+    step_barrier = None
+    if shard is not None:
+        treedef, axes_leaves, shardings_leaves = ingest_plan
+        local_parts = shard.local_parts(cfg.batch_trajectories)
+
+        def make_poll(q_k):
+            def poll(n):
+                check_health(0)
+                try:
+                    return q_k.get_many(n, timeout=0.25)
+                except queue_lib.Empty:
+                    return ()
+
+            return poll
+
+        pipes = []
+        for j, sh in enumerate(shard.local_shards()):
+            if shard.multihost:
+                transfer = sharding_lib.process_local_transfer(
+                    shardings_leaves, axes_leaves, shard.shard_count
+                )
+                wrap = True
+            else:
+                transfer = sharding_lib.device_slice_transfer(
+                    shard.device_slice(mesh, sh), axes_leaves
+                )
+                wrap = False
+            pipes.append(
+                LearnerPipeline(
+                    poll=make_poll(queues[j]),
+                    batch_parts=local_parts,
+                    treedef=treedef,
+                    axes_leaves=axes_leaves,
+                    shardings_leaves=shardings_leaves,
+                    n_slots=max(2, cfg.pipeline_slots),
+                    validate_coded=validate_coded,
+                    max_decode_bytes=cfg.transport_max_frame_mb << 20,
+                    part_specs=part_specs,
+                    transfer=transfer,
+                    wrap_batch=wrap,
+                    name=f"learner-pipeline-{sh}",
+                )
+            )
+        if shard.multihost:
+            ingest = pipes[0]
+            if shard.shard_count > 1 and cfg.shard_step_barrier:
+                if coordinator is None or not hasattr(
+                    coordinator, "step_barrier"
+                ):
+                    raise ValueError(
+                        "per-host sharded learner needs a preemption "
+                        "coordinator for the lockstep barrier (--shard "
+                        "wires one; pass coordinator= here)"
+                    )
+
+                def step_barrier(it, stop_evt):
+                    return coordinator.step_barrier(
+                        it,
+                        timeout_s=cfg.shard_barrier_timeout_s,
+                        stop_event=stop_evt,
+                    )
+
+            # Checkpoint ownership: shard 0 writes (host numpy — no
+            # multi-process array coordination inside orbax); others
+            # skip with a debug log. Reads delegate unchanged.
+            if checkpointer is not None and not isinstance(
+                checkpointer, sharding_lib.ShardCheckpointer
+            ):
+                checkpointer = sharding_lib.ShardCheckpointer(
+                    checkpointer, shard.shard_id
+                )
+        else:
+            global_shapes = []
+            for (pshape, _), ax in zip(part_specs, axes_leaves):
+                g = list(pshape)
+                g[ax] *= cfg.batch_trajectories
+                global_shapes.append(tuple(g))
+            ingest = sharding_lib.ShardedIngest(
+                pipes,
+                treedef=treedef,
+                global_shapes=global_shapes,
+                shardings=shardings_leaves,
+            )
 
     completed = False
     try:
@@ -2222,10 +2573,20 @@ def run_impala_distributed(
             validate_coded=validate_coded,
             stop_event=stop_event,
             coordinator=coordinator,
+            ingest=ingest,
+            step_barrier=step_barrier,
         )
         completed = True
     finally:
         closing.set()
+        if ingest is not None:
+            # Normally the loop's finally closed it; the early-return
+            # path (already-exhausted budget) never entered the loop
+            # body, and close() is idempotent.
+            try:
+                ingest.close()
+            except Exception:
+                pass
         try:
             publisher.close()
         except Exception:
@@ -2247,14 +2608,16 @@ def run_impala_distributed(
             # hello-declared standbys to take over FIRST (same
             # connection, ordered before any close). A standby that
             # then finds no work left exits immediately.
-            handed_off = server.broadcast_handoff()
+            handed_off = sum(s.broadcast_handoff() for s in servers)
         # With a standby taking over, the fleet must SURVIVE this
         # learner: skip the goodbye (actors see a reset, retry, and
         # land on the successor via the redirector) instead of telling
         # every actor to exit. No standby -> the PR-3 clean shutdown.
-        server.close(graceful=handed_off == 0)
-        q.close()
-        for p in procs:
+        for s in servers:
+            s.close(graceful=handed_off == 0)
+        for q_k in queues:
+            q_k.close()
+        for p in procs.values():
             p.join(timeout=5.0)
             if p.is_alive():
                 p.terminate()
@@ -2337,6 +2700,12 @@ def run_impala_standby(
         donation_supported,
     )
 
+    if cfg.shard_count > 1:
+        raise ValueError(
+            "warm-standby failover is not yet supported for the "
+            "sharded learner (shard_count > 1): a standby would have "
+            "to take over every shard's listener at once"
+        )
     programs = make_impala(cfg)
     template = jax.eval_shape(programs.init, jax.random.PRNGKey(cfg.seed))
     # Wire treedefs + ingest plan derived NOW (eval_shape traces): the

@@ -430,6 +430,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "learner and resets live links. Binds 0.0.0.0 "
                         "unless HOST is given — the fleet is usually "
                         "on other hosts")
+    p.add_argument("--shard", default=None, metavar="N | K/N@HOST:PORT",
+                   help="impala with --actor-processes: shard the "
+                        "LEARNER data-parallel. Bare 'N' runs N "
+                        "in-process ingest shards over device slices "
+                        "of the mesh (each its own trajectory "
+                        "listener, host arena and param publishes, "
+                        "each owning a disjoint slice of the actor "
+                        "fleet). 'K/N@HOST:PORT' joins this process "
+                        "as learner-host shard K of N: HOST:PORT is "
+                        "the jax.distributed rendezvous (shard 0 "
+                        "hosts it), PORT+1 carries the preemption "
+                        "consensus + per-step lockstep barrier "
+                        "(shard 0 leads), shard 0 owns checkpoints. "
+                        "Knobs: --set shard_step_barrier= "
+                        "shard_barrier_timeout_s=. Requires "
+                        "batch_trajectories/num_actors/devices "
+                        "divisible by N; see ARCHITECTURE.md "
+                        "'Sharded learner'")
     p.add_argument("--coordinate-preemption", default=None,
                    metavar="SPEC",
                    help="impala: coordinate the SIGTERM final "
@@ -538,6 +556,102 @@ def make_coordinator(spec: str):
     )
 
 
+def parse_shard(spec: str):
+    """``N`` -> in-process plan args; ``K/N@HOST:PORT`` -> per-host
+    plan args. Returns ``(shard_id_or_None, shard_count, host, port)``
+    — host/port are the rendezvous address (None for in-process)."""
+    addr_part = None
+    topo = spec
+    if "@" in spec:
+        topo, _, addr_part = spec.partition("@")
+    if "/" in topo:
+        if addr_part is None:
+            raise SystemExit(
+                f"--shard: per-host form needs a rendezvous address "
+                f"('K/N@HOST:PORT'), got {spec!r}"
+            )
+        k_s, _, n_s = topo.partition("/")
+        try:
+            k, n = int(k_s), int(n_s)
+        except ValueError:
+            raise SystemExit(f"--shard: bad K/N in {spec!r}")
+        host, port = parse_hostport(addr_part, "--shard")
+        return k, n, host, port
+    if addr_part is not None:
+        raise SystemExit(
+            f"--shard: the in-process form is a bare count "
+            f"('--shard N'), got {spec!r}"
+        )
+    try:
+        n = int(topo)
+    except ValueError:
+        raise SystemExit(f"--shard: bad shard count {spec!r}")
+    return None, n, None, None
+
+
+def make_shard_runtime(args, cfg):
+    """--shard -> (cfg with shard_count set, ShardPlan | None,
+    coordinator | None). The per-host form joins the jax.distributed
+    runtime NOW (before any backend use) and wires the preemption
+    coordinator that doubles as the per-step lockstep barrier: shard 0
+    leads on rendezvous-port+1, everyone else follows."""
+    if args.shard is None:
+        return cfg, None, None
+    if not args.actor_processes:
+        raise SystemExit("--shard requires --actor-processes (the "
+                         "sharded learner ingests over the transport)")
+    if args.standby:
+        raise SystemExit("--shard is incompatible with --standby")
+    shard_id, shard_count, host, port = parse_shard(args.shard)
+    if shard_count < 1:
+        raise SystemExit(f"--shard: count must be >= 1, got {shard_count}")
+    cfg = dataclasses.replace(cfg, shard_count=shard_count)
+    if shard_count == 1 and shard_id is None:
+        return cfg, None, None
+
+    from actor_critic_algs_on_tensorflow_tpu.distributed.sharding import (
+        ShardPlan,
+    )
+
+    plan = ShardPlan(shard_count, shard_id=shard_id)
+    if shard_id is None:
+        return cfg, plan, None
+    if args.coordinate_preemption:
+        raise SystemExit(
+            "--shard K/N@... already wires the preemption coordinator "
+            "(it carries the lockstep barrier); drop "
+            "--coordinate-preemption"
+        )
+    from actor_critic_algs_on_tensorflow_tpu.distributed.controlplane import (
+        PreemptionFollower,
+        PreemptionLeader,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.parallel import multihost
+
+    multihost.initialize(
+        coordinator_address=f"{host}:{port}",
+        num_processes=shard_count,
+        process_id=shard_id,
+    )
+    if shard_id == 0:
+        coord = PreemptionLeader(
+            n_followers=shard_count - 1, host="", port=port + 1
+        )
+        print(
+            f"[train] shard 0/{shard_count}: lockstep leader on "
+            f"port {coord.port} ({shard_count - 1} followers)",
+            flush=True,
+        )
+    else:
+        coord = PreemptionFollower(host, port + 1)
+        print(
+            f"[train] shard {shard_id}/{shard_count}: following the "
+            f"lockstep leader at {host}:{port + 1}",
+            flush=True,
+        )
+    return cfg, plan, coord
+
+
 def make_config(args) -> Tuple[str, Any]:
     from actor_critic_algs_on_tensorflow_tpu.algos.a2c import A2CConfig
     from actor_critic_algs_on_tensorflow_tpu.algos.ddpg import DDPGConfig
@@ -602,7 +716,8 @@ def main(argv=None) -> int:
             writer.close()
 
 
-def _open_checkpointer(args, make_template, cfg=None):
+def _open_checkpointer(args, make_template, cfg=None, wait_for_step_s=None,
+                       solo_process=False):
     """(checkpointer, restored_state) from --checkpoint-dir/--resume.
 
     ``make_template`` is called lazily only when a restore happens; it
@@ -610,6 +725,12 @@ def _open_checkpointer(args, make_template, cfg=None):
     matters, the shardings) the restored arrays should adopt. ``cfg``
     (when given) guards against grafting fresh obs-normalization stats
     into a normalize_obs=True run (utils.checkpoint.obs_norm_restore_guard).
+    ``wait_for_step_s`` (non-zero learner shards resuming a sharded
+    run) blocks until shard 0's latest step dir is durable instead of
+    racing the writer — see ``Checkpointer.wait_for_step``.
+    ``solo_process`` (per-host sharded runs) keeps orbax's own
+    multiprocess coordination out of the manager — the shard plane
+    owns cross-host checkpoint semantics explicitly.
     """
     if not args.checkpoint_dir:
         return None, None
@@ -618,8 +739,16 @@ def _open_checkpointer(args, make_template, cfg=None):
         obs_norm_restore_guard,
     )
 
-    checkpointer = Checkpointer(args.checkpoint_dir)
+    checkpointer = Checkpointer(
+        args.checkpoint_dir, solo_process=solo_process
+    )
     state = None
+    if (
+        args.resume
+        and wait_for_step_s is not None
+        and checkpointer.latest_step() is None
+    ):
+        checkpointer.wait_for_step(timeout_s=wait_for_step_s)
     if args.resume and checkpointer.latest_step() is not None:
         state = checkpointer.restore(
             make_template(),
@@ -803,6 +932,8 @@ def _run(args, algo, cfg, writer) -> int:
         )
     if args.redirector is not None and not args.standby:
         raise SystemExit("--redirector requires --standby")
+    if args.shard is not None and algo != "impala":
+        raise SystemExit("--shard is impala-only (the sharded learner)")
     if args.eval:
         if not args.checkpoint_dir:
             raise SystemExit("--eval requires --checkpoint-dir")
@@ -837,7 +968,12 @@ def _run(args, algo, cfg, writer) -> int:
             run_impala_distributed,
         )
 
-        coordinator = None
+        # Sharded learner first: the per-host form must join the
+        # jax.distributed runtime BEFORE anything touches the backend
+        # (make_template below compiles against the global mesh).
+        cfg, shard_plan, shard_coord = make_shard_runtime(args, cfg)
+
+        coordinator = shard_coord
         if args.coordinate_preemption:
             coordinator = make_coordinator(args.coordinate_preemption)
 
@@ -852,11 +988,44 @@ def _run(args, algo, cfg, writer) -> int:
                 make_impala(cfg).init, jax.random.PRNGKey(cfg.seed)
             )
 
-        checkpointer, initial_state = _open_checkpointer(args, make_template)
+        checkpointer, initial_state = _open_checkpointer(
+            args, make_template,
+            # Deliberately SHORT and decoupled from the barrier budget:
+            # a fresh start under a restart wrapper that always passes
+            # --resume finds an EMPTY dir on every shard — a non-zero
+            # shard must give the (possibly mid-final-save) writer a
+            # beat to surface its step, then proceed fresh well inside
+            # the leader's first step-barrier deadline. A diverged
+            # restore is caught loudly by that barrier's step check.
+            wait_for_step_s=(
+                min(15.0, cfg.shard_barrier_timeout_s / 4)
+                if shard_plan is not None
+                and shard_plan.multihost
+                and shard_plan.shard_id != 0
+                else None
+            ),
+            solo_process=shard_plan is not None and shard_plan.multihost,
+        )
+        if (
+            checkpointer is not None
+            and shard_plan is not None
+            and shard_plan.multihost
+        ):
+            # Shard 0 owns the writes (through host numpy); peers skip
+            # with a debug log — reads/restores delegate unchanged.
+            from actor_critic_algs_on_tensorflow_tpu.distributed.sharding import (  # noqa: E501
+                ShardCheckpointer,
+            )
+
+            checkpointer = ShardCheckpointer(
+                checkpointer, shard_plan.shard_id
+            )
         kwargs = {"coordinator": coordinator}
         if args.actor_processes:
             runner = run_impala_distributed
             kwargs["host"], kwargs["port"] = parse_bind(args.learner_bind)
+            if shard_plan is not None:
+                kwargs["shard"] = shard_plan
         else:
             runner = run_impala
         # Preemption-safe shutdown: SIGTERM/SIGINT set an event the

@@ -247,6 +247,8 @@ class LearnerPipeline:
         validate_coded: Optional[Callable[[Any, Any, int], bool]] = None,
         max_decode_bytes: int = 1 << 30,
         part_specs: Optional[Sequence[Tuple[tuple, Any]]] = None,
+        transfer: Optional[Callable[[Sequence[np.ndarray]], Any]] = None,
+        wrap_batch: bool = True,
         name: str = "learner-pipeline",
     ):
         self._poll = poll
@@ -259,6 +261,16 @@ class LearnerPipeline:
         # polled item.
         self._validate_coded = validate_coded
         self._max_decode_bytes = max_decode_bytes
+        # Sharded-learner hooks (distributed.sharding): ``transfer``
+        # replaces the whole-buffer sharded ``device_put`` with a
+        # shard-aware placement — per-device chunks of THIS shard's
+        # device slice (in-process shards), or a process-local wrap
+        # into the global multi-host batch. ``wrap_batch=False`` hands
+        # the consumer the raw transferred leaves instead of the
+        # unflattened pytree (the in-process stitcher combines N
+        # shards' leaves BEFORE the tree exists).
+        self._transfer = transfer
+        self._wrap_batch = wrap_batch
         self._batch_parts = batch_parts
         self._treedef = treedef
         self._axes = axes_leaves
@@ -502,16 +514,25 @@ class LearnerPipeline:
             {k: np.asarray(v) for k, v in ep.items()} for ep in eps
         ]
         t0 = time.perf_counter()
-        dev_leaves = [
-            jax.device_put(buf, s)
-            for buf, s in zip(self._arena.slot_leaves(slot), self._shardings)
-        ]
+        if self._transfer is not None:
+            dev_leaves = self._transfer(self._arena.slot_leaves(slot))
+        else:
+            dev_leaves = [
+                jax.device_put(buf, s)
+                for buf, s in zip(
+                    self._arena.slot_leaves(slot), self._shardings
+                )
+            ]
         # Block THIS thread (not the learner) until the host->device
         # copies land — the transfer rides under the learner's compute,
         # and once ready the slot's host memory is provably unread.
         jax.block_until_ready(dev_leaves)
         self.split.add("transfer_s", time.perf_counter() - t0)
-        batch = jax.tree_util.tree_unflatten(self._treedef, dev_leaves)
+        batch = (
+            jax.tree_util.tree_unflatten(self._treedef, dev_leaves)
+            if self._wrap_batch
+            else dev_leaves
+        )
         return batch, eps_np, slot
 
     # -- consumer side --------------------------------------------------

@@ -48,6 +48,7 @@ threads.
 from __future__ import annotations
 
 import dataclasses
+import select
 import socket
 import threading
 import time
@@ -83,7 +84,16 @@ __all__ = [
     "PreemptionLeader",
     "PrimaryMonitor",
     "Redirector",
+    "ShardDesync",
 ]
+
+
+class ShardDesync(RuntimeError):
+    """A sharded learner fleet left lockstep: a peer host is dead,
+    wedged past the barrier deadline, or reporting a different step.
+    Raised instead of letting the survivors dispatch into a collective
+    that can never complete — the detection path of the per-step
+    training barrier (``PreemptionLeader/Follower.step_barrier``)."""
 
 
 class Redirector(ChaosProxy):
@@ -534,6 +544,11 @@ class _Follower:
     last_step_t: float = 0.0
     final_report: Optional[int] = None
     barrier_arrived: bool = False
+    # Per-STEP training barrier (sharded learner lockstep): the newest
+    # step this follower reported ready-to-dispatch. Distinct from
+    # ``barrier_arrived`` (the save-complete frame at preemption) —
+    # per-step frames carry a marker array, save-complete frames none.
+    barrier_step: Optional[int] = None
     dead: bool = False
 
 
@@ -570,6 +585,7 @@ class PreemptionLeader:
         host: str = "127.0.0.1",
         port: int = 0,
         log: Callable[[str], None] | None = None,
+        reuse_port: bool = False,
     ):
         self.n_followers = n_followers
         self._log = log if log is not None else (
@@ -583,7 +599,10 @@ class PreemptionLeader:
         self._own_step: Optional[int] = None
         self._halt = threading.Event()
         self._reader_threads: List[threading.Thread] = []
-        self._listener = socket.create_server((host, port))
+        self._listener = socket.create_server(
+            (host, port),
+            reuse_port=reuse_port and hasattr(socket, "SO_REUSEPORT"),
+        )
         self._listener.settimeout(0.2)
         self.port = self._listener.getsockname()[1]
         self._accept_thread = threading.Thread(
@@ -629,6 +648,11 @@ class PreemptionLeader:
                         f.last_step_t = time.monotonic()
                     elif kind == KIND_STEP_REPORT:
                         f.final_report = int(tag)
+                        self._cond.notify_all()
+                    elif kind == KIND_BARRIER and arrays:
+                        # Per-step training barrier (marker array):
+                        # this follower is ready to dispatch step tag.
+                        f.barrier_step = int(tag)
                         self._cond.notify_all()
                     elif kind == KIND_BARRIER:
                         f.barrier_arrived = True
@@ -720,10 +744,110 @@ class PreemptionLeader:
                 self._log(f"follower lost during {what}")
         return arrived
 
+    # -- per-step training barrier (sharded learner lockstep) ----------
+
+    def step_barrier(
+        self,
+        step: int,
+        *,
+        timeout_s: float = 60.0,
+        stop_event: threading.Event | None = None,
+    ) -> str:
+        """Hold until every follower host reported ready-to-dispatch
+        for ``step``, then release them all — the lockstep gate the
+        sharded learner passes between collecting a batch and entering
+        the cross-host collective.
+
+        Returns ``"ok"`` (dispatch), or ``"stop"`` when a preemption is
+        under way (our ``stop_event`` fired, or a follower broke off
+        into the stop-step consensus) — the caller then joins the
+        consensus instead of dispatching. A dead peer, a peer on a
+        DIFFERENT step (diverged restore / lost lockstep), or silence
+        past ``timeout_s`` raises ``ShardDesync``: a loud, attributable
+        error beats an unbounded hang inside the collective the dead
+        host can never join."""
+        step = int(step)
+        deadline = time.monotonic() + timeout_s
+        followers = self._wait_followers(deadline)
+        if len(followers) < self.n_followers:
+            raise ShardDesync(
+                f"step barrier: only {len(followers)}/{self.n_followers} "
+                f"shard hosts connected within {timeout_s:.1f}s"
+            )
+        with self._cond:
+            while True:
+                if stop_event is not None and stop_event.is_set():
+                    return "stop"
+                if any(f.final_report is not None for f in followers):
+                    # A peer began the preemption consensus (its signal
+                    # may not have reached this host): stop training
+                    # and join it.
+                    return "stop"
+                dead = [f for f in followers if f.dead]
+                if dead:
+                    raise ShardDesync(
+                        f"step barrier: {len(dead)} shard host(s) lost "
+                        f"at step {step}"
+                    )
+                ready = [
+                    f for f in followers
+                    if f.barrier_step is not None and f.barrier_step >= step
+                ]
+                if len(ready) == len(followers):
+                    off = sorted(
+                        {f.barrier_step for f in followers
+                         if f.barrier_step != step}
+                    )
+                    if off:
+                        raise ShardDesync(
+                            f"step barrier: hosts out of lockstep at "
+                            f"step {step} (peer steps {off} — diverged "
+                            f"restore or missed iteration)"
+                        )
+                    break
+                if time.monotonic() >= deadline:
+                    silent = sum(
+                        1 for f in followers
+                        if f.barrier_step is None or f.barrier_step < step
+                    )
+                    raise ShardDesync(
+                        f"step barrier: {silent} shard host(s) silent "
+                        f"at step {step} past the {timeout_s:.1f}s "
+                        f"deadline (wedged or partitioned)"
+                    )
+                self._cond.wait(
+                    timeout=max(0.02, min(0.2, deadline - time.monotonic()))
+                )
+        for f in followers:
+            try:
+                send_msg(f.sock, KIND_BARRIER_OK, step)
+            except OSError as e:
+                raise ShardDesync(
+                    f"step barrier: release to a shard host failed at "
+                    f"step {step} ({e!r})"
+                ) from e
+        return "ok"
+
     def decide(self, local_step: int, timeout_s: float = 20.0) -> int:
         """Collect every follower's (final) step report, broadcast the
         agreed stop step (max of all, including ours), return it."""
         deadline = time.monotonic() + timeout_s
+        # Peers may be blocked in their per-step lockstep barrier recv
+        # with no local preemption signal of their own: nudge them into
+        # the consensus (a STOP_STEP WITH a marker array — the real
+        # agreed-step frame below carries none, and followers outside
+        # a barrier wait skip marker frames, so the wire stays
+        # unambiguous for every follower state).
+        with self._cond:
+            fs = list(self._followers)
+        for f in fs:
+            try:
+                send_msg(
+                    f.sock, KIND_STOP_STEP, 0,
+                    [np.asarray([1], np.int64)],
+                )
+            except OSError:
+                pass
         followers = self._wait_followers(deadline)
         live = self._wait_inbox(
             followers, lambda f: f.final_report is not None, deadline,
@@ -860,6 +984,83 @@ class PreemptionFollower:
             except OSError:
                 pass
 
+    def step_barrier(
+        self,
+        step: int,
+        *,
+        timeout_s: float = 60.0,
+        stop_event: threading.Event | None = None,
+    ) -> str:
+        """Follower side of the per-step lockstep gate: announce
+        ready-to-dispatch for ``step`` (a ``KIND_BARRIER`` frame WITH a
+        marker array — the save-complete barrier at preemption carries
+        none), then hold for the leader's release.
+
+        Returns ``"ok"`` (dispatch now — every host will) or ``"stop"``
+        (the leader is preempting: join the stop-step consensus instead
+        of dispatching). Once the announce frame is sent the outcome is
+        the LEADER's to resolve — bailing out locally on ``stop_event``
+        here could leave the released peers dispatching into a
+        collective this host never joins, so the local signal is acted
+        on at the next loop boundary instead. A dead/wedged leader
+        raises ``ShardDesync`` within the deadline."""
+        step = int(step)
+        del stop_event  # resolved leader-side; see docstring
+        if self._telemetry_dead:
+            raise ShardDesync(
+                "step barrier: the consensus link was severed by an "
+                "earlier telemetry failure; this host cannot hold "
+                "lockstep"
+            )
+        deadline = time.monotonic() + timeout_s
+        try:
+            self._sock.settimeout(2.0)
+            send_msg(
+                self._sock, KIND_BARRIER, step,
+                [np.asarray([1], np.int64)],
+            )
+            while True:
+                # Poll READABILITY, then read the whole frame under a
+                # generous per-frame budget: recv_msg is a multi-read
+                # parse, and a short recv timeout firing MID-frame
+                # would desync the stream beyond repair (the same
+                # reasoning report_step applies to a partial send) —
+                # retrying it would misparse from the middle of a
+                # frame and kill a healthy fleet.
+                readable, _, _ = select.select([self._sock], [], [], 0.2)
+                if not readable:
+                    if time.monotonic() >= deadline:
+                        raise ShardDesync(
+                            f"step barrier: no release for step {step} "
+                            f"within {timeout_s:.1f}s (leader host "
+                            f"wedged or partitioned)"
+                        )
+                    continue
+                # Barrier frames are tiny; a frame that stalls this
+                # long mid-read is a genuinely broken link (-> the
+                # ConnectionError/ShardDesync path below).
+                self._sock.settimeout(5.0)
+                kind, tag, arrays = recv_msg(self._sock)
+                if kind == KIND_BARRIER_OK and int(tag) == step:
+                    return "ok"
+                if kind == KIND_BARRIER_OK:
+                    continue  # stale release from an earlier step
+                if kind == KIND_STOP_STEP and arrays:
+                    # Preemption-pending nudge: the leader is stopping;
+                    # do NOT dispatch — join the consensus.
+                    return "stop"
+                # Anything else (telemetry echoes etc.): ignore.
+        except (ConnectionError, OSError) as e:
+            raise ShardDesync(
+                f"step barrier: link to the leader lost at step {step} "
+                f"({e!r})"
+            ) from e
+        finally:
+            try:
+                self._sock.settimeout(None)
+            except OSError:
+                pass
+
     def decide(self, local_step: int, timeout_s: float = 20.0) -> int:
         """Report our step; block for the leader's agreed stop step.
         On a dead leader, fall back to our own step (save locally —
@@ -867,10 +1068,19 @@ class PreemptionFollower:
         try:
             self._sock.settimeout(timeout_s)
             send_msg(self._sock, KIND_STEP_REPORT, int(local_step))
-            kind, tag, _ = recv_msg(self._sock)
-            if kind != KIND_STOP_STEP:
+            while True:
+                kind, tag, arrays = recv_msg(self._sock)
+                if kind == KIND_STOP_STEP and not arrays:
+                    return int(tag)
+                if kind == KIND_BARRIER_OK or (
+                    kind == KIND_STOP_STEP and arrays
+                ):
+                    # Leftovers of the per-step barrier exchange (a
+                    # stale release, or the preemption-pending nudge
+                    # that sent us here): skip to the real agreed-step
+                    # frame.
+                    continue
                 raise ConnectionError(f"expected STOP_STEP, got {kind}")
-            return int(tag)
         except (socket.timeout, ConnectionError, OSError) as e:
             self._log(
                 f"leader unreachable during consensus ({e!r}); saving at "

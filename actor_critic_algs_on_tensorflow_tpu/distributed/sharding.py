@@ -1,0 +1,369 @@
+"""Sharded-learner data plane: per-shard ingest feeding one global
+data-parallel ``learner_step``.
+
+PRs 1-7 made the distributed runtime fault-tolerant and wire-efficient,
+but the learner plane — trajectory server, host arena, prefetch
+pipeline, param publishes — still serialized through ONE ingest stack
+on one host. IMPALA (Espeholt et al. 2018) and SEED RL scale the
+learner data-parallel: params replicated, the batch sharded across
+accelerators, gradients ``pmean``'d — exactly what the ``shard_map``
+specs in ``parallel/mesh.py`` already express. This module supplies
+the missing host side: the topology math and ingest plumbing that let
+N independent ingest stacks (each its own ``LearnerServer``,
+``TrajectoryQueue``, ``HostArena``/``LearnerPipeline``, each serving
+delta publishes to only its slice of the actor fleet) feed ONE
+global-mesh ``learner_step``.
+
+Two deployment shapes share this machinery:
+
+  - **In-process shards** (``ShardPlan(n)``, ``shard_id=None``): one
+    learner process runs all ``n`` ingest stacks, each bound to a
+    contiguous device slice of the mesh. Each stack's prefetch thread
+    assembles its local parts and ``device_put``s them onto ITS
+    devices; ``ShardedIngest`` stitches the per-device arrays into the
+    global sharded batch with ``jax.make_array_from_single_device_arrays``
+    — zero copies at the join, and the per-shard decode/assembly work
+    runs concurrently instead of serializing through one prefetch
+    thread. This is the single-controller shape (a multi-chip host, or
+    the CPU test mesh).
+  - **Per-host shards** (``ShardPlan(n, shard_id=k)``): each learner
+    HOST is one shard of a ``jax.distributed`` job — it runs one local
+    ingest stack over its slice of the actor fleet and wraps its local
+    slot buffers into the global batch with
+    ``jax.make_array_from_process_local_data``; the ``shard_map``
+    collective then averages gradients over DCN. Hosts advance in
+    lockstep through the per-step barrier grown out of the preemption
+    consensus (``controlplane.PreemptionLeader/Follower.step_barrier``)
+    so a wedged host surfaces as a loud ``ShardDesync`` within a
+    deadline instead of an unbounded hang inside the collective.
+
+Checkpoint ownership under sharding (params are replicated, so every
+shard holds the full state): only shard 0 writes — ``ShardCheckpointer``
+gates the others — and saves go through ``jax.device_get`` first so
+orbax never engages multi-process array coordination.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue as queue_lib
+import threading
+import time
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+__all__ = [
+    "ShardCheckpointer",
+    "ShardPlan",
+    "ShardedIngest",
+    "QueueGroup",
+    "device_slice_transfer",
+    "process_local_transfer",
+    "stitch_global_leaves",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardPlan:
+    """Topology of a sharded learner: how the actor fleet, the global
+    batch, and the mesh devices split across ``shard_count`` ingest
+    shards.
+
+    ``shard_id=None`` is the in-process shape (this process runs every
+    shard's ingest stack); ``shard_id=k`` is the per-host shape (this
+    process IS shard ``k`` of a multi-host job). All splits are
+    contiguous and equal-sized — divisibility is validated loudly so a
+    bad topology fails at construction, not as a shape error deep in
+    the pipeline.
+    """
+
+    shard_count: int
+    shard_id: Optional[int] = None
+
+    def __post_init__(self):
+        if self.shard_count < 1:
+            raise ValueError(f"shard_count must be >= 1, got {self.shard_count}")
+        if self.shard_id is not None and not (
+            0 <= self.shard_id < self.shard_count
+        ):
+            raise ValueError(
+                f"shard_id {self.shard_id} outside [0, {self.shard_count})"
+            )
+
+    @property
+    def multihost(self) -> bool:
+        """Per-host shape: this process runs exactly one shard."""
+        return self.shard_id is not None
+
+    def local_shards(self) -> range:
+        """Shard indices whose ingest stacks live in THIS process."""
+        if self.multihost:
+            return range(self.shard_id, self.shard_id + 1)
+        return range(self.shard_count)
+
+    def local_parts(self, batch_trajectories: int) -> int:
+        """Trajectories per shard per learner batch."""
+        if batch_trajectories % self.shard_count:
+            raise ValueError(
+                f"batch_trajectories={batch_trajectories} not divisible "
+                f"by shard_count={self.shard_count}"
+            )
+        return batch_trajectories // self.shard_count
+
+    def actor_slice(self, num_actors: int, shard: int) -> range:
+        """GLOBAL actor ids owned by ``shard`` (disjoint, contiguous).
+        Global ids keep provenance (quarantine, logs) unambiguous
+        across the whole fleet."""
+        if num_actors % self.shard_count:
+            raise ValueError(
+                f"num_actors={num_actors} not divisible by "
+                f"shard_count={self.shard_count}"
+            )
+        per = num_actors // self.shard_count
+        return range(shard * per, (shard + 1) * per)
+
+    def device_slice(self, mesh, shard: int) -> List[Any]:
+        """The contiguous block of data-axis mesh devices shard
+        ``shard`` feeds (in-process shape). Contiguity matters: the
+        batch spec shards the env axis in device order, so shard k's
+        rows must land on devices [k*d/N, (k+1)*d/N)."""
+        devices = list(mesh.devices.flat)
+        if len(devices) % self.shard_count:
+            raise ValueError(
+                f"{len(devices)} mesh devices not divisible by "
+                f"shard_count={self.shard_count}"
+            )
+        per = len(devices) // self.shard_count
+        return devices[shard * per : (shard + 1) * per]
+
+
+def device_slice_transfer(
+    devices: Sequence[Any], axes: Sequence[int]
+) -> Callable[[Sequence[np.ndarray]], List[List[Any]]]:
+    """Transfer hook for an in-process shard's ``LearnerPipeline``:
+    split each slot buffer along its data axis into one chunk per
+    owned device and ``device_put`` each chunk to ITS device. Returns
+    per-leaf lists of single-device arrays — exactly what
+    ``stitch_global_leaves`` wraps into the global batch with no
+    further copies."""
+    n = len(devices)
+
+    def transfer(slot_leaves: Sequence[np.ndarray]) -> List[List[Any]]:
+        out = []
+        for buf, ax in zip(slot_leaves, axes):
+            w = buf.shape[ax] // n
+            chunks = []
+            for i, dev in enumerate(devices):
+                sl = [slice(None)] * buf.ndim
+                sl[ax] = slice(i * w, (i + 1) * w)
+                chunks.append(jax.device_put(buf[tuple(sl)], dev))
+            out.append(chunks)
+        return out
+
+    return transfer
+
+
+def process_local_transfer(
+    shardings: Sequence[Any], axes: Sequence[int], shard_count: int
+) -> Callable[[Sequence[np.ndarray]], List[Any]]:
+    """Transfer hook for a per-host shard's ``LearnerPipeline``: wrap
+    this host's slot buffers (the LOCAL slice of the batch) into
+    global arrays over the multi-host mesh. No wire traffic — each
+    host contributes only its addressable shards; the cross-host
+    averaging happens inside ``learner_step``'s ``pmean``."""
+
+    def transfer(slot_leaves: Sequence[np.ndarray]) -> List[Any]:
+        out = []
+        for buf, sharding, ax in zip(slot_leaves, shardings, axes):
+            gshape = list(buf.shape)
+            gshape[ax] *= shard_count
+            out.append(
+                jax.make_array_from_process_local_data(
+                    sharding, buf, tuple(gshape)
+                )
+            )
+        return out
+
+    return transfer
+
+
+def stitch_global_leaves(
+    per_shard_leaves: Sequence[Sequence[List[Any]]],
+    global_shapes: Sequence[tuple],
+    shardings: Sequence[Any],
+) -> List[Any]:
+    """Combine per-shard per-device arrays into global sharded leaves.
+
+    ``per_shard_leaves[k][i]`` is shard ``k``'s list of single-device
+    arrays for leaf ``i`` (produced by ``device_slice_transfer``).
+    ``jax.make_array_from_single_device_arrays`` matches arrays to the
+    sharding by each array's OWN device, so the wrap is order-robust
+    and copy-free — the global batch aliases the per-shard transfer
+    buffers."""
+    leaves = []
+    for i, (gshape, sharding) in enumerate(zip(global_shapes, shardings)):
+        arrays = [a for shard in per_shard_leaves for a in shard[i]]
+        leaves.append(
+            jax.make_array_from_single_device_arrays(gshape, sharding, arrays)
+        )
+    return leaves
+
+
+class ShardedIngest:
+    """Join N per-shard ``LearnerPipeline``s into one global-batch
+    source with the single pipeline's consumer interface
+    (``get``/``mark_consumed``/``metrics``/``close``), so
+    ``_learner_loop`` cannot tell it from a lone pipe.
+
+    Each pipeline prefetches and stages its shard's batch
+    independently (its own poll thread, arena, device transfer); ``get``
+    joins the N staged batches and stitches them into the global
+    sharded pytree. The join wait AFTER the first shard staged is the
+    shard-skew cost — surfaced as ``pipeline_barrier_wait_s`` (the
+    in-process analog of the multi-host step barrier's wait)."""
+
+    def __init__(
+        self,
+        pipes: Sequence[Any],
+        *,
+        treedef: Any,
+        global_shapes: Sequence[tuple],
+        shardings: Sequence[Any],
+    ):
+        from actor_critic_algs_on_tensorflow_tpu.utils.metrics import (
+            TimeSplit,
+        )
+
+        self._pipes = list(pipes)
+        self._treedef = treedef
+        self._global_shapes = list(global_shapes)
+        self._shardings = list(shardings)
+        self.split = TimeSplit()
+        self.batches = 0
+
+    def get(self, timeout: float = 0.5, stop=None):
+        per = []
+        first_staged_t = None
+        for pipe in self._pipes:
+            got = pipe.get(timeout=timeout, stop=stop)
+            if got is None:
+                return None
+            per.append(got)
+            if first_staged_t is None:
+                first_staged_t = time.perf_counter()
+        # Time spent waiting for stragglers once SOME shard was ready:
+        # the stitch is gated on the slowest shard, exactly like the
+        # multi-host barrier is gated on the slowest host.
+        self.split.add(
+            "barrier_wait_s", time.perf_counter() - first_staged_t
+        )
+        leaves = stitch_global_leaves(
+            [lv for lv, _, _ in per], self._global_shapes, self._shardings
+        )
+        batch = jax.tree_util.tree_unflatten(self._treedef, leaves)
+        eps = [e for _, shard_eps, _ in per for e in shard_eps]
+        self.batches += 1
+        return batch, eps, tuple(h for _, _, h in per)
+
+    def mark_consumed(self, handle, token) -> None:
+        for pipe, h in zip(self._pipes, handle):
+            pipe.mark_consumed(h, token)
+
+    def metrics(self) -> dict:
+        """Merged view: time buckets and counters SUM across shards
+        (they are concurrent threads, so sums measure total work, not
+        wall time), plus the join-skew wait and the minimum per-shard
+        batch count (a shard at 0 means its slice of the fleet never
+        fed — the starvation signal the disjoint-ingest tests pin)."""
+        out: dict = {}
+        for pipe in self._pipes:
+            for k, v in pipe.metrics().items():
+                if isinstance(v, (int, float)):
+                    out[k] = round(out.get(k, 0) + v, 6)
+                else:
+                    out[k] = v
+        out.update(self.split.window())
+        out["pipeline_batches"] = self.batches
+        out["pipeline_shard_batches_min"] = min(
+            p.batches for p in self._pipes
+        )
+        return out
+
+    def close(self) -> None:
+        for pipe in self._pipes:
+            pipe.close()
+
+    @property
+    def alive(self) -> bool:
+        return all(p.alive for p in self._pipes)
+
+
+class QueueGroup:
+    """Metrics facade over the per-shard trajectory queues (the learner
+    loop folds ``q.metrics()`` into its log line; counters sum, depth
+    sums — the aggregate backlog)."""
+
+    def __init__(self, queues: Sequence[Any]):
+        self._queues = list(queues)
+
+    def metrics(self) -> dict:
+        out: dict = {}
+        for q in self._queues:
+            for k, v in q.metrics().items():
+                out[k] = round(out.get(k, 0) + v, 6)
+        return out
+
+    def get(self, *a, **kw):  # pragma: no cover - serial path is
+        # validated away in sharded mode; a reach here is a bug.
+        raise queue_lib.Empty
+
+    def get_many(self, *a, **kw):  # pragma: no cover
+        raise queue_lib.Empty
+
+
+class ShardCheckpointer:
+    """Checkpoint ownership under sharding: params/opt state are
+    REPLICATED across shards, so every shard holds the full training
+    state and exactly one writer suffices. Shard 0 saves (through
+    ``jax.device_get``, so orbax sees plain host numpy and never
+    engages multi-process array coordination); other shards skip with
+    a debug log. Reads (``latest_step``/``restore``/...) delegate
+    unchanged — every shard restores from the shared directory."""
+
+    def __init__(self, inner, shard_id: int, *, log=None):
+        self._inner = inner
+        self._shard_id = int(shard_id)
+        self._log = log if log is not None else (
+            lambda msg: print(f"[shard-ckpt] {msg}", flush=True)
+        )
+        self._skips = 0
+
+    def _skip(self, what: str, step: int) -> None:
+        self._skips += 1
+        if self._skips <= 1:
+            self._log(
+                f"shard {self._shard_id}: skipping {what} at step {step} "
+                f"(checkpoints are owned by shard 0; further skips "
+                f"logged silently)"
+            )
+
+    def save(self, step: int, state: Any) -> None:
+        if self._shard_id != 0:
+            self._skip("checkpoint save", int(step))
+            return
+        self._inner.save(int(step), jax.device_get(state))
+
+    def save_interrupted(self, step: int, state: Any) -> bool:
+        if self._shard_id != 0:
+            self._skip("interrupted save", int(step))
+            return False
+        return self._inner.save_interrupted(
+            int(step), jax.device_get(state)
+        )
+
+    # -- reads / lifecycle: delegate -----------------------------------
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)

@@ -112,6 +112,44 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def spans_processes(mesh: Mesh) -> bool:
+    """Whether ``mesh`` includes devices of more than one process —
+    the multi-host (per-host-sharded learner) regime, where host
+    values become global arrays via the process-local constructors
+    instead of a plain ``device_put``."""
+    return len({d.process_index for d in mesh.devices.flat}) > 1
+
+
+def put_replicated_tree(tree, mesh: Mesh):
+    """Place a host pytree fully replicated on ``mesh``, multi-host
+    aware.
+
+    Single-process meshes (and abstract tracing, e.g. ``eval_shape``
+    of an init program) take the ordinary ``device_put``. A mesh that
+    spans processes instead wraps each (identical-on-every-host —
+    same seed, same config) concrete leaf with
+    ``jax.make_array_from_process_local_data``: every process
+    contributes its own replica and no cross-process transfer happens,
+    which is both the portable path on this jax line and the only one
+    that never asks ``device_put`` to address a non-addressable
+    device."""
+    sharding = NamedSharding(mesh, P())
+    leaves = jax.tree_util.tree_leaves(tree)
+    concrete = all(
+        isinstance(x, (np.ndarray, np.generic, jax.Array, int, float, bool))
+        and not isinstance(x, jax.core.Tracer)
+        for x in leaves
+    )
+    if not spans_processes(mesh) or not concrete:
+        return jax.device_put(tree, sharding)
+    return jax.tree_util.tree_map(
+        lambda x: jax.make_array_from_process_local_data(
+            sharding, np.asarray(x), np.shape(x)
+        ),
+        tree,
+    )
+
+
 def batch_sharded(mesh: Mesh, axis_name: str = DATA_AXIS) -> NamedSharding:
     """Shard the leading (batch/env) axis across the mesh."""
     return NamedSharding(mesh, P(axis_name))

@@ -55,8 +55,16 @@ def is_initialized() -> bool:
         return False
 
 
+def process_count() -> int:
+    """Processes in the runtime (1 when not distributed-initialized)."""
+    return jax.process_count()
+
+
 def process_info() -> dict:
-    """Host topology snapshot for logs/metrics."""
+    """Host topology snapshot for logs/metrics — folded into the
+    sharded learner's periodic log line (``extra_metrics``) so a
+    multi-host run is attributable to its host from the log stream
+    alone."""
     return {
         "process_index": jax.process_index(),
         "process_count": jax.process_count(),

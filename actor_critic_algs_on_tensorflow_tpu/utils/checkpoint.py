@@ -14,6 +14,7 @@ from the latest step.
 from __future__ import annotations
 
 import os
+import time
 from typing import Any
 
 import jax
@@ -57,12 +58,39 @@ class Checkpointer:
         *,
         max_to_keep: int = 3,
         async_save: bool = True,
+        solo_process: bool = False,
     ):
+        directory = os.path.abspath(os.fspath(directory))
+        extra: dict = {}
+        if solo_process and jax.process_count() > 1:
+            # Per-host-sharded learners own checkpointing explicitly
+            # (shard 0 writes host numpy, peers poll the shared dir —
+            # distributed.sharding.ShardCheckpointer), so THIS manager
+            # must act alone: orbax's default multiprocess mode would
+            # run cross-process barriers in the constructor and every
+            # save — a hang when only one shard ever calls save (and,
+            # on backends without multiprocess computations, a crash
+            # at construction). active_processes pins every barrier to
+            # this process; the root dir is pre-created because orbax
+            # refuses create=True in that mode.
+            from orbax.checkpoint import options as ocp_options
+
+            os.makedirs(directory, exist_ok=True)
+            pid = jax.process_index()
+            extra = dict(
+                create=False,
+                multiprocessing_options=ocp_options.MultiprocessingOptions(
+                    primary_host=pid,
+                    active_processes={pid},
+                    barrier_sync_key_prefix=f"solo{pid}",
+                ),
+            )
         self._mgr = ocp.CheckpointManager(
-            os.path.abspath(os.fspath(directory)),
+            directory,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep,
                 enable_async_checkpointing=async_save,
+                **extra,
             ),
         )
         # Step id the last successful restore() actually loaded — the
@@ -111,6 +139,33 @@ class Checkpointer:
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
+
+    def wait_for_step(
+        self,
+        step: int | None = None,
+        *,
+        timeout_s: float = 60.0,
+        poll_s: float = 0.25,
+    ) -> int | None:
+        """Block until a DURABLE checkpoint step is visible (>= ``step``
+        when given), re-scanning the directory each poll; returns the
+        step, or ``None`` at the deadline.
+
+        The non-zero-shard restore path of the sharded learner: shard 0
+        owns the writes, so a peer host resuming must wait for the step
+        dir to be finalized instead of racing the writer. Orbax
+        finalizes atomically (tmp dir + rename), so a step visible in
+        ``latest_step()`` IS durable — the wait is for visibility, not
+        partial-write detection."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            self.refresh()
+            latest = self.latest_step()
+            if latest is not None and (step is None or latest >= step):
+                return latest
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(poll_s)
 
     def step_written_at(self, step: int) -> float | None:
         """Wall-clock mtime of ``step``'s checkpoint directory — when

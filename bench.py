@@ -49,6 +49,15 @@ cost — plus a small end-to-end distributed run reporting learner stall
 share both ways. Merged under ``"traj_plane"``; same off-by-default
 contract (scripts/traj_bench.py owns the measurement helpers).
 
+Optional sharded-learner leg (``BENCH_SHARD=1``): a subprocess runs
+real distributed IMPALA at 1 vs N ingest shards (per-shard listeners,
+arenas and actor slices feeding the stitched global ``learner_step``)
+under weak scaling and reports aggregate env-steps/sec, the speedup of
+the largest leg, and the barrier/join-wait share of wall time. Merged
+under ``"shard"``; same off-by-default contract (scripts/shard_bench.py
+owns the helpers; ``cpu_limited`` flags hosts where the ratio measures
+scheduler overlap, not parallel capacity).
+
 Optional serving leg (``BENCH_SERVE=1``): a fifth subprocess runs the
 SEED-style central-inference tier — real LearnerServer +
 InferenceServer with the compiled act() program, env-shim client
@@ -310,6 +319,35 @@ def measure_serve() -> dict:
     )
 
 
+def measure_shard() -> dict:
+    """Sharded-learner leg (scripts/shard_bench.py owns the helpers):
+    aggregate learner env-steps/sec at 1 vs N in-process ingest shards
+    under weak scaling (per-shard batch and actor slice fixed), plus
+    the barrier/join-wait share of wall time — the lockstep cost the
+    shard plane adds. ``cpu_limited`` flags hosts with fewer cores
+    than concurrent workers, where the ratio measures scheduler
+    overlap rather than parallel capacity."""
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts"),
+    )
+    import shard_bench as shb
+
+    counts = tuple(
+        int(x)
+        for x in os.environ.get("BENCH_SHARD_COUNTS", "1,2").split(",")
+    )
+    return shb.bench(
+        counts,
+        iters=int(os.environ.get("BENCH_SHARD_ITERS", 40)),
+        parts_per_shard=int(os.environ.get("BENCH_SHARD_PARTS", 2)),
+        actors_per_shard=int(os.environ.get("BENCH_SHARD_ACTORS", 1)),
+        envs_per_actor=int(os.environ.get("BENCH_SHARD_ENVS", 16)),
+        rollout_length=int(os.environ.get("BENCH_SHARD_ROLLOUT", 32)),
+        env=os.environ.get("BENCH_SHARD_ENV", "CartPole-v1"),
+    )
+
+
 def _notify_latencies_ms(cpb, versions) -> list:
     """publish() -> fetch-complete latencies (ms); the harness itself
     lives in controlplane_bench (single source of truth)."""
@@ -351,6 +389,15 @@ def main() -> int:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         try:
             print(json.dumps(measure_serve()))
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+            return 1
+        return 0
+
+    if len(sys.argv) > 1 and sys.argv[1] == "--measure-shard":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        try:
+            print(json.dumps(measure_shard()))
         except Exception:
             traceback.print_exc(file=sys.stderr)
             return 1
@@ -506,6 +553,24 @@ def main() -> int:
             sys.stderr.write(
                 "[bench] traj plane leg failed\n"
                 + (tchild.stderr[-2000:] if tchild is not None else "")
+            )
+    if os.environ.get("BENCH_SHARD"):
+        dchild = None
+        try:
+            dchild = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--measure-shard"],
+                capture_output=True,
+                text=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                timeout=int(os.environ.get("BENCH_CHILD_TIMEOUT", 900)),
+            )
+            payload["shard"] = json.loads(
+                dchild.stdout.strip().splitlines()[-1]
+            )
+        except Exception:
+            sys.stderr.write(
+                "[bench] shard leg failed\n"
+                + (dchild.stderr[-2000:] if dchild is not None else "")
             )
     if os.environ.get("BENCH_SERVE"):
         schild = None

@@ -1,0 +1,168 @@
+"""Sharded-learner bench: aggregate learner throughput at 1 vs N
+ingest shards, plus the barrier-wait share (the lockstep cost).
+
+Weak-scaling discipline — the claim the sharded learner makes on real
+hardware: hold the PER-SHARD workload fixed (trajectories per batch,
+actors, envs) and add shards; aggregate env-steps/sec should scale
+with the shard count while the join/barrier wait stays a small share
+of wall time. Each leg is a real ``run_impala_distributed`` run (actor
+processes over the transport, per-shard listeners and arenas, the
+stitched global ``learner_step``), so the measured path is the
+production path.
+
+Caveat recorded with every result: on a host with fewer cores than
+``shards + actors`` the legs timeshare one CPU and the aggregate ratio
+measures scheduler overlap, not parallel capacity — ``cpu_limited``
+flags it, and the leg is then primarily evidence that the shard plane
+adds little overhead (the barrier-wait share), not a scaling proof.
+"""
+
+from __future__ import annotations
+
+import os
+import statistics
+import time
+
+
+def _cpu_budget() -> int:
+    try:
+        return len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        return os.cpu_count() or 1
+
+
+def _ensure_devices(n: int) -> None:
+    """The N-shard leg needs >= n mesh devices. On a CPU host that
+    means the virtual-device flag, which only works BEFORE jax's first
+    backend use — set it here (fresh bench subprocess) or fail loudly
+    if jax is already up with too few devices (e.g. called from a
+    process that initialized a 1-device backend)."""
+    # Harmless if the backend is already up (the flag is only read at
+    # first backend init); decisive if it is not.
+    if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""
+    ):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n}"
+        )
+    import jax
+
+    if len(jax.devices()) < n:
+        raise RuntimeError(
+            f"shard bench needs >= {n} devices, have "
+            f"{len(jax.devices())}; run via `bench.py --measure-shard` "
+            f"(a fresh subprocess) or preset "
+            f"--xla_force_host_platform_device_count"
+        )
+
+
+def shard_leg(
+    shards: int,
+    *,
+    iters: int = 40,
+    parts_per_shard: int = 2,
+    actors_per_shard: int = 1,
+    envs_per_actor: int = 16,
+    rollout_length: int = 32,
+    env: str = "CartPole-v1",
+) -> dict:
+    """One leg: a real distributed run at ``shards`` ingest shards
+    (weak scaling — the per-shard slice is constant). Returns the
+    aggregate env-steps/sec (median over post-compile log windows),
+    the learner step rate, and the barrier/join-wait share of wall
+    time."""
+    from actor_critic_algs_on_tensorflow_tpu.algos.impala import (
+        ImpalaConfig,
+        run_impala_distributed,
+    )
+
+    steps_per_batch = (
+        shards * parts_per_shard * envs_per_actor * rollout_length
+    )
+    cfg = ImpalaConfig(
+        env=env,
+        num_actors=shards * actors_per_shard,
+        envs_per_actor=envs_per_actor,
+        rollout_length=rollout_length,
+        batch_trajectories=shards * parts_per_shard,
+        total_env_steps=iters * steps_per_batch,
+        queue_size=8,
+        lr_decay=False,
+        num_devices=shards,
+        shard_count=shards,
+    )
+    history = []
+    t0 = time.perf_counter()
+    _, hist = run_impala_distributed(
+        cfg, log_interval=max(2, iters // 8),
+        log_fn=lambda s, m: history.append((s, m)),
+    )
+    wall = time.perf_counter() - t0
+    # Window 0 pays XLA compilation; drop it unless it is the only one.
+    windows = history[1:] if len(history) > 1 else history
+    rates = [m["steps_per_sec"] for _, m in windows]
+    barrier_s = sum(
+        m.get("pipeline_barrier_wait_s", 0.0) for _, m in history
+    )
+    stall_s = sum(m.get("pipeline_stall_s", 0.0) for _, m in history)
+    agg = statistics.median(rates)
+    return {
+        "shards": shards,
+        "aggregate_steps_per_sec": round(agg, 1),
+        "learner_steps_per_sec": round(agg / steps_per_batch, 2),
+        "steps_per_batch": steps_per_batch,
+        "barrier_wait_share": round(barrier_s / max(wall, 1e-9), 4),
+        "stall_share": round(stall_s / max(wall, 1e-9), 4),
+        "wall_s": round(wall, 2),
+    }
+
+
+def bench(shard_counts=(1, 2), **leg_kwargs) -> dict:
+    """The ``BENCH_SHARD`` payload: one leg per shard count, the
+    aggregate speedup of the largest vs the single-shard leg, and the
+    largest leg's barrier-wait share."""
+    _ensure_devices(max(shard_counts))
+    legs = {str(s): shard_leg(s, **leg_kwargs) for s in shard_counts}
+    base = legs[str(min(shard_counts))]
+    top = legs[str(max(shard_counts))]
+    cpus = _cpu_budget()
+    return {
+        "legs": legs,
+        "aggregate_speedup": round(
+            top["aggregate_steps_per_sec"]
+            / max(base["aggregate_steps_per_sec"], 1e-9),
+            4,
+        ),
+        "barrier_wait_share": top["barrier_wait_share"],
+        "cpus": cpus,
+        # Fewer cores than concurrent workers: the ratio measures
+        # scheduler overlap on a shared core, not parallel capacity.
+        "cpu_limited": cpus < max(shard_counts) * 2,
+    }
+
+
+def main() -> int:
+    import json
+
+    counts = tuple(
+        int(x)
+        for x in os.environ.get("BENCH_SHARD_COUNTS", "1,2").split(",")
+    )
+    out = bench(
+        counts,
+        iters=int(os.environ.get("BENCH_SHARD_ITERS", 40)),
+        parts_per_shard=int(os.environ.get("BENCH_SHARD_PARTS", 2)),
+        actors_per_shard=int(os.environ.get("BENCH_SHARD_ACTORS", 1)),
+        envs_per_actor=int(os.environ.get("BENCH_SHARD_ENVS", 16)),
+        rollout_length=int(os.environ.get("BENCH_SHARD_ROLLOUT", 32)),
+        env=os.environ.get("BENCH_SHARD_ENV", "CartPole-v1"),
+    )
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -2,6 +2,64 @@
 
 import contextlib
 import signal
+import socket
+
+
+class PortReservation:
+    """Race-free test-port reservation.
+
+    The old idiom — bind a probe to port 0, read the port, close the
+    probe, hand the number to a subprocess that rebinds it — has a
+    window in which any other process can grab the port (the bind-race
+    flake class). This helper keeps the reservation socket BOUND (and
+    never listening) for its whole lifetime:
+
+      - While held, no other bind can take the port, and connects to it
+        are refused — ideal for "nothing listens here" tests.
+      - A server that binds with ``reuse_port=True`` (SO_REUSEPORT,
+        e.g. ``PreemptionLeader(reuse_port=True)``) can bind WHILE the
+        reservation is held: a bound-but-not-listening socket is not in
+        the kernel's listen group, so every connection goes to the real
+        listener — the race is eliminated, not narrowed.
+      - Servers that cannot set SO_REUSEPORT (jax.distributed's
+        coordinator, a LearnerServer inside a spawned run) call
+        ``release()`` at the last moment before the bind — the window
+        shrinks to the handoff instant and lives in ONE audited place
+        instead of being re-derived per test.
+    """
+
+    def __init__(self, host: str = "127.0.0.1"):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        if hasattr(socket, "SO_REUSEPORT"):
+            self._sock.setsockopt(
+                socket.SOL_SOCKET, socket.SO_REUSEPORT, 1
+            )
+        self._sock.bind((host, 0))
+        self.host = host
+        self.port = self._sock.getsockname()[1]
+
+    def release(self) -> int:
+        """Close the reservation (just-in-time handoff for servers
+        that cannot share the port via SO_REUSEPORT); returns the
+        port. Idempotent."""
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+        return self.port
+
+    def __enter__(self) -> "PortReservation":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+def reserve_port(host: str = "127.0.0.1") -> PortReservation:
+    """Reserve an ephemeral test port; see ``PortReservation``."""
+    return PortReservation(host)
 
 import jax
 import jax.numpy as jnp

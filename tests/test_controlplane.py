@@ -38,7 +38,7 @@ from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
     pack_arrays,
     recv_msg,
 )
-from tests.helpers import time_limit
+from tests.helpers import reserve_port, time_limit
 
 
 def _quiet_server(sink=None, **kw):
@@ -233,12 +233,11 @@ def test_monitor_never_seen_primary_gets_grace_not_deadline():
     dead: the plain deadline must not trigger a takeover (a standby
     winning the start race would split the fleet); only the much
     larger never-seen grace declares it down."""
-    with time_limit(30, "monitor never-seen"):
-        probe = socket.create_server(("127.0.0.1", 0))
-        port = probe.getsockname()[1]
-        probe.close()  # nothing ever listens here
+    with time_limit(30, "monitor never-seen"), reserve_port() as r:
+        # Held (bound, never listening) for the whole test: connects
+        # are refused AND nothing else can grab the port meanwhile.
         monitor = PrimaryMonitor(
-            "127.0.0.1", port,
+            "127.0.0.1", r.port,
             interval_s=0.05, deadline_s=0.3,
             never_seen_grace_s=1.5, log=lambda m: None,
         )
@@ -669,10 +668,11 @@ def test_failover_primary_killed_standby_takes_over(tmp_path):
         ckpt_dir = str(tmp_path / "ck")
 
         # A fixed port for the primary so the standby knows whom to
-        # monitor (bind-then-close: fine for a localhost test).
-        probe = socket.create_server(("127.0.0.1", 0))
-        primary_port = probe.getsockname()[1]
-        probe.close()
+        # monitor; the reservation is held until the last moment
+        # before the primary process binds it (tests/helpers.py
+        # PortReservation — the audited handoff idiom).
+        primary_reservation = reserve_port()
+        primary_port = primary_reservation.port
 
         redirector = Redirector("127.0.0.1", primary_port)
         ctx = mp.get_context("spawn")
@@ -681,6 +681,7 @@ def test_failover_primary_killed_standby_takes_over(tmp_path):
             args=(cfg, primary_port, ckpt_dir),
             daemon=True,
         )
+        primary_reservation.release()  # just-in-time handoff
         primary.start()
         # The actor fleet belongs to the JOB, not the primary: actors
         # connect to the redirector and survive the primary's death.
@@ -806,9 +807,8 @@ def test_coordinated_sigterm_two_processes_one_agreed_step(tmp_path):
     with time_limit(570, "coordinated sigterm e2e"):
         cfg_a = _failover_cfg(400)
         cfg_b = _failover_cfg(400)
-        probe = socket.create_server(("127.0.0.1", 0))
-        lead_port = probe.getsockname()[1]
-        probe.close()
+        lead_reservation = reserve_port()
+        lead_port = lead_reservation.port
 
         ctx = mp.get_context("spawn")
         pa = ctx.Process(
@@ -821,6 +821,7 @@ def test_coordinated_sigterm_two_processes_one_agreed_step(tmp_path):
             args=(cfg_b, f"follow@127.0.0.1:{lead_port}",
                   str(tmp_path / "b")),
         )
+        lead_reservation.release()  # just-in-time handoff
         pa.start()
         pb.start()
 

@@ -8,12 +8,13 @@ so the collective must cross processes to be correct.
 """
 
 import os
-import socket
 import subprocess
 import sys
 import textwrap
 
 import pytest
+
+from tests.helpers import reserve_port
 
 _WORKER = textwrap.dedent(
     """
@@ -66,15 +67,13 @@ _WORKER = textwrap.dedent(
 )
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
 @pytest.mark.slow
 def test_two_process_distributed_rendezvous(tmp_path):
-    addr = f"127.0.0.1:{_free_port()}"
+    # Reservation held until just before the workers spawn — the jax
+    # coordinator cannot share a port, so the handoff is the narrowed
+    # (and centralized) release() idiom from tests/helpers.py.
+    coord_reservation = reserve_port()
+    addr = f"127.0.0.1:{coord_reservation.port}"
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     # A fresh XLA_FLAGS without the conftest's forced 8-device count:
@@ -87,6 +86,7 @@ def test_two_process_distributed_rendezvous(tmp_path):
     env["PYTHONPATH"] = repo
     script = tmp_path / "worker.py"
     script.write_text(_WORKER)
+    coord_reservation.release()  # just-in-time handoff to proc 0
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), addr, str(pid)],

@@ -1,0 +1,768 @@
+"""Sharded learner (distributed.sharding + the impala wiring).
+
+Pins the three claims the shard plane makes:
+
+  (a) ingest through per-shard arenas/device-slices, stitched into the
+      global batch, is BIT-IDENTICAL to the single-stack device_put
+      path at a fixed seed — sharding changes topology, never math;
+  (b) each shard's server/arena ingests a DISJOINT slice of the actor
+      fleet (e2e, real actor processes over the transport);
+  (c) the per-step lockstep barrier detects a dead/wedged/diverged
+      host within its deadline (ShardDesync) instead of letting the
+      survivors dispatch into a collective that can never complete —
+      and folds a preempting host into the stop-step consensus.
+
+Plus: checkpoint ownership (shard 0 writes, peers wait for durability),
+CLI knob parsing, and the BENCH_SHARD leg's measurement contract.
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from actor_critic_algs_on_tensorflow_tpu.distributed.controlplane import (
+    PreemptionFollower,
+    PreemptionLeader,
+    ShardDesync,
+)
+from actor_critic_algs_on_tensorflow_tpu.distributed.sharding import (
+    ShardCheckpointer,
+    ShardPlan,
+    device_slice_transfer,
+    process_local_transfer,
+    stitch_global_leaves,
+)
+from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import make_mesh
+from tests.helpers import time_limit
+
+
+# ---------------------------------------------------------------------
+# Topology math.
+# ---------------------------------------------------------------------
+
+def test_shard_plan_splits_and_validation():
+    plan = ShardPlan(2)
+    assert not plan.multihost
+    assert list(plan.local_shards()) == [0, 1]
+    assert plan.local_parts(4) == 2
+    assert list(plan.actor_slice(6, 0)) == [0, 1, 2]
+    assert list(plan.actor_slice(6, 1)) == [3, 4, 5]
+    mesh = make_mesh(4)
+    devs = list(mesh.devices.flat)
+    assert plan.device_slice(mesh, 0) == devs[:2]
+    assert plan.device_slice(mesh, 1) == devs[2:]
+
+    host = ShardPlan(2, shard_id=1)
+    assert host.multihost
+    assert list(host.local_shards()) == [1]
+
+    with pytest.raises(ValueError, match="not divisible"):
+        plan.local_parts(3)
+    with pytest.raises(ValueError, match="not divisible"):
+        plan.actor_slice(5, 0)
+    with pytest.raises(ValueError, match="not divisible"):
+        plan.device_slice(make_mesh(3), 0)
+    with pytest.raises(ValueError, match="outside"):
+        ShardPlan(2, shard_id=2)
+    with pytest.raises(ValueError, match=">= 1"):
+        ShardPlan(0)
+
+
+def test_shard_count_rejected_by_thread_runner():
+    from actor_critic_algs_on_tensorflow_tpu.algos.impala import (
+        ImpalaConfig,
+        run_impala,
+    )
+
+    with pytest.raises(ValueError, match="shard_count"):
+        run_impala(ImpalaConfig(shard_count=2))
+
+
+# ---------------------------------------------------------------------
+# Stitched transfer: unit equivalence + (a) bit-identical training.
+# ---------------------------------------------------------------------
+
+def test_stitch_matches_whole_buffer_device_put():
+    """device_slice_transfer + stitch == the PR-2 whole-buffer sharded
+    device_put, leaf for leaf, for both concat-axis conventions."""
+    mesh = make_mesh(2)
+    axes = [1, 0]
+    full = [
+        np.arange(3 * 8, dtype=np.float32).reshape(3, 8),  # [T, B]
+        np.arange(8, dtype=np.int32),                      # [B]
+    ]
+    shardings = [
+        NamedSharding(mesh, P(None, "data")),
+        NamedSharding(mesh, P("data")),
+    ]
+    plan = ShardPlan(2)
+    per_shard = []
+    for k in range(2):
+        local = [full[0][:, 4 * k : 4 * (k + 1)], full[1][4 * k : 4 * (k + 1)]]
+        transfer = device_slice_transfer(plan.device_slice(mesh, k), axes)
+        per_shard.append(transfer(local))
+    stitched = stitch_global_leaves(
+        per_shard, [f.shape for f in full], shardings
+    )
+    ref = [jax.device_put(f, s) for f, s in zip(full, shardings)]
+    for got, want in zip(stitched, ref):
+        assert got.sharding.is_equivalent_to(want.sharding, got.ndim)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_process_local_transfer_single_process_equals_device_put():
+    mesh = make_mesh(2)
+    sharding = NamedSharding(mesh, P(None, "data"))
+    buf = np.arange(12, dtype=np.float32).reshape(3, 4)
+    [got] = process_local_transfer([sharding], [1], shard_count=1)([buf])
+    np.testing.assert_array_equal(
+        np.asarray(got), np.asarray(jax.device_put(buf, sharding))
+    )
+
+
+def test_sharded_ingest_bit_identical_to_single_stack():
+    """(a): K learner steps fed through two per-shard arenas + device
+    slices + the stitcher produce bit-identical params/opt-state to
+    the same steps fed through the single whole-buffer arena path."""
+    from actor_critic_algs_on_tensorflow_tpu.algos.impala import (
+        ImpalaConfig,
+        _derive_wire_plan,
+        make_impala,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.data.pipeline import HostArena
+
+    cfg = ImpalaConfig(
+        env="CartPole-v1",
+        num_actors=2,
+        envs_per_actor=4,
+        rollout_length=8,
+        batch_trajectories=2,
+        num_devices=2,
+        lr_decay=False,
+    )
+    programs = make_impala(cfg)
+    state0 = programs.init(jax.random.PRNGKey(cfg.seed))
+    traj_def, _, ingest_plan, traj_shape = _derive_wire_plan(
+        programs, state0.params
+    )
+    treedef, axes, shardings = ingest_plan
+
+    # Two deterministic wire trajectories off the real rollout program.
+    rollout, reset = programs.make_actor_programs(0)
+    key = jax.random.PRNGKey(11)
+    env_state, obs, carry = reset(key)
+    parts = []
+    for _ in range(2):
+        key, k = jax.random.split(key)
+        env_state, obs, carry, traj, _ = rollout(
+            state0.params, env_state, obs, carry, k
+        )
+        parts.append(
+            [np.asarray(x) for x in jax.tree_util.tree_leaves(traj)]
+        )
+
+    def run_steps(batch, n=3):
+        state = programs.init(jax.random.PRNGKey(cfg.seed))
+        for _ in range(n):
+            state, _ = programs.learner_step(state, batch)
+        return jax.device_get(state)
+
+    # Single-stack path: one arena, whole-buffer sharded device_put.
+    arena = HostArena(axes, n_parts=2)
+    for j, leaves in enumerate(parts):
+        arena.write_part(0, j, leaves)
+    single_leaves = [
+        jax.device_put(buf, s)
+        for buf, s in zip(arena.slot_leaves(0), shardings)
+    ]
+    single = run_steps(jax.tree_util.tree_unflatten(treedef, single_leaves))
+
+    # Sharded path: one arena per shard, device-slice transfer, stitch.
+    plan = ShardPlan(2)
+    per_shard = []
+    for k in range(2):
+        sh_arena = HostArena(axes, n_parts=1)
+        sh_arena.write_part(0, 0, parts[k])
+        transfer = device_slice_transfer(
+            plan.device_slice(programs.mesh, k), axes
+        )
+        per_shard.append(transfer(sh_arena.slot_leaves(0)))
+    gshapes = []
+    for leaf, ax in zip(jax.tree_util.tree_leaves(traj_shape), axes):
+        g = list(leaf.shape)
+        g[ax] *= 2
+        gshapes.append(tuple(g))
+    stitched_leaves = stitch_global_leaves(per_shard, gshapes, shardings)
+    sharded = run_steps(
+        jax.tree_util.tree_unflatten(treedef, stitched_leaves)
+    )
+
+    same = jax.tree_util.tree_map(
+        lambda a, b: bool(np.array_equal(np.asarray(a), np.asarray(b))),
+        single,
+        sharded,
+    )
+    assert all(jax.tree_util.tree_leaves(same)), same
+
+
+# ---------------------------------------------------------------------
+# Per-step lockstep barrier (c).
+# ---------------------------------------------------------------------
+
+def _pair(n=1):
+    leader = PreemptionLeader(
+        n_followers=n, host="127.0.0.1", port=0, log=lambda m: None
+    )
+    followers = [
+        PreemptionFollower("127.0.0.1", leader.port, log=lambda m: None)
+        for _ in range(n)
+    ]
+    return leader, followers
+
+
+def test_step_barrier_lockstep_rounds():
+    with time_limit(30, "barrier lockstep"):
+        leader, (follower,) = _pair()
+        results = []
+
+        def run_follower():
+            for step in range(3):
+                results.append(follower.step_barrier(step, timeout_s=10))
+
+        t = threading.Thread(target=run_follower, daemon=True)
+        t.start()
+        try:
+            for step in range(3):
+                assert leader.step_barrier(step, timeout_s=10) == "ok"
+            t.join(timeout=5)
+            assert results == ["ok", "ok", "ok"]
+        finally:
+            follower.close()
+            leader.close()
+
+
+def test_step_barrier_detects_dead_follower_within_deadline():
+    """(c): a killed host surfaces as ShardDesync promptly — the
+    survivors never dispatch into a collective it cannot join."""
+    with time_limit(30, "barrier dead follower"):
+        leader, (follower,) = _pair()
+        t = threading.Thread(
+            target=lambda: follower.step_barrier(0, timeout_s=10),
+            daemon=True,
+        )
+        t.start()
+        try:
+            assert leader.step_barrier(0, timeout_s=10) == "ok"
+            t.join(timeout=5)
+            follower.close()  # the host dies between steps
+            t0 = time.monotonic()
+            with pytest.raises(ShardDesync, match="lost|silent"):
+                leader.step_barrier(1, timeout_s=5.0)
+            # Death is a connection reset: detected well inside the
+            # wedged-host deadline.
+            assert time.monotonic() - t0 < 4.0
+        finally:
+            leader.close()
+
+
+def test_step_barrier_detects_dead_leader():
+    with time_limit(30, "barrier dead leader"):
+        leader, (follower,) = _pair()
+        try:
+            leader.close()
+            with pytest.raises(ShardDesync, match="lost|wedged"):
+                follower.step_barrier(0, timeout_s=5.0)
+        finally:
+            follower.close()
+
+
+def test_step_barrier_times_out_on_wedged_follower():
+    with time_limit(30, "barrier wedged"):
+        leader, (follower,) = _pair()
+        try:
+            # Connected but never syncing (wedged in compile, say).
+            t0 = time.monotonic()
+            with pytest.raises(ShardDesync, match="silent"):
+                leader.step_barrier(0, timeout_s=1.0)
+            assert time.monotonic() - t0 < 4.0
+        finally:
+            follower.close()
+            leader.close()
+
+
+def test_step_barrier_desync_on_diverged_step():
+    """Hosts on different iterations (a diverged restore) fail loudly
+    at the FIRST barrier instead of silently training skew."""
+    with time_limit(30, "barrier diverged"):
+        leader, (follower,) = _pair()
+        errs = []
+
+        def run_follower():
+            try:
+                follower.step_barrier(5, timeout_s=3.0)
+            except ShardDesync as e:
+                errs.append(e)
+
+        t = threading.Thread(target=run_follower, daemon=True)
+        t.start()
+        try:
+            with pytest.raises(ShardDesync, match="lockstep"):
+                leader.step_barrier(3, timeout_s=5.0)
+            t.join(timeout=6)
+        finally:
+            follower.close()
+            leader.close()
+
+
+def test_step_barrier_folds_preemption_into_consensus_both_ways():
+    with time_limit(30, "barrier preemption"):
+        # Follower preempts first: the leader's barrier returns "stop"
+        # and the ordinary decide/barrier consensus completes.
+        leader, (follower,) = _pair()
+        out = {}
+
+        def follower_preempts():
+            out["agreed"] = follower.decide(7, timeout_s=10)
+            out["released"] = follower.barrier(timeout_s=10)
+
+        t = threading.Thread(target=follower_preempts, daemon=True)
+        t.start()
+        try:
+            assert leader.step_barrier(3, timeout_s=10) == "stop"
+            assert leader.decide(3, timeout_s=10) == 7
+            assert leader.barrier(timeout_s=10)
+            t.join(timeout=5)
+            assert out == {"agreed": 7, "released": True}
+        finally:
+            follower.close()
+            leader.close()
+
+        # Leader preempts first: its decide() nudges the follower out
+        # of the barrier wait ("stop") and into the consensus.
+        leader, (follower,) = _pair()
+        out = {}
+
+        def follower_in_barrier():
+            out["barrier"] = follower.step_barrier(4, timeout_s=10)
+            if out["barrier"] == "stop":
+                out["agreed"] = follower.decide(4, timeout_s=10)
+                out["released"] = follower.barrier(timeout_s=10)
+
+        t = threading.Thread(target=follower_in_barrier, daemon=True)
+        t.start()
+        try:
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                # Wait until the follower's barrier frame landed so the
+                # nudge has something to interrupt.
+                with leader._cond:
+                    if any(
+                        f.barrier_step is not None
+                        for f in leader._followers
+                    ):
+                        break
+                time.sleep(0.02)
+            assert leader.decide(4, timeout_s=10) == 4
+            assert leader.barrier(timeout_s=10)
+            t.join(timeout=5)
+            assert out == {"barrier": "stop", "agreed": 4, "released": True}
+        finally:
+            follower.close()
+            leader.close()
+
+
+# ---------------------------------------------------------------------
+# Checkpoint ownership.
+# ---------------------------------------------------------------------
+
+def test_shard_checkpointer_only_shard_zero_writes(tmp_path):
+    from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
+        Checkpointer,
+    )
+
+    state = {"w": np.arange(4.0, dtype=np.float32), "step": np.int32(3)}
+    writer = Checkpointer(str(tmp_path), async_save=False)
+    logs = []
+    gate1 = ShardCheckpointer(writer, 1, log=logs.append)
+    gate1.save(10, state)
+    assert gate1.save_interrupted(10, state) is False
+    assert writer.latest_step() is None  # non-zero shard never writes
+    assert logs and "shard 1" in logs[0]
+
+    gate0 = ShardCheckpointer(writer, 0, log=logs.append)
+    gate0.save(10, state)
+    writer.wait()
+    assert gate0.latest_step() == 10  # reads delegate
+
+    # Peer-side durability wait + restore through the gate.
+    reader = Checkpointer(str(tmp_path), async_save=False)
+    assert reader.wait_for_step(10, timeout_s=10) == 10
+    restored = ShardCheckpointer(reader, 1, log=logs.append).restore(
+        state, step=10
+    )
+    np.testing.assert_array_equal(restored["w"], state["w"])
+
+
+def test_wait_for_step_times_out_empty_dir(tmp_path):
+    from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
+        Checkpointer,
+    )
+
+    ckpt = Checkpointer(str(tmp_path), async_save=False)
+    t0 = time.monotonic()
+    assert ckpt.wait_for_step(timeout_s=0.4, poll_s=0.05) is None
+    assert 0.3 < time.monotonic() - t0 < 5.0
+
+
+# ---------------------------------------------------------------------
+# (b) e2e: two in-process shards, disjoint actor slices, real wire.
+# ---------------------------------------------------------------------
+
+def test_sharded_e2e_disjoint_actor_slices():
+    from actor_critic_algs_on_tensorflow_tpu.algos.impala import (
+        ImpalaConfig,
+        run_impala_distributed,
+    )
+
+    with time_limit(240, "sharded e2e"):
+        spb = 2 * 4 * 8
+        cfg = ImpalaConfig(
+            env="CartPole-v1",
+            num_actors=2,
+            envs_per_actor=4,
+            rollout_length=8,
+            batch_trajectories=2,
+            total_env_steps=6 * spb,
+            queue_size=8,
+            num_devices=2,
+            shard_count=2,
+            lr_decay=False,
+        )
+        history = []
+        state, _ = run_impala_distributed(
+            cfg, log_interval=2,
+            log_fn=lambda s, m: history.append((s, m)),
+        )
+        assert int(state.step) == 6
+        finite = jax.tree_util.tree_map(
+            lambda x: bool(np.isfinite(np.asarray(x)).all()), state.params
+        )
+        assert all(jax.tree_util.tree_leaves(finite))
+        m = history[-1][1]
+        # Disjoint ingest: each shard's listener saw exactly its own
+        # actor, no foreign peers, and BOTH arenas assembled batches.
+        assert m["shard0_conns"] == 1 and m["shard1_conns"] == 1
+        assert m["shard0_foreign_peers"] == 0
+        assert m["shard1_foreign_peers"] == 0
+        assert m["shard0_trajectories"] > 0
+        assert m["shard1_trajectories"] > 0
+        assert m["pipeline_shard_batches_min"] > 0
+        # Per-shard param plane: every listener publishes (the async
+        # publisher is newest-wins, so the version count is >= the
+        # initial publish + at least one training publish, not exactly
+        # the step count).
+        assert m["param_version"] >= 2
+        # Host attribution rides the log line (the process_info
+        # satellite): topology keys present in every periodic window.
+        assert m["shard_count"] == 2
+        assert m["process_count"] >= 1
+
+
+def test_sharded_runner_validates_topology():
+    from actor_critic_algs_on_tensorflow_tpu.algos.impala import (
+        ImpalaConfig,
+        run_impala_distributed,
+    )
+
+    base = dict(num_actors=2, envs_per_actor=4, rollout_length=8,
+                num_devices=2, shard_count=2)
+    with pytest.raises(ValueError, match="pipeline"):
+        run_impala_distributed(
+            ImpalaConfig(batch_trajectories=2, pipeline=False, **base)
+        )
+    with pytest.raises(ValueError, match="not divisible"):
+        run_impala_distributed(
+            ImpalaConfig(batch_trajectories=3, **base)
+        )
+    with pytest.raises(ValueError, match="fetch_params"):
+        run_impala_distributed(
+            ImpalaConfig(
+                batch_trajectories=2, actor_mode="env_shim", **base
+            )
+        )
+
+
+# ---------------------------------------------------------------------
+# CLI knobs.
+# ---------------------------------------------------------------------
+
+def test_cli_parse_shard_forms():
+    from actor_critic_algs_on_tensorflow_tpu.cli.train import parse_shard
+
+    assert parse_shard("2") == (None, 2, None, None)
+    assert parse_shard("1/2@10.0.0.1:6000") == (1, 2, "10.0.0.1", 6000)
+    with pytest.raises(SystemExit):
+        parse_shard("1/2")  # per-host form needs an address
+    with pytest.raises(SystemExit):
+        parse_shard("2@host:1")  # address only valid with K/N
+    with pytest.raises(SystemExit):
+        parse_shard("x")
+    with pytest.raises(SystemExit):
+        parse_shard("a/b@h:1")
+
+
+def test_cli_shard_requires_actor_processes_and_impala():
+    from actor_critic_algs_on_tensorflow_tpu.cli.train import (
+        build_parser,
+        make_shard_runtime,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.algos.impala import (
+        ImpalaConfig,
+    )
+
+    parser = build_parser()
+    args = parser.parse_args(
+        ["--preset", "impala-cartpole", "--shard", "2"]
+    )
+    with pytest.raises(SystemExit, match="actor-processes"):
+        make_shard_runtime(args, ImpalaConfig())
+
+    args = parser.parse_args(
+        ["--preset", "impala-cartpole", "--shard", "2",
+         "--actor-processes"]
+    )
+    cfg, plan, coord = make_shard_runtime(args, ImpalaConfig())
+    assert cfg.shard_count == 2
+    assert plan is not None and not plan.multihost
+    assert coord is None
+
+    # Bare --shard 1 is the unsharded topology, no plan.
+    args = parser.parse_args(
+        ["--preset", "impala-cartpole", "--shard", "1",
+         "--actor-processes"]
+    )
+    cfg, plan, coord = make_shard_runtime(args, ImpalaConfig())
+    assert cfg.shard_count == 1 and plan is None
+
+    from actor_critic_algs_on_tensorflow_tpu.cli.train import main
+
+    with pytest.raises(SystemExit, match="impala-only"):
+        main(["--preset", "a2c-cartpole", "--shard", "2"])
+
+
+def test_cli_shard_knob_coercion():
+    from actor_critic_algs_on_tensorflow_tpu.algos.impala import (
+        ImpalaConfig,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.cli.train import (
+        apply_overrides,
+    )
+
+    cfg = apply_overrides(
+        ImpalaConfig(),
+        ["shard_count=2", "shard_step_barrier=False",
+         "shard_barrier_timeout_s=12.5"],
+    )
+    assert cfg.shard_count == 2
+    assert cfg.shard_step_barrier is False
+    assert cfg.shard_barrier_timeout_s == 12.5
+
+
+# ---------------------------------------------------------------------
+# Per-host (multi-host) shard topology: 2 real processes.
+# ---------------------------------------------------------------------
+
+_HOST_WORKER = """
+import sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from actor_critic_algs_on_tensorflow_tpu.distributed.controlplane import (
+    PreemptionFollower, PreemptionLeader, ShardDesync,
+)
+from actor_critic_algs_on_tensorflow_tpu.distributed.sharding import (
+    ShardCheckpointer, ShardPlan, process_local_transfer,
+)
+from actor_critic_algs_on_tensorflow_tpu.parallel import multihost
+from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
+    put_replicated_tree,
+)
+from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import Checkpointer
+
+addr, pid, barrier_port, ckpt_dir = (
+    sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+)
+multihost.initialize(coordinator_address=addr, num_processes=2, process_id=pid)
+info = multihost.process_info()
+assert info["process_count"] == 2, info
+
+plan = ShardPlan(2, shard_id=pid)
+mesh = Mesh(np.asarray(jax.devices()), ("data",))
+
+# Per-host ingest wrap: this host's local [T=2, B_local=3] slice becomes
+# its addressable shards of the global [2, 6] batch — no wire traffic.
+sharding = NamedSharding(mesh, P(None, "data"))
+local = np.full((2, 3), float(pid), np.float32)
+[garr] = process_local_transfer([sharding], [1], 2)([local])
+assert garr.shape == (2, 6), garr.shape
+for sh in garr.addressable_shards:
+    np.testing.assert_array_equal(np.asarray(sh.data), local)
+
+# Replicated state placement across hosts (init/restore path).
+rep = put_replicated_tree({"w": np.arange(4.0, dtype=np.float32)}, mesh)
+assert rep["w"].shape == (4,)
+
+# solo_process: this manager must never engage orbax's cross-process
+# barriers — shard 0 writes alone, peers poll the shared directory.
+ckpt = Checkpointer(ckpt_dir, async_save=False, solo_process=True)
+gate = ShardCheckpointer(ckpt, pid, log=lambda m: None)
+state = {"w": np.arange(4.0, dtype=np.float32)}
+if pid == 0:
+    coord = PreemptionLeader(
+        n_followers=1, host="127.0.0.1", port=barrier_port,
+        reuse_port=True, log=lambda m: None,
+    )
+    for step in (0, 1):
+        assert coord.step_barrier(step, timeout_s=60) == "ok", step
+    gate.save(11, state)  # shard 0 owns the write
+    # The peer exits WITHOUT syncing step 2: detected, not deadlocked.
+    try:
+        coord.step_barrier(2, timeout_s=10)
+        raise AssertionError("expected ShardDesync")
+    except ShardDesync:
+        pass
+    coord.close()
+else:
+    coord = PreemptionFollower(
+        "127.0.0.1", barrier_port, log=lambda m: None
+    )
+    for step in (0, 1):
+        assert coord.step_barrier(step, timeout_s=60) == "ok", step
+    # Non-zero shard: writes are skipped, durable reads come from
+    # shard 0 (wait_for_step never races the writer).
+    gate.save(12, state)
+    assert ckpt.wait_for_step(11, timeout_s=60) == 11
+    assert ckpt.latest_step() == 11
+    coord.close()
+print(f"shard{pid} ok", flush=True)
+"""
+
+
+def test_two_host_shard_topology(tmp_path):
+    """Per-host shards over a REAL jax.distributed rendezvous: the
+    process-local batch wrap, replicated state placement, the socket
+    lockstep barrier (including dead-host detection across process
+    boundaries), and shard-0 checkpoint ownership. The cross-host
+    collective itself is excluded — this jaxlib's CPU backend does not
+    implement multiprocess computations (see test_multihost)."""
+    import os
+    import subprocess
+    import sys as _sys
+
+    from tests.helpers import reserve_port
+
+    coord_r = reserve_port()
+    barrier_r = reserve_port()  # held: the leader binds reuse_port=True
+    addr = f"127.0.0.1:{coord_r.port}"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""  # one device per process
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo
+    script = tmp_path / "shard_worker.py"
+    script.write_text(_HOST_WORKER)
+    ckpt_dir = str(tmp_path / "ck")
+    coord_r.release()  # just-in-time handoff to the jax coordinator
+    procs = [
+        subprocess.Popen(
+            [_sys.executable, str(script), addr, str(pid),
+             str(barrier_r.port), ckpt_dir],
+            env=env,
+            cwd=repo,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("two-host shard topology timed out")
+    finally:
+        barrier_r.release()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"shard{pid} failed:\n{out[-3000:]}"
+        assert f"shard{pid} ok" in out, out[-3000:]
+
+
+# ---------------------------------------------------------------------
+# BENCH_SHARD leg.
+# ---------------------------------------------------------------------
+
+def test_shard_bench_leg_smoke():
+    """Tier-1 smoke of the BENCH_SHARD measurement contract: one tiny
+    real 2-shard leg, fields present and sane."""
+    import importlib
+    import os
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(root, "scripts"))
+    shb = importlib.import_module("shard_bench")
+
+    with time_limit(240, "shard bench smoke"):
+        leg = shb.shard_leg(
+            2, iters=4, parts_per_shard=1, actors_per_shard=1,
+            envs_per_actor=4, rollout_length=8,
+        )
+    assert leg["shards"] == 2
+    assert leg["aggregate_steps_per_sec"] > 0
+    assert leg["steps_per_batch"] == 2 * 1 * 4 * 8
+    assert 0.0 <= leg["barrier_wait_share"] <= 1.0
+    assert leg["learner_steps_per_sec"] > 0
+
+
+@pytest.mark.slow
+def test_shard_bench_full_leg_subprocess():
+    """The BENCH_SHARD=1 contract end-to-end: child-mode bench.py
+    prints exactly one JSON line with both legs, the speedup, the
+    barrier share, and the honesty flag."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_SHARD_ITERS"] = "8"
+    env["BENCH_SHARD_ENVS"] = "8"
+    env["BENCH_SHARD_ROLLOUT"] = "16"
+    child = subprocess.run(
+        [sys.executable, os.path.join(root, "bench.py"), "--measure-shard"],
+        capture_output=True,
+        text=True,
+        cwd=root,
+        timeout=600,
+        env=env,
+    )
+    assert child.returncode == 0, child.stderr[-3000:]
+    out = json.loads(child.stdout.strip().splitlines()[-1])
+    assert set(out["legs"]) == {"1", "2"}
+    assert out["aggregate_speedup"] > 0
+    assert 0.0 <= out["barrier_wait_share"] <= 1.0
+    assert isinstance(out["cpu_limited"], bool)
