@@ -239,6 +239,18 @@ class ImpalaConfig:
     # FRESHER weights than the last checkpoint (training state still
     # resumes from the checkpoint — optimizer state is not published).
     standby_tail_params: bool = True
+    # --- quorum control plane (N-standby election + fencing) ----------
+    # Override for the standby monitor's never-seen grace (seconds;
+    # 0 = the default 10x takeover deadline): how long a primary that
+    # has NEVER been reachable stays "not up yet" before its
+    # unreachability counts as death.
+    standby_never_seen_grace_s: float = 0.0
+    # Election probe bounds: when the primary is declared down, each
+    # standby probes every LOWER-ranked peer's early listener
+    # (connect + ping) — per-attempt timeout and attempt count. The
+    # lowest live rank wins; losers re-arm as its followers.
+    election_probe_timeout_s: float = 1.0
+    election_probe_attempts: int = 3
     # --- sharded learner (distributed.sharding) -----------------------
     # Data-parallel learner sharding: run shard_count independent
     # ingest stacks (each its own LearnerServer + TrajectoryQueue +
@@ -1754,6 +1766,15 @@ def _actor_process_main(
         if cfg.traj_codec else None
     )
     tdelta_ok = None
+    # Redundant redirector tier: ``port`` may be an ordered list of
+    # (host, port) endpoints instead of one port — the client then
+    # walks its priority list when a connect is refused, so losing a
+    # redirector costs one rotation, not the actor.
+    from actor_critic_algs_on_tensorflow_tpu.distributed.resilience import (
+        endpoint_list,
+    )
+
+    host, port, endpoints = endpoint_list(host, port)
     client = ResilientActorClient(
         host, port,
         retry=RetryPolicy(deadline_s=cfg.transport_retry_deadline_s),
@@ -1764,6 +1785,7 @@ def _actor_process_main(
             actor_id, generation, ROLE_ACTOR,
             CAP_TRAJ_CODED if cfg.traj_codec else 0,
         ),
+        endpoints=endpoints,
     )
     try:
         version, leaves = client.fetch_params()
@@ -1871,6 +1893,96 @@ def _actor_process_main(
             pass
 
 
+def _peer_epoch_knowledge(servers) -> int:
+    """Freshest fencing epoch any CONNECTED standby peer announced
+    (the hello frame's 5th field) across this standby's early
+    listeners. A REPLACEMENT standby that never observed the current
+    reign itself (fresh process; the primary died before its first
+    pong or tailed publish) would otherwise open a STALE epoch at
+    takeover — one the veteran followers' min_epoch already fences
+    out, freezing their tails for the whole reign. The veterans
+    re-arm behind the would-be winner within a heartbeat deadline
+    (well inside the replacement's never-seen grace), announcing
+    their believed epoch in their monitor/tailer hellos — so the
+    winner's takeover epoch is the max over its OWN observations and
+    everything its peers know."""
+    from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+        ROLE_STANDBY,
+    )
+
+    return max(
+        (
+            c["epoch"]
+            for s in servers
+            for c in s.connections()
+            if c["role"] == ROLE_STANDBY
+        ),
+        default=0,
+    )
+
+
+def _rehome_parked_actors(monitor, servers, halt, interval_s=2.0):
+    """While the monitored primary is HEALTHY (its pongs advancing),
+    periodically recycle ROLE_ACTOR links parked on the standby's
+    early listeners. An actor lands there by losing a connect race
+    against the primary's bind (its endpoint list walked past the
+    not-yet-listening primary) — and its pushes are absorbed and
+    DISCARDED there, so leaving it parked while the primary lives
+    starves the primary of that actor's slice at zero progress. The
+    recycled client retries its PRIORITY-ordered endpoints head-first
+    and re-homes. Goes quiet the moment pongs stop (primary down or
+    suspect): parked actors are then exactly where the failover wants
+    them, backoff already paid."""
+    last_pongs = monitor.pongs
+    while not halt.wait(interval_s):
+        pongs = monitor.pongs
+        # Freshness check at RECYCLE time, not just across the
+        # interval: a primary that ponged once early in the window
+        # and then died must not get its just-parked actors bounced
+        # (monitor.down may already be set by now). The residual race
+        # — death inside the window, down not yet declared — costs a
+        # recycled actor one refused head-connect and an immediate
+        # re-park, not its full paid-up backoff.
+        if (
+            pongs > last_pongs
+            and not monitor.down.is_set()
+            and not monitor.finished.is_set()
+        ):
+            for s in servers:
+                s.recycle_actor_connections()
+        last_pongs = pongs
+
+
+def _fenced_redirect(redirect, epoch: int, rank: int = 0):
+    """Wrap a takeover ``redirect(host, port)`` callback to carry the
+    new reign's fencing epoch — and this standby's rank — when the
+    callable can accept them (``epoch``/``rank`` keywords as on
+    ``Redirector.redirect``, or ``**kwargs``); legacy 2-arg callbacks
+    pass through unchanged. The epoch lets the redirector refuse a
+    deposed primary's later re-point; the rank breaks the tie when a
+    dual-win election round produces two takeovers at the SAME epoch
+    (the lower rank claims every redirector deterministically)."""
+    if redirect is None:
+        return None
+    import inspect
+
+    try:
+        params = inspect.signature(redirect).parameters
+        haskw = any(
+            p.kind == inspect.Parameter.VAR_KEYWORD
+            for p in params.values()
+        )
+        takes_epoch = "epoch" in params or haskw
+        takes_rank = "rank" in params or haskw
+    except (TypeError, ValueError):
+        takes_epoch = takes_rank = False
+    if not takes_epoch:
+        return redirect
+    if takes_rank:
+        return lambda h, p: redirect(h, p, epoch=epoch, rank=rank)
+    return lambda h, p: redirect(h, p, epoch=epoch)
+
+
 def _derive_wire_plan(programs: "ImpalaPrograms", params):
     """(traj treedef, ep treedef, ingest plan) for rebuilding pytrees
     from wire leaves — leaf ORDER is tree_flatten order on both sides;
@@ -1912,6 +2024,7 @@ def run_impala_distributed(
     wire_plan=None,
     server=None,
     shard=None,
+    epoch: int = 0,
 ) -> Tuple[LearnerState, List[Tuple[int, Dict[str, float]]]]:
     """IMPALA with actors in separate PROCESSES streaming trajectories
     through ``distributed.transport`` — the same topology that spans
@@ -1957,7 +2070,15 @@ def run_impala_distributed(
     standby's pre-takeover listener, with actors ALREADY connected to
     it) — its trajectory sink is swapped from the standby's discard
     mode onto this run's queue, so takeover starts consuming a live
-    stream instead of waiting out reconnects.
+    stream instead of waiting out reconnects. For a SHARDED takeover
+    (in-process shape) ``server`` is a LIST of pre-bound listeners,
+    one per ingest shard in shard order — each is adopted onto its
+    shard's queue; a dead listener in the list raises ``ShardDesync``
+    (a takeover that silently served N-1 shards would starve one
+    actor slice forever). ``epoch`` is the fencing epoch this learner
+    serves under (stamped into publish versions and pong tags; a
+    takeover passes the deposed reign + 1 so the old primary's late
+    frames are rejectable everywhere reign identity matters).
     """
     import multiprocessing as mp
 
@@ -2000,10 +2121,13 @@ def run_impala_distributed(
                 "sharded learner requires time_shards=1 (the batch "
                 "slices split the data axis only)"
             )
-        if server is not None or external_actors:
+        if shard.multihost and (server is not None or external_actors):
+            # The in-process shape CAN be taken over by a standby (it
+            # adopts every shard listener at once); a per-host shard
+            # cannot — one standby process is not N learner hosts.
             raise ValueError(
-                "sharded learner is incompatible with the standby "
-                "takeover hooks (server=/external_actors)"
+                "per-host sharded learner is incompatible with the "
+                "standby takeover hooks (server=/external_actors)"
             )
         # Fail loudly on bad topology before anything binds.
         shard.local_parts(cfg.batch_trajectories)
@@ -2139,15 +2263,43 @@ def run_impala_distributed(
             param_delta=cfg.param_delta,
             param_delta_ring=cfg.param_delta_ring,
             param_bf16=cfg.param_bf16_wire,
+            epoch=epoch,
         )
 
+    adopted = server is not None
     if server is not None:
-        # Adopt the pre-takeover listener: actors connected while the
-        # standby was absorbing (and discarding) their pushes now feed
-        # the real queue. The publish below bumps the version and
-        # notifies them, so everyone re-fetches from the new learner.
-        server.set_trajectory_sink(make_on_trajectory(queues[0]))
-        servers = [server]
+        # Adopt the pre-takeover listener(s): actors connected while
+        # the standby was absorbing (and discarding) their pushes now
+        # feed the real queue(s). The publish below bumps the version
+        # and notifies them, so everyone re-fetches from the new
+        # learner. A sharded takeover hands in one listener per shard
+        # (shard order); every one must still be alive — a silently
+        # dead listener would starve its actor slice forever, which
+        # is exactly the diverged-shard class ShardDesync names.
+        servers = (
+            list(server) if isinstance(server, (list, tuple))
+            else [server]
+        )
+        if len(servers) != n_stacks:
+            raise ValueError(
+                f"adopting {len(servers)} pre-bound listener(s) for "
+                f"{n_stacks} ingest shard(s) — the standby must "
+                f"pre-bind every shard's port"
+            )
+        dead = [j for j, s in enumerate(servers) if not s.alive]
+        if dead:
+            from actor_critic_algs_on_tensorflow_tpu.distributed.controlplane import (  # noqa: E501
+                ShardDesync,
+            )
+
+            raise ShardDesync(
+                f"takeover adoption: pre-bound shard listener(s) "
+                f"{dead} are dead — cannot serve every actor slice"
+            )
+        for j, s in enumerate(servers):
+            s.set_epoch(epoch)
+            s.set_trajectory_sink(make_on_trajectory(queues[j]))
+        server = servers[0]
     else:
         # One listener per ingest shard: the param plane (publishes,
         # delta encodes, notify broadcasts) and the trajectory receive
@@ -2444,9 +2596,21 @@ def run_impala_distributed(
         # metrics: disconnect/reconnect counts, per-actor liveness,
         # byte/frame totals (LearnerServer.metrics()) — plus the
         # serving tier's batch/latency counters in env_shim mode.
+        from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+            epoch_of,
+            version_seq,
+        )
+
         sm = _merged_server_metrics()
         return {
-            "param_version": server.version,
+            # The publish SEQUENCE within this reign (the human-scale
+            # counter); the fencing epoch rides separately when one is
+            # in force, instead of a 2^48-scale composite in the log.
+            "param_version": version_seq(server.version),
+            **(
+                {"param_epoch": epoch_of(server.version)}
+                if epoch_of(server.version) else {}
+            ),
             "actor_restarts": restarts,
             **sm,
             # Staleness at fetch in LEARNER STEPS (versions are
@@ -2552,6 +2716,19 @@ def run_impala_distributed(
                 treedef=treedef,
                 global_shapes=global_shapes,
                 shardings=shardings_leaves,
+                # The stitch join is the in-process analog of the
+                # multi-host step barrier: bound the straggler wait so
+                # a shard whose actor slice never feeds (diverged
+                # after a takeover, starved ingest) raises ShardDesync
+                # instead of hanging the learner. Armed immediately on
+                # a takeover adoption (that fleet was live moments
+                # ago); a cold start arms after the first full join so
+                # actor-compile skew cannot trip it.
+                desync_timeout_s=(
+                    cfg.shard_barrier_timeout_s
+                    if cfg.shard_step_barrier else None
+                ),
+                armed=adopted,
             )
 
     completed = False
@@ -2645,6 +2822,8 @@ def run_impala_standby(
     coordinator=None,
     on_ready=None,
     on_serving=None,
+    standby_id: int = 0,
+    peers: List[Tuple[str, int]] | None = None,
 ) -> Tuple[LearnerState, List[Tuple[int, Dict[str, float]]]] | None:
     """Warm-standby learner: wait, stay hot, take over on primary death.
 
@@ -2682,6 +2861,35 @@ def run_impala_standby(
         The reconnect-backoff term of the failover gap is paid before
         the failover, not inside it (PERF.md "Param data plane").
 
+    **Quorum mode** (``peers`` = the rank-ordered list of EVERY
+    standby's data-plane endpoint, ``standby_id`` = this one's rank):
+    on primary death the standbys elect — the lowest LIVE rank takes
+    over (``controlplane.StandbyElection``: each probes only the
+    ranks below its own at their early listeners), losers re-arm as
+    followers of the winner (monitor + param tail re-pointed at its
+    endpoint, checkpoint tail unchanged — the winner writes the same
+    shared dir) and keep the loop: if the winner later dies too, they
+    elect again. Every takeover bumps the FENCING EPOCH (learned from
+    the deposed primary's pong tags and publish versions, +1): the
+    new reign's publishes outrank the old one's, a loser's re-armed
+    param tail drops sub-epoch frames (``ParamTailer(min_epoch=)``),
+    and the redirect carries the epoch so a deposed primary's late
+    re-point is refused. Requires ``standby_serve_early`` (the peers
+    list IS the probe surface). Election knobs:
+    ``cfg.election_probe_timeout_s``/``election_probe_attempts``;
+    ``cfg.standby_never_seen_grace_s`` overrides the monitor grace.
+
+    **Sharded primary** (``cfg.shard_count > 1``, in-process shape):
+    the standby pre-binds ALL N per-shard listeners at start (ports
+    ``port..port+N-1``; each absorbs its slice's pushes and serves
+    the tailed params), tails shard 0's checkpoints plus the merged
+    param stream, and at takeover re-enters
+    ``run_impala_distributed(shard=)`` adopting every listener — a
+    dead one raises ``ShardDesync`` rather than silently starving an
+    actor slice, and the stitch join's straggler bound (armed
+    immediately on takeover) catches a shard whose slice never
+    reconnects.
+
     Returns ``None`` without taking over when the primary finishes
     cleanly (``KIND_CLOSE``) or ``stop_event`` fires first; otherwise
     returns the takeover run's ``(state, history)``. With
@@ -2692,20 +2900,37 @@ def run_impala_standby(
         CheckpointTailer,
         ParamTailer,
         PrimaryMonitor,
+        StandbyElection,
     )
     from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
         LearnerServer,
+        epoch_of,
     )
     from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
         donation_supported,
     )
 
-    if cfg.shard_count > 1:
+    n_stacks = max(1, cfg.shard_count)
+    if n_stacks > 1 and not cfg.standby_serve_early:
         raise ValueError(
-            "warm-standby failover is not yet supported for the "
-            "sharded learner (shard_count > 1): a standby would have "
-            "to take over every shard's listener at once"
+            "a sharded-learner standby requires standby_serve_early="
+            "True: the N per-shard takeover listeners must pre-bind "
+            "so every actor slice has somewhere to land"
         )
+    quorum = peers is not None and len(peers) > 1
+    election = None
+    if quorum:
+        if not cfg.standby_serve_early:
+            raise ValueError(
+                "quorum standbys require standby_serve_early=True "
+                "(peers are probed at their early listeners)"
+            )
+        election = StandbyElection(
+            standby_id, peers,
+            probe_timeout_s=cfg.election_probe_timeout_s,
+            probe_attempts=cfg.election_probe_attempts,
+        )
+    _slog = lambda msg: print(f"[standby-{standby_id}] {msg}", flush=True)
     programs = make_impala(cfg)
     template = jax.eval_shape(programs.init, jax.random.PRNGKey(cfg.seed))
     # Wire treedefs + ingest plan derived NOW (eval_shape traces): the
@@ -2750,162 +2975,309 @@ def run_impala_standby(
         del warm_state, warm_batch, out, arena
         print("[standby] learner programs compiled (warm)", flush=True)
 
-    # Early data plane: bind the takeover listener NOW so actors that
-    # lose the primary land here (via the redirector's fallback route)
-    # and pay their reconnect before the failover. Pushes are absorbed
-    # (ACKed, dropped — the primary is consuming the real stream);
-    # fetches serve whatever the param tailer has re-published.
-    early_server = None
+    # Early data plane: bind the takeover listener(s) NOW so actors
+    # that lose the primary land here (via the redirector's fallback
+    # route) and pay their reconnect before the failover. Pushes are
+    # absorbed (ACKed, dropped — the primary is consuming the real
+    # stream); fetches serve whatever the param tailer has
+    # re-published. A sharded primary gets one listener PER SHARD
+    # (port..port+N-1), each parking its own actor slice — and these
+    # listeners double as the election's probe surface: a quorum peer
+    # that answers pings here is alive.
+    early_servers: List[Any] = []
     ptailer = None
     if cfg.standby_serve_early:
-        early_server = LearnerServer(
-            lambda traj_leaves, ep_leaves: True,
-            host=host,
-            port=port,
-            idle_timeout_s=cfg.transport_idle_timeout_s,
-            max_frame_bytes=cfg.transport_max_frame_mb << 20,
-            param_delta=cfg.param_delta,
-            param_delta_ring=cfg.param_delta_ring,
-            param_bf16=cfg.param_bf16_wire,
-            log=lambda msg: print(f"[standby-server] {msg}", flush=True),
-        )
-        port = early_server.port
+        try:
+            for j in range(n_stacks):
+                early_servers.append(LearnerServer(
+                    lambda traj_leaves, ep_leaves: True,
+                    host=host,
+                    port=port if port == 0 else port + j,
+                    idle_timeout_s=cfg.transport_idle_timeout_s,
+                    max_frame_bytes=cfg.transport_max_frame_mb << 20,
+                    param_delta=cfg.param_delta,
+                    param_delta_ring=cfg.param_delta_ring,
+                    param_bf16=cfg.param_bf16_wire,
+                    log=(lambda tag: lambda msg: print(
+                        f"[{tag}] {msg}", flush=True
+                    ))(f"standby-{standby_id}-server{j}"),
+                ))
+        except BaseException:
+            # A failed bind for shard j must not leak listeners
+            # 0..j-1 — the supervisor's retry would hit "Address
+            # already in use" on the --learner-bind rebind.
+            for s in early_servers:
+                s.close()
+            raise
+        port = early_servers[0].port
         if on_serving is not None:
-            on_serving(host, early_server.port)
+            try:
+                for s in early_servers:
+                    on_serving(host, s.port)
+            except BaseException:
+                # A raising caller hook must not leak the bound
+                # listeners either (same EADDRINUSE-on-retry reasoning
+                # as the bind loop above).
+                for s in early_servers:
+                    s.close()
+                raise
+
+    def _republish(version, leaves):
+        # Tail -> every early listener, stamped with the REIGN the
+        # tailed publish came from, so parked actors fetch weights
+        # whose version already carries the right fencing epoch.
+        e = epoch_of(version)
+        for s in early_servers:
+            s.set_epoch(e)
+            s.publish(leaves)
+
+    def _make_ptailer(phost, pport, min_epoch):
+        return ParamTailer(
+            phost, pport,
+            standby_id=standby_id,
+            min_epoch=min_epoch,
+            poll_interval_s=max(heartbeat_interval_s, 0.25),
+            on_params=_republish if early_servers else None,
+        )
+
+    # The election loop. One round = watch the current primary until
+    # an outcome; on death, elect (quorum mode): the winner exits the
+    # loop into takeover, a loser re-points its monitor + param tail
+    # at the winner and goes around again — so a later death of the
+    # winner re-elects, N-1 deep, with the fencing epoch marching up
+    # by one per reign.
+    cur_host, cur_port = primary_host, primary_port
+    min_epoch = 0       # lowest reign this standby accepts as current
+    seen_epoch = 0      # freshest reign actually observed
+    grace = cfg.standby_never_seen_grace_s or None
+    tailer = None
+    outcome = None
     try:
         if cfg.standby_tail_params:
-            ptailer = ParamTailer(
-                primary_host, primary_port,
-                poll_interval_s=max(heartbeat_interval_s, 0.25),
-                on_params=(
-                    (lambda v, leaves: early_server.publish(leaves))
-                    if early_server is not None
-                    else None
-                ),
-            )
-
-        tailer = CheckpointTailer(checkpointer, template)
-        monitor = PrimaryMonitor(
-            primary_host, primary_port,
-            interval_s=heartbeat_interval_s,
-            deadline_s=takeover_deadline_s,
+            ptailer = _make_ptailer(cur_host, cur_port, min_epoch)
+        tailer = CheckpointTailer(
+            checkpointer, template, standby_id=standby_id
         )
+        while True:
+            monitor = PrimaryMonitor(
+                cur_host, cur_port,
+                interval_s=heartbeat_interval_s,
+                deadline_s=takeover_deadline_s,
+                never_seen_grace_s=grace,
+                standby_id=standby_id,
+                epoch=min_epoch,
+                log=_slog,
+            )
+            nudge_halt = threading.Event()
+            nudger = None
+            if early_servers:
+                # Re-home actors parked on the early (discard)
+                # listeners while the primary is demonstrably alive —
+                # see _rehome_parked_actors.
+                nudger = threading.Thread(
+                    target=_rehome_parked_actors,
+                    args=(monitor, early_servers, nudge_halt),
+                    name="standby-rehome-nudge", daemon=True,
+                )
+                nudger.start()
+            try:
+                if on_ready is not None:
+                    on_ready(monitor)
+                outcome = monitor.wait_outcome(stop_event=stop_event)
+            finally:
+                nudge_halt.set()
+                monitor.close()
+                if nudger is not None:
+                    nudger.join(timeout=3.0)
+            # The reign a takeover would succeed: the freshest epoch
+            # seen on the primary's pongs or its publish stream — or
+            # announced by any standby PEER parked on our listeners
+            # (the replacement-standby case: see
+            # _peer_epoch_knowledge).
+            seen_epoch = max(
+                seen_epoch,
+                min_epoch,
+                monitor.epoch_seen,
+                epoch_of(ptailer.newest()[0]) if ptailer is not None
+                else 0,
+                _peer_epoch_knowledge(early_servers),
+            )
+            if outcome != "down":
+                break  # finished / stopped: stand down, no takeover
+            if election is not None:
+                winner = election.elect(stop_event)
+                if stop_event is not None and stop_event.is_set():
+                    outcome = None
+                    break
+                if winner != standby_id:
+                    # Lost: re-arm as a follower of the winner. Its
+                    # reign will be seen_epoch + 1, so anything older
+                    # arriving on the re-pointed param tail is a
+                    # deposed primary's late frame — fenced, counted,
+                    # never recorded or republished.
+                    cur_host, cur_port = peers[winner]
+                    min_epoch = seen_epoch + 1
+                    if ptailer is not None:
+                        ptailer.close()
+                        ptailer = _make_ptailer(
+                            cur_host, cur_port, min_epoch
+                        )
+                    _slog(
+                        f"following elected rank {winner} at "
+                        f"{cur_host}:{cur_port} (fencing epoch >= "
+                        f"{min_epoch}); checkpoint tail unchanged — "
+                        f"it writes the same shared dir"
+                    )
+                    continue
+            break  # down, and this standby won (or runs solo)
     except BaseException:
-        # Nothing below ever runs: release the early listener (a
+        # Nothing below ever runs: release the early listeners (a
         # supervisor's retry would otherwise hit "Address already in
-        # use" on the --learner-bind rebind) and stop the tail thread.
-        if ptailer is not None:
-            ptailer.close()
-        if early_server is not None:
-            early_server.close()
-        raise
-    try:
-        if on_ready is not None:
-            on_ready(monitor)
-        outcome = monitor.wait_outcome(stop_event=stop_event)
-    except BaseException:
-        if early_server is not None:
-            early_server.close()
+        # use" on the --learner-bind rebind) and stop the tails.
+        for s in early_servers:
+            s.close()
         raise
     finally:
-        monitor.close()
         # One last synchronous poll: the primary's dying save (the
         # preemption path writes one final checkpoint) may have landed
-        # between our last poll and its death.
-        tailer.close(final_poll=True)
-        # The param tail likewise stops at the outcome: its newest()
-        # is frozen at the last publish the primary ever made.
+        # between our last poll and its death. The param tail likewise
+        # stops here: its newest() is frozen at the last publish the
+        # (accepted-reign) primary ever made.
+        if tailer is not None:
+            tailer.close(final_poll=True)
         if ptailer is not None:
             ptailer.close()
     if outcome != "down":
-        if early_server is not None:
-            early_server.close()
-        print(
-            f"[standby] no takeover "
-            f"({outcome or 'stopped before any outcome'})",
-            flush=True,
+        for s in early_servers:
+            s.close()
+        _slog(
+            f"no takeover ({outcome or 'stopped before any outcome'})"
         )
         return None
 
-    step_id, state = tailer.newest()
-    tailed_version, tailed_leaves = (
-        ptailer.newest() if ptailer is not None else (0, None)
-    )
-    # Graft only when the publish stream is actually the fresher
-    # source, ordered by CONTENT time (checkpoint = writer's dir
-    # mtime, publish = fetch arrival): publishes ride every learner
-    # step while checkpoints land every interval, so the last publish
-    # is normally newer — but a param-tail outage (reconnect window)
-    # or a dying save that outran the severed tail means the
-    # checkpoint's params are at least as new, and grafting the stale
-    # tail over them would silently REGRESS the weights.
-    if tailed_leaves is not None and state is not None and (
-        ptailer.newest_seen_t <= tailer.newest_seen_t
-    ):
-        print(
-            f"[standby] tailed params version {tailed_version} "
-            f"predate the newest checkpoint (step {step_id}); using "
-            f"the checkpoint's params",
-            flush=True,
+    try:
+        step_id, state = tailer.newest()
+        # Completion check BEFORE any takeover: a primary that finished
+        # its whole budget and exited looks exactly like a crashed one to
+        # the liveness monitor whenever the orderly KIND_CLOSE is lost to
+        # a wire race (a crossing ping against the closing socket RSTs
+        # the frame away). The job's ARTIFACTS are race-free: if the
+        # tailed checkpoint already covers every trainable step, there is
+        # nothing to take over — stand down. (Without this, a quorum
+        # cascades: each standby would "take over" the finished job,
+        # instantly finish, close, and hand the same race to the next.)
+        spb_ = (
+            cfg.batch_trajectories * cfg.envs_per_actor * cfg.rollout_length
         )
-        tailed_leaves = None
-    if tailed_leaves is not None:
-        # Graft the freshest PUBLISHED weights onto the restored
-        # training state: params advance every publish (usually every
-        # learner step), checkpoints every checkpoint_interval — the
-        # takeover learner and the fleet resume from weights newer
-        # than any checkpoint. Optimizer state and the step counter
-        # still come from the checkpoint (they are never published).
-        if state is None:
-            state = programs.init(jax.random.PRNGKey(cfg.seed))
-        params = jax.tree_util.tree_unflatten(
-            jax.tree_util.tree_structure(template.params),
-            [np.asarray(x) for x in tailed_leaves],
-        )
-        state = state.replace(
-            params=jax.device_put(
-                params, NamedSharding(programs.mesh, P())
+        # max(1, ...): the learner loop always trains at least one
+        # step from a fresh state (same rule as num_learner_steps),
+        # so a sub-batch total_env_steps must not round the finish
+        # line to 0 — a step-0 interrupted save would then read as
+        # "finished" and nobody would ever take the job over.
+        budget = max(1, cfg.total_env_steps // spb_) * spb_
+        if step_id is not None and step_id >= budget:
+            for s in early_servers:
+                s.close()
+            _slog(
+                f"tailed checkpoint step {step_id} already covers the "
+                f"{budget}-env-step budget — training finished; standing "
+                f"down instead of taking over"
             )
+            return None
+        tailed_version, tailed_leaves = (
+            ptailer.newest() if ptailer is not None else (0, None)
         )
-    if early_server is not None:
-        absorbed = early_server.metrics()["transport_trajectories"]
+        # Graft only when the publish stream is actually the fresher
+        # source, ordered by CONTENT time (checkpoint = writer's dir
+        # mtime, publish = fetch arrival): publishes ride every learner
+        # step while checkpoints land every interval, so the last publish
+        # is normally newer — but a param-tail outage (reconnect window)
+        # or a dying save that outran the severed tail means the
+        # checkpoint's params are at least as new, and grafting the stale
+        # tail over them would silently REGRESS the weights.
+        if tailed_leaves is not None and state is not None and (
+            ptailer.newest_seen_t <= tailer.newest_seen_t
+        ):
+            _slog(
+                f"tailed params version {tailed_version} predate the "
+                f"newest checkpoint (step {step_id}); using the "
+                f"checkpoint's params"
+            )
+            tailed_leaves = None
+        if tailed_leaves is not None:
+            # Graft the freshest PUBLISHED weights onto the restored
+            # training state: params advance every publish (usually every
+            # learner step), checkpoints every checkpoint_interval — the
+            # takeover learner and the fleet resume from weights newer
+            # than any checkpoint. Optimizer state and the step counter
+            # still come from the checkpoint (they are never published).
+            if state is None:
+                state = programs.init(jax.random.PRNGKey(cfg.seed))
+            params = jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(template.params),
+                [np.asarray(x) for x in tailed_leaves],
+            )
+            state = state.replace(
+                params=jax.device_put(
+                    params, NamedSharding(programs.mesh, P())
+                )
+            )
+        absorbed = sum(
+            s.metrics()["transport_trajectories"] for s in early_servers
+        )
         if absorbed:
-            print(
-                f"[standby] absorbed {absorbed} pre-takeover "
-                f"trajectory pushes (discarded; backoff already paid)",
-                flush=True,
+            _slog(
+                f"absorbed {absorbed} pre-takeover trajectory pushes "
+                f"(discarded; backoff already paid)"
             )
-    print(
-        f"[standby] TAKEOVER ({monitor.reason}): "
-        + (
-            f"resuming from tailed checkpoint step {step_id} "
-            f"(already restored in memory)"
-            if step_id is not None
-            else "no checkpoint ever landed; starting from init"
+        # Fencing: this takeover opens reign seen_epoch + 1. Every publish
+        # the new primary makes (and its pong tags) carries it; the
+        # redirect below carries it too, so a deposed primary's late
+        # re-point loses to this one no matter the arrival order.
+        new_epoch = seen_epoch + 1
+        _slog(
+            f"TAKEOVER ({monitor.reason}) at fencing epoch {new_epoch}: "
+            + (
+                f"resuming from tailed checkpoint step {step_id} "
+                f"(already restored in memory)"
+                if step_id is not None
+                else "no checkpoint ever landed; starting from init"
+            )
+            + (
+                f" + tailed params version {tailed_version} (fresher than "
+                f"the checkpoint)"
+                if tailed_leaves is not None
+                else ""
+            )
+            + (f" adopting {n_stacks} shard listeners" if n_stacks > 1 else "")
         )
-        + (
-            f" + tailed params version {tailed_version} (fresher than "
-            f"the checkpoint)"
-            if tailed_leaves is not None
-            else ""
-        ),
-        flush=True,
-    )
-    return run_impala_distributed(
-        cfg,
-        log_interval=log_interval,
-        log_fn=log_fn,
-        summary_writer=summary_writer,
-        checkpointer=checkpointer,
-        checkpoint_interval=checkpoint_interval,
-        initial_state=state,
-        host=host,
-        port=port,
-        stop_event=stop_event,
-        programs=programs,
-        external_actors=not spawn_actors,
-        on_server_start=redirect,
-        coordinator=coordinator,
-        wire_plan=wire_plan,
-        server=early_server,
-    )
+        return run_impala_distributed(
+            cfg,
+            log_interval=log_interval,
+            log_fn=log_fn,
+            summary_writer=summary_writer,
+            checkpointer=checkpointer,
+            checkpoint_interval=checkpoint_interval,
+            initial_state=state,
+            host=host,
+            port=port,
+            stop_event=stop_event,
+            programs=programs,
+            external_actors=not spawn_actors,
+            on_server_start=_fenced_redirect(redirect, new_epoch, standby_id),
+            coordinator=coordinator,
+            wire_plan=wire_plan,
+            server=early_servers if early_servers else None,
+            epoch=new_epoch,
+        )
+    except BaseException:
+        # The takeover prologue (graft) or the takeover call's
+        # own validation raised BEFORE run_impala_distributed's
+        # teardown could own the adopted listeners: release
+        # them here (close is idempotent, so a post-adoption
+        # failure whose finally already closed them is fine) —
+        # a supervisor retry must not hit "Address already in
+        # use".
+        for s in early_servers:
+            s.close()
+        raise

@@ -421,7 +421,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "standby_serve_early= (pre-takeover listener + "
                         "redirector fallback) standby_tail_params= "
                         "(follow the primary's publishes, not just its "
-                        "checkpoints)")
+                        "checkpoints). Election/fencing knobs: --set "
+                        "standby_never_seen_grace_s= (0 = 10x the "
+                        "takeover deadline) election_probe_timeout_s= "
+                        "election_probe_attempts=. A sharded primary "
+                        "(--set shard_count=N, in-process shape) makes "
+                        "the standby pre-bind all N per-shard "
+                        "listeners and adopt them at takeover")
+    p.add_argument("--standby-rank", type=int, default=0, metavar="K",
+                   help="with --standby: this standby's rank in the "
+                        "quorum (lowest live rank wins the election; "
+                        "index into --standby-peers)")
+    p.add_argument("--standby-peers", default=None,
+                   metavar="H:P[,H:P...]",
+                   help="with --standby: the rank-ordered data-plane "
+                        "endpoints of EVERY standby (rank K = K-th "
+                        "entry = that standby's --learner-bind / early "
+                        "listener). Enables the N-standby election: on "
+                        "primary death the lowest live rank takes "
+                        "over, the rest re-arm as its followers, and a "
+                        "fencing epoch makes the deposed primary's "
+                        "late publishes/redirects rejectable. The "
+                        "redirector's fallback route becomes this "
+                        "whole list (walked in rank order)")
     p.add_argument("--redirector", default=None, metavar="[HOST:]PORT",
                    help="with --standby: also run the actor-facing "
                         "redirector (actors connect here, never to a "
@@ -824,6 +846,60 @@ def _run_standby(args, cfg, writer, coordinator) -> int:
         )
     phost, pport = parse_hostport(args.standby, "--standby")
     host, port = parse_bind(args.learner_bind)
+    # Quorum mode: the rank-ordered endpoint list of EVERY standby's
+    # data plane (rank = list index). One entry (or none) = the
+    # legacy single-standby pair.
+    peers = None
+    if args.standby_peers:
+        peers = [
+            parse_hostport(s.strip(), "--standby-peers")
+            for s in args.standby_peers.split(",")
+            if s.strip()
+        ]
+        if not peers:
+            raise SystemExit("--standby-peers: empty endpoint list")
+        if not 0 <= args.standby_rank < len(peers):
+            raise SystemExit(
+                f"--standby-rank {args.standby_rank} outside the "
+                f"{len(peers)}-entry --standby-peers list"
+            )
+    elif args.standby_rank:
+        raise SystemExit(
+            "--standby-rank needs --standby-peers (the rank indexes "
+            "that list)"
+        )
+    if args.redirector is not None and cfg.shard_count > 1:
+        raise SystemExit(
+            "--redirector supports single-stack standbys only: one "
+            "redirector has one target, so with shard_count > 1 its "
+            "last-wins re-point would route EVERY through-redirector "
+            "actor to shard N-1 and starve the other slices. Give the "
+            "actors per-shard priority endpoint lists instead (or "
+            "wire one redirector per shard programmatically)"
+        )
+    if peers is not None and port != peers[args.standby_rank][1]:
+        # The peers list IS the probe surface: elections and the
+        # redirector fallback walk ask peers[rank], so a standby
+        # whose listener binds anywhere else (the default is an
+        # EPHEMERAL port) is "dead" to every peer while alive to
+        # itself — on its election round that is a guaranteed dual
+        # primary at one epoch.
+        raise SystemExit(
+            f"--learner-bind must pin this standby's own "
+            f"--standby-peers entry (rank {args.standby_rank} = "
+            f"{peers[args.standby_rank][0]}:"
+            f"{peers[args.standby_rank][1]}, got port "
+            f"{port or 'ephemeral'}): the election and the redirector "
+            f"fallbacks probe the peers list, so an unmatched bind is "
+            f"an unreachable standby"
+        )
+    if cfg.shard_count > 1 and port == 0:
+        raise SystemExit(
+            "a sharded standby needs an explicit --learner-bind "
+            "port: its N shard listeners bind port..port+N-1 — the "
+            "contract actor endpoint lists rely on — and ephemeral "
+            "ports land anywhere"
+        )
     checkpointer = Checkpointer(args.checkpoint_dir)
     redirector = None
     redirect = None
@@ -850,9 +926,13 @@ def _run_standby(args, cfg, writer, coordinator) -> int:
             flush=True,
         )
 
-        def redirect(h, p):
+        def redirect(h, p, epoch=None, rank=None):
+            # The takeover path passes its fencing epoch (and rank)
+            # so a deposed — or equal-epoch outranked — primary's
+            # later re-point is refused by the redirector.
             redirector.redirect(
-                "127.0.0.1" if h in ("0.0.0.0", "") else h, p
+                "127.0.0.1" if h in ("0.0.0.0", "") else h, p,
+                epoch=epoch, rank=rank,
             )
 
     def on_serving(h, p):
@@ -868,7 +948,14 @@ def _run_standby(args, cfg, writer, coordinator) -> int:
             flush=True,
         )
         if redirector is not None:
-            redirector.set_fallback(h, p)
+            if peers is not None:
+                # Quorum: the fallback route is the WHOLE rank-ordered
+                # standby list — walked front to back, it lands actors
+                # on the lowest live rank, the same host the election
+                # elects, even before any explicit re-point arrives.
+                redirector.set_fallbacks(peers)
+            else:
+                redirector.set_fallback(h, p)
 
     shutdown = None
     if args.preempt_save:
@@ -888,6 +975,8 @@ def _run_standby(args, cfg, writer, coordinator) -> int:
             stop_event=shutdown.event if shutdown is not None else None,
             coordinator=coordinator,
             on_serving=on_serving,
+            standby_id=args.standby_rank,
+            peers=peers,
         )
     finally:
         if shutdown is not None:
@@ -932,6 +1021,10 @@ def _run(args, algo, cfg, writer) -> int:
         )
     if args.redirector is not None and not args.standby:
         raise SystemExit("--redirector requires --standby")
+    if (args.standby_rank or args.standby_peers) and not args.standby:
+        raise SystemExit(
+            "--standby-rank/--standby-peers require --standby"
+        )
     if args.shard is not None and algo != "impala":
         raise SystemExit("--shard is impala-only (the sharded learner)")
     if args.eval:

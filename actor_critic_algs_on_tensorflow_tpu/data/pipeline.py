@@ -537,13 +537,22 @@ class LearnerPipeline:
 
     # -- consumer side --------------------------------------------------
 
-    def get(self, timeout: float = 0.5, stop: Optional[threading.Event] = None):
+    def get(
+        self,
+        timeout: float = 0.5,
+        stop: Optional[threading.Event] = None,
+        max_wait_s: Optional[float] = None,
+    ):
         """Next ``(batch, eps, handle)``; blocks until one is staged.
         Raises whatever the prefetch thread raised (health-check
         failures included). With ``stop`` given, returns ``None`` once
         it is set and nothing is staged — a preemption mid-batch-wait
         (actors likely killed by the same signal) must not hang the
-        shutdown path forever."""
+        shutdown path forever. With ``max_wait_s``, a wait that
+        exceeds it raises ``TimeoutError`` instead of blocking on —
+        the sharded stitcher's straggler bound (``ShardedIngest``
+        turns it into a loud ``ShardDesync``); plain consumers never
+        pass it and keep the block-forever contract."""
         t0 = time.perf_counter()
         while True:
             if self._error is not None:
@@ -557,6 +566,13 @@ class LearnerPipeline:
                     return None
                 if self._closed.is_set() and self._error is None:
                     raise RuntimeError("pipeline closed while waiting")
+                if (
+                    max_wait_s is not None
+                    and time.perf_counter() - t0 > max_wait_s
+                ):
+                    raise TimeoutError(
+                        f"no batch staged within {max_wait_s:.1f}s"
+                    )
 
     def mark_consumed(self, handle, token) -> None:
         """Release the arena slot behind ``handle`` once ``token`` (an

@@ -30,6 +30,15 @@ plane:
     production redirection primitive) and resets live links so
     in-flight connections fail over immediately instead of waiting
     out their idle deadlines.
+  - ``StandbyElection`` — the quorum layer above the monitor: N
+    standbys hold one rank-ordered endpoint list; on primary death the
+    lowest LIVE rank wins the takeover (each standby probes only the
+    ranks below its own), losers re-arm as followers of the winner,
+    and a fencing epoch — stamped by the primary into publish versions
+    and pong tags (``transport.EPOCH_SHIFT``), bumped at every
+    takeover — makes a deposed primary's late publishes and re-points
+    rejectable (``ParamTailer(min_epoch=)``, ``Redirector.redirect(
+    epoch=)``): no split brain survives an election.
   - ``PreemptionLeader``/``PreemptionFollower`` — SIGTERM consensus
     for multi-host learner jobs: every host reports its local step,
     the leader broadcasts ONE agreed stop step (the max), each host
@@ -73,6 +82,7 @@ from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
     KIND_STOP_STEP,
     ROLE_STANDBY,
     LearnerShutdown,
+    epoch_of,
     recv_msg,
     send_msg,
 )
@@ -85,6 +95,7 @@ __all__ = [
     "PrimaryMonitor",
     "Redirector",
     "ShardDesync",
+    "StandbyElection",
 ]
 
 
@@ -106,13 +117,79 @@ class Redirector(ChaosProxy):
     and (by default) live links are reset so actors already blocked on
     the dead primary reconnect NOW instead of waiting out a heartbeat
     idle window (their resilient clients treat the reset as an
-    ordinary transport fault and re-push)."""
+    ordinary transport fault and re-push).
+
+    Fencing (quorum control plane): a redirect may carry the caller's
+    fencing epoch (and rank). The redirector remembers the highest
+    (epoch, inverse-rank) it was ever pointed by and REFUSES
+    re-points from lower reigns — a deposed primary that wakes up
+    late and tries to pull the fleet back to itself is rejected
+    instead of splitting the brain. The RANK tiebreak covers the
+    rare dual-win round (two standbys whose mutual probes failed both
+    take over, deriving the SAME epoch): the lower rank — the
+    election's legitimate winner — claims every redirector it can
+    reach, deterministically, so the fleet converges on one primary
+    even then (the outranked winner just starves). The epoch check
+    and the re-point are ONE atomic step under the lock, so a racing
+    lower-reign redirect can never land its target after a
+    higher-reign one passed the check. Epoch-less redirects (legacy
+    callers, chaos tests) bypass the fence."""
+
+    # Fencing state (class defaults — ChaosProxy.__init__ is reused
+    # untouched; instance writes shadow these). epoch_rank is the
+    # rank that set the current epoch (-1 = unknown/legacy holder:
+    # highest priority, never displaced by an equal epoch).
+    epoch: int = 0
+    epoch_rank: int = -1
+    stale_redirects: int = 0
 
     def redirect(
-        self, host: str, port: int, *, reset_existing: bool = True
+        self,
+        host: str,
+        port: int,
+        *,
+        reset_existing: bool = True,
+        epoch: int | None = None,
+        rank: int | None = None,
     ) -> int:
         """Point new connections at ``host:port``; returns how many
-        live links were reset over to it."""
+        live links were reset over to it, or ``-1`` when the redirect
+        was REFUSED: ``epoch`` is below the reign this redirector is
+        already pointed by — or equal to it from a HIGHER rank (the
+        dual-win tiebreak)."""
+        if epoch is not None:
+            with self._lock:
+                r = -1 if rank is None else int(rank)
+                if epoch > self.epoch:
+                    accept = True          # a newer reign
+                elif epoch < self.epoch:
+                    accept = False         # a deposed reign
+                elif r == self.epoch_rank:
+                    accept = True          # the same winner re-points
+                elif r < 0 or self.epoch_rank < 0:
+                    accept = False         # unordered ranks: first wins
+                else:
+                    accept = r < self.epoch_rank  # dual-win tiebreak
+                if accept:
+                    self.epoch, self.epoch_rank = epoch, r
+                    # Atomic with the check: the target swap must not
+                    # escape the lock, or a racing stale redirect
+                    # could apply its target AFTER losing the fence.
+                    self._target = (host, port)
+                    refused = None
+                else:
+                    self.stale_redirects += 1
+                    refused = (self.epoch, self.epoch_rank)
+            if refused is not None:
+                print(
+                    f"[redirector] REFUSED redirect to {host}:{port} "
+                    f"(fencing epoch {epoch}/rank {rank} loses to "
+                    f"current {refused[0]}/rank {refused[1]} — a "
+                    f"deposed or outranked primary's re-point)",
+                    flush=True,
+                )
+                return -1
+            return self.reset_all() if reset_existing else 0
         self.set_target(host, port)
         return self.reset_all() if reset_existing else 0
 
@@ -150,6 +227,7 @@ class PrimaryMonitor(threading.Thread):
         deadline_s: float = 3.0,
         never_seen_grace_s: float | None = None,
         standby_id: int = 0,
+        epoch: int = 0,
         log: Callable[[str], None] | None = None,
     ):
         super().__init__(name="primary-monitor", daemon=True)
@@ -162,6 +240,7 @@ class PrimaryMonitor(threading.Thread):
             else never_seen_grace_s
         )
         self._standby_id = standby_id
+        self._epoch = int(epoch)
         self._log = log if log is not None else (
             lambda msg: print(f"[standby] {msg}", flush=True)
         )
@@ -169,6 +248,10 @@ class PrimaryMonitor(threading.Thread):
         self.finished = threading.Event()
         self.reason: str = ""
         self.pongs = 0
+        # Fencing epoch of the monitored primary, learned from its
+        # pong tags (high bits): the reign a takeover would succeed.
+        # Stays at the constructor's belief until the first pong.
+        self.epoch_seen = int(epoch)
         self._halt = threading.Event()
         self.start()
 
@@ -191,10 +274,15 @@ class PrimaryMonitor(threading.Thread):
                             self._addr, timeout=self._interval
                         )
                         seen_alive = True
+                        # [actor_id, generation, role, caps, epoch]:
+                        # the standby announces the reign it believes
+                        # current, so the primary's registry shows
+                        # each standby's fencing knowledge.
                         send_msg(
                             sock, KIND_HELLO, 0,
                             [np.asarray(
-                                [self._standby_id, 0, ROLE_STANDBY],
+                                [self._standby_id, 0, ROLE_STANDBY,
+                                 0, self._epoch],
                                 np.int64,
                             )],
                         )
@@ -240,10 +328,15 @@ class PrimaryMonitor(threading.Thread):
                     # A peer silent past the deadline is down anyway.
                     sock.settimeout(max(self._interval, self._deadline))
                     send_msg(sock, KIND_PING)
-                    kind, _, _ = recv_msg(sock)
+                    kind, tag, _ = recv_msg(sock)
                     last_alive = time.monotonic()
                     if kind == KIND_PONG:
                         self.pongs += 1
+                        # The pong tag's high bits carry the primary's
+                        # fencing epoch (legacy primaries send 0).
+                        self.epoch_seen = max(
+                            self.epoch_seen, epoch_of(tag)
+                        )
                     elif kind == KIND_CLOSE:
                         self.reason = "primary finished (KIND_CLOSE)"
                         self.finished.set()
@@ -297,6 +390,104 @@ class PrimaryMonitor(threading.Thread):
         self.join(timeout=2.0 + self._interval)
 
 
+class StandbyElection:
+    """Rank-ordered election among N standbys: the lowest LIVE rank
+    wins the takeover.
+
+    Every standby holds the same ordered list of standby data-plane
+    endpoints (rank r = ``peers[r]`` — its early, pre-takeover
+    listener, which answers ``KIND_PING`` from process start). When
+    the primary is declared down, each standby probes every rank
+    BELOW its own: the first live one is the winner and this standby
+    re-arms as its follower; if none answers, this standby IS the
+    lowest live rank and takes over. No ballot exchange is needed —
+    the rank order is the ballot, agreed at deploy time, and the
+    probe set is strictly nested (rank k probes a prefix of what
+    rank k+1 probes), so two standbys can only elect different
+    winners if a peer died BETWEEN their probes — in which case the
+    losers' re-armed monitors (watching the winner they chose)
+    re-elect within a heartbeat deadline, and the fencing epoch on
+    publishes/redirects keeps any transient double-primary's frames
+    rejectable meanwhile.
+
+    Probes are bounded (``probe_timeout_s`` per attempt,
+    ``probe_attempts`` attempts with a short breather) so one slow
+    peer delays, never wedges, the election."""
+
+    def __init__(
+        self,
+        rank: int,
+        peers: List[Tuple[str, int]],
+        *,
+        probe_timeout_s: float = 1.0,
+        probe_attempts: int = 3,
+        log: Callable[[str], None] | None = None,
+    ):
+        if not 0 <= int(rank) < len(peers):
+            raise ValueError(
+                f"standby rank {rank} outside the {len(peers)}-peer list"
+            )
+        self.rank = int(rank)
+        self.peers = [(h, int(p)) for h, p in peers]
+        self._timeout = probe_timeout_s
+        self._attempts = max(1, int(probe_attempts))
+        self._log = log if log is not None else (
+            lambda msg: print(f"[standby-{rank}] {msg}", flush=True)
+        )
+
+    def _peer_alive(
+        self, host: str, port: int,
+        stop_event: threading.Event | None,
+    ) -> bool:
+        """One bounded liveness probe: connect + ping the peer's
+        early listener. Any reply frame proves liveness except an
+        orderly ``KIND_CLOSE`` (the peer is shutting down — it will
+        not take over)."""
+        for attempt in range(self._attempts):
+            if stop_event is not None and stop_event.is_set():
+                return False
+            sock = None
+            try:
+                sock = socket.create_connection(
+                    (host, port), timeout=self._timeout
+                )
+                sock.settimeout(self._timeout)
+                send_msg(sock, KIND_PING)
+                kind, _, _ = recv_msg(sock)
+                return kind != KIND_CLOSE
+            except (socket.timeout, ConnectionError, OSError):
+                if attempt + 1 < self._attempts:
+                    time.sleep(min(0.05 * (attempt + 1), self._timeout))
+            finally:
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+        return False
+
+    def elect(
+        self, stop_event: threading.Event | None = None
+    ) -> int:
+        """Returns the winning RANK: ``self.rank`` means this standby
+        takes over; any lower value names the live peer to re-arm
+        behind. Probes strictly in rank order, so the first live
+        lower rank short-circuits the walk."""
+        for r in range(self.rank):
+            if self._peer_alive(*self.peers[r], stop_event):
+                self._log(
+                    f"election: standby rank {r} is live and outranks "
+                    f"us — following it"
+                )
+                return r
+        if self.rank > 0:
+            self._log(
+                f"election: no live standby below rank {self.rank} — "
+                f"taking over"
+            )
+        return self.rank
+
+
 class CheckpointTailer(threading.Thread):
     """Keep the latest checkpoint restored IN MEMORY on the standby.
 
@@ -315,14 +506,20 @@ class CheckpointTailer(threading.Thread):
         template: Any,
         *,
         poll_interval_s: float = 0.25,
+        standby_id: int = 0,
         log: Callable[[str], None] | None = None,
     ):
         super().__init__(name="checkpoint-tailer", daemon=True)
         self._ckpt = checkpointer
         self._template = template
         self._interval = poll_interval_s
+        # The tailer never hellos anywhere (it polls a directory), but
+        # with N standbys tailing one dir its log lines must name
+        # WHICH standby restored what — the same derived-once id the
+        # monitor and param tailer announce on the wire.
+        self._standby_id = int(standby_id)
         self._log = log if log is not None else (
-            lambda msg: print(f"[standby] {msg}", flush=True)
+            lambda msg: print(f"[standby-{standby_id}] {msg}", flush=True)
         )
         self._lock = threading.Lock()
         self._step: Optional[int] = None
@@ -417,7 +614,14 @@ class ParamTailer(threading.Thread):
     listener so pre-takeover actors fetch live weights from it.
 
     A lost primary just means retry-with-backoff here (the monitor owns
-    declaring it dead); an orderly ``KIND_CLOSE`` ends the tail."""
+    declaring it dead); an orderly ``KIND_CLOSE`` ends the tail.
+
+    Fencing: with ``min_epoch`` set, a fetched version whose fencing
+    epoch (high tag bits) is BELOW it is dropped and counted
+    (``fenced``) instead of recorded — the standby's defense against a
+    deposed primary's late publishes after an election moved the
+    reign on. The dropped frame costs one delta fetch; the recorded
+    state, the republish hook, and the takeover graft never see it."""
 
     def __init__(
         self,
@@ -425,6 +629,7 @@ class ParamTailer(threading.Thread):
         port: int,
         *,
         standby_id: int = 0,
+        min_epoch: int = 0,
         poll_interval_s: float = 1.0,
         on_params: Callable[[int, List[np.ndarray]], None] | None = None,
         log: Callable[[str], None] | None = None,
@@ -432,16 +637,19 @@ class ParamTailer(threading.Thread):
         super().__init__(name="param-tailer", daemon=True)
         self._addr = (host, port)
         self._standby_id = standby_id
+        self._min_epoch = int(min_epoch)
         self._interval = poll_interval_s
         self._on_params = on_params
         self._log = log if log is not None else (
-            lambda msg: print(f"[standby] {msg}", flush=True)
+            lambda msg: print(f"[standby-{standby_id}] {msg}", flush=True)
         )
         self._lock = threading.Lock()
         self._version = 0
         self._leaves: Optional[List[np.ndarray]] = None
         self._seen_t = float("-inf")
         self.fetches = 0
+        self.fenced = 0
+        self._fence_logged = False
         self._halt = threading.Event()
         self.start()
 
@@ -458,7 +666,14 @@ class ParamTailer(threading.Thread):
                             heartbeat_interval_s=None,
                             idle_timeout_s=30.0,
                             connect_timeout=2.0,
-                            hello=(self._standby_id, 0, ROLE_STANDBY),
+                            # 5-field hello: announce the minimum
+                            # reign this tail accepts, so the peer's
+                            # registry shows each standby's fencing
+                            # knowledge next to its identity.
+                            hello=(
+                                self._standby_id, 0, ROLE_STANDBY,
+                                0, self._min_epoch,
+                            ),
                         )
                     except (ConnectionError, OSError):
                         # Not up yet / mid-restart: the monitor decides
@@ -484,6 +699,24 @@ class ParamTailer(threading.Thread):
                     else:
                         idle_wakes = 0
                     version, leaves = client.fetch_params()
+                    if version != 0 and epoch_of(version) < self._min_epoch:
+                        # A publish from a DEPOSED reign (the election
+                        # moved the epoch past its producer): drop it.
+                        # Recording it — or republishing it to parked
+                        # actors — would be exactly the split-brain
+                        # double-publish the fence exists to close.
+                        self.fenced += 1
+                        if not self._fence_logged:
+                            self._fence_logged = True
+                            self._log(
+                                f"FENCED a publish from epoch "
+                                f"{epoch_of(version)} (< min epoch "
+                                f"{self._min_epoch}) — deposed "
+                                f"primary's late frames; further "
+                                f"fences counted silently"
+                            )
+                        self._halt.wait(self._interval)
+                        continue
                     if version != 0 and version != have:
                         with self._lock:
                             self._version, self._leaves = version, leaves

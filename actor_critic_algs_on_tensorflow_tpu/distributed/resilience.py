@@ -115,6 +115,21 @@ class RetryPolicy:
                 sleep(delay)
 
 
+def endpoint_list(host, port):
+    """Normalize the actor process mains' address contract: ``port``
+    may be a plain port or an ordered ``(host, port)`` endpoint list
+    (the redundant-redirector form — see
+    ``ResilientActorClient(endpoints=)``). Returns ``(head_host,
+    head_port, endpoints_or_None)``; one shared helper so the classic
+    and env-shim actor mains cannot drift."""
+    if isinstance(port, (list, tuple)):
+        eps = [(h, int(p)) for h, p in port]
+        if not eps:
+            raise ValueError("empty endpoint list")
+        return eps[0][0], eps[0][1], eps
+    return host, port, None
+
+
 class ResilientActorClient:
     """``ActorClient`` with transparent reconnect + retry.
 
@@ -141,9 +156,29 @@ class ResilientActorClient:
         connect_timeout: float = 10.0,
         max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
         hello: Sequence[int] | None = None,
+        endpoints: Sequence[Tuple[str, int]] | None = None,
         rng: random.Random | None = None,
     ):
-        self._host, self._port = host, port
+        # PRIORITY-ordered endpoint list (redundant redirector /
+        # standby tier): the client holds every address in preference
+        # order and walks it on failed CONNECTs, so losing an
+        # endpoint costs one rotation inside the ordinary retry loop,
+        # not the fleet. Every RECONNECT CYCLE restarts at the HEAD
+        # (a fault resets the index): an actor that fell through to a
+        # lower-priority endpoint (a standby's parking listener)
+        # because it lost a startup race re-homes to the primary on
+        # its next reconnect instead of feeding a discard sink
+        # forever — the head retry costs one refused connect when the
+        # primary really is dead. Default: the single (host, port) —
+        # fully backward compatible.
+        self._endpoints: List[Tuple[str, int]] = (
+            [(h, int(p)) for h, p in endpoints]
+            if endpoints else [(host, port)]
+        )
+        if not self._endpoints:
+            raise ValueError("endpoints must name at least one address")
+        self._ep_idx = 0
+        self.endpoint_switches = 0
         self._retry = retry if retry is not None else RetryPolicy()
         self._heartbeat = heartbeat_interval_s
         self._idle = idle_timeout_s
@@ -165,15 +200,27 @@ class ResilientActorClient:
 
     def _ensure_connected(self) -> ActorClient:
         if self._client is None:
-            self._client = ActorClient(
-                self._host,
-                self._port,
-                connect_timeout=self._connect_timeout,
-                heartbeat_interval_s=self._heartbeat,
-                idle_timeout_s=self._idle,
-                max_frame_bytes=self._max_frame_bytes,
-                hello=self._hello,
-            )
+            host, port = self._endpoints[self._ep_idx]
+            try:
+                self._client = ActorClient(
+                    host,
+                    port,
+                    connect_timeout=self._connect_timeout,
+                    heartbeat_interval_s=self._heartbeat,
+                    idle_timeout_s=self._idle,
+                    max_frame_bytes=self._max_frame_bytes,
+                    hello=self._hello,
+                )
+            except (ConnectionError, OSError):
+                # This endpoint refused: rotate BEFORE re-raising so
+                # the retry layer's next attempt tries the next
+                # redirector instead of hammering a dead one.
+                if len(self._endpoints) > 1:
+                    self._ep_idx = (self._ep_idx + 1) % len(
+                        self._endpoints
+                    )
+                    self.endpoint_switches += 1
+                raise
             if self._ever_connected:
                 self.reconnects += 1
             self._ever_connected = True
@@ -183,6 +230,9 @@ class ResilientActorClient:
         client, self._client = self._client, None
         if client is not None:
             client.abort()  # no goodbye frame on a broken connection
+        # Priority semantics: the next reconnect cycle starts at the
+        # head of the endpoint list again (see __init__).
+        self._ep_idx = 0
 
     def _op(
         self,
@@ -339,7 +389,11 @@ class ResilientActorClient:
                 return 0
 
     def stats(self) -> dict:
-        return {"reconnects": self.reconnects, "retries": self.retries}
+        out = {"reconnects": self.reconnects, "retries": self.retries}
+        if len(self._endpoints) > 1:
+            out["endpoint_switches"] = self.endpoint_switches
+            out["endpoint"] = self._ep_idx
+        return out
 
     def close(self) -> None:
         with self._lock:
@@ -421,7 +475,7 @@ class ChaosProxy:
                  *, host: str = "127.0.0.1", port: int = 0):
         self._lock = threading.Lock()
         self._target = (target_host, target_port)
-        self._fallback: Tuple[str, int] | None = None
+        self._fallbacks: List[Tuple[str, int]] = []
         self.fallback_connections = 0
         self._delay = 0.0
         self._refuse = False
@@ -457,9 +511,19 @@ class ChaosProxy:
         the hot standby's pre-takeover listener — on their FIRST retry
         instead of accumulating backoff against a dead address, which
         is exactly the reconnect-backoff term of the failover gap.
-        ``None`` clears."""
+        ``None`` clears. The single-fallback form of
+        ``set_fallbacks``."""
+        self.set_fallbacks([(host, port)] if host is not None else [])
+
+    def set_fallbacks(self, endpoints) -> None:
+        """ORDERED fallback list, walked front-to-back when the target
+        refuses — the quorum generalization of ``set_fallback``. Give
+        every redirector the standby endpoints in RANK order and the
+        walk independently converges on the same host the standby
+        election elects (the lowest live rank), so a redirector that
+        was never re-pointed still tracks the current primary."""
         with self._lock:
-            self._fallback = (host, port) if host is not None else None
+            self._fallbacks = [(h, int(p)) for h, p in endpoints]
 
     def set_delay(self, seconds: float) -> None:
         with self._lock:
@@ -539,15 +603,19 @@ class ChaosProxy:
                 upstream = socket.create_connection(target, timeout=2.0)
             except OSError:
                 with self._lock:
-                    fallback = self._fallback
-                if fallback is None:
-                    _hard_reset(client)
-                    continue
-                try:
-                    upstream = socket.create_connection(
-                        fallback, timeout=2.0
-                    )
-                except OSError:
+                    fallbacks = list(self._fallbacks)
+                upstream = None
+                for fb in fallbacks:
+                    if fb == target:
+                        continue  # the dead target re-listed as a peer
+                    try:
+                        upstream = socket.create_connection(
+                            fb, timeout=2.0
+                        )
+                        break
+                    except OSError:
+                        continue
+                if upstream is None:
                     _hard_reset(client)
                     continue
                 with self._lock:

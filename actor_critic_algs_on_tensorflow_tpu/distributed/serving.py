@@ -582,6 +582,14 @@ def env_shim_actor_main(
     encoder = (
         codec.TrajEncoder(obs_delta=False) if cfg.serve_obs_codec else None
     )
+    # ``port`` may be an ordered (host, port) endpoint list — the
+    # redundant-redirector form, same contract as the classic actor
+    # main (resilience.endpoint_list is the single normalizer).
+    from actor_critic_algs_on_tensorflow_tpu.distributed.resilience import (
+        endpoint_list,
+    )
+
+    host, port, endpoints = endpoint_list(host, port)
     client = ResilientActorClient(
         host, port,
         retry=RetryPolicy(deadline_s=cfg.transport_retry_deadline_s),
@@ -589,6 +597,7 @@ def env_shim_actor_main(
         idle_timeout_s=cfg.transport_idle_timeout_s,
         max_frame_bytes=cfg.transport_max_frame_mb << 20,
         hello=(actor_id, generation, ROLE_ACTOR, CAP_INFERENCE),
+        endpoints=endpoints,
     )
     lat = LatencyStats()
     b = cfg.envs_per_actor

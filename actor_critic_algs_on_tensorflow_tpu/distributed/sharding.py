@@ -222,7 +222,19 @@ class ShardedIngest:
     joins the N staged batches and stitches them into the global
     sharded pytree. The join wait AFTER the first shard staged is the
     shard-skew cost — surfaced as ``pipeline_barrier_wait_s`` (the
-    in-process analog of the multi-host step barrier's wait)."""
+    in-process analog of the multi-host step barrier's wait).
+
+    Straggler bound (``desync_timeout_s``): once one shard has staged,
+    a sibling that produces nothing within the budget raises
+    ``controlplane.ShardDesync`` — the in-process stitch join is the
+    analog of the multi-host step barrier, and a shard whose slice of
+    the fleet never came back (the mid-takeover diverged-shard case)
+    must surface as a loud, attributable error, not an eternal hang
+    behind one arena. The bound arms only in the steady state (after
+    the first full join) unless ``armed=True`` — a takeover adoption
+    arms it immediately, since its fleet was live moments ago; a cold
+    start keeps the unbounded first join so actor-compile skew cannot
+    trip it."""
 
     def __init__(
         self,
@@ -231,6 +243,8 @@ class ShardedIngest:
         treedef: Any,
         global_shapes: Sequence[tuple],
         shardings: Sequence[Any],
+        desync_timeout_s: Optional[float] = None,
+        armed: bool = False,
     ):
         from actor_critic_algs_on_tensorflow_tpu.utils.metrics import (
             TimeSplit,
@@ -240,19 +254,62 @@ class ShardedIngest:
         self._treedef = treedef
         self._global_shapes = list(global_shapes)
         self._shardings = list(shardings)
+        self._desync_timeout = desync_timeout_s
+        self._armed = bool(armed)
         self.split = TimeSplit()
         self.batches = 0
 
     def get(self, timeout: float = 0.5, stop=None):
-        per = []
+        from actor_critic_algs_on_tensorflow_tpu.distributed.controlplane import (  # noqa: E501
+            ShardDesync,
+        )
+
+        # ROUND-ROBIN join, not an in-order walk: blocking on pipe 0
+        # first would blind the straggler bound to pipe 0 itself (a
+        # starved shard 0 would hang forever while shard 1 sits
+        # staged) — any staged sibling must start the clock no matter
+        # its index. Each sweep gives every still-missing shard a
+        # short bounded poll; the desync deadline runs from the FIRST
+        # stage anywhere.
+        per: List[Any] = [None] * len(self._pipes)
+        remaining = set(range(len(self._pipes)))
         first_staged_t = None
-        for pipe in self._pipes:
-            got = pipe.get(timeout=timeout, stop=stop)
-            if got is None:
-                return None
-            per.append(got)
-            if first_staged_t is None:
-                first_staged_t = time.perf_counter()
+        deadline = None
+        # One empty queue wait per shard per sweep: the pipeline only
+        # checks max_wait_s after a queue-get times out, so the tick
+        # IS the poll granularity — the bound is set strictly inside
+        # it to mean "report unstaged after exactly one empty tick".
+        poll_s = min(timeout, 0.1)
+        while remaining:
+            for k in sorted(remaining):
+                try:
+                    got = self._pipes[k].get(
+                        timeout=poll_s, stop=stop,
+                        max_wait_s=poll_s / 2,
+                    )
+                except TimeoutError:
+                    continue  # not staged yet; poll the next shard
+                if got is None:
+                    return None
+                per[k] = got
+                remaining.discard(k)
+                if first_staged_t is None:
+                    first_staged_t = time.perf_counter()
+            if (
+                remaining
+                and first_staged_t is not None
+                and self._desync_timeout is not None
+                and (self._armed or self.batches > 0)
+            ):
+                if deadline is None:
+                    deadline = first_staged_t + self._desync_timeout
+                if time.perf_counter() > deadline:
+                    raise ShardDesync(
+                        f"shard(s) {sorted(remaining)} staged no batch "
+                        f"within {self._desync_timeout:.1f}s of a "
+                        f"sibling shard (diverged or starved ingest — "
+                        f"their actor slices never fed these stacks)"
+                    )
         # Time spent waiting for stragglers once SOME shard was ready:
         # the stitch is gated on the slowest shard, exactly like the
         # multi-host barrier is gated on the slowest host.

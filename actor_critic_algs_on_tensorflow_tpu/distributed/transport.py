@@ -120,6 +120,32 @@ OBS_REQ_CODED = 1 << 62
 ROLE_ACTOR = 0
 ROLE_STANDBY = 1
 
+# --- fencing epoch (quorum control plane) ----------------------------
+# The epoch identifies a primary's REIGN: the first primary serves
+# epoch 0, and every takeover increments it. It rides in the high bits
+# of the u64 param-version tag (PARAMS/PARAMS_CODED/PARAMS_NOTIFY/ACK
+# frames) and of the PONG reply, so every peer that sees a publish or
+# a heartbeat learns which reign produced it — a deposed primary's
+# late frames carry a stale epoch and are rejectable wherever reign
+# identity matters (the standby param tail, redirector re-points),
+# closing the split-brain double-publish window without a new frame
+# kind. ``version == 0`` still means "nothing published yet" in every
+# epoch; legacy peers see an epoch-stamped version as just a bigger
+# number whose CHANGE (the only thing they test) still triggers their
+# re-fetch.
+EPOCH_SHIFT = 48
+_EPOCH_SEQ_MASK = (1 << EPOCH_SHIFT) - 1
+
+
+def epoch_of(version: int) -> int:
+    """Fencing epoch carried in a param-version (or pong) tag."""
+    return int(version) >> EPOCH_SHIFT
+
+
+def version_seq(version: int) -> int:
+    """Publish sequence number within the version's epoch."""
+    return int(version) & _EPOCH_SEQ_MASK
+
 # KIND_HELLO capability bits (4th hello field; absent = 0 = legacy
 # peer). Capabilities are FORWARD declarations — the server accepts
 # both plain and coded trajectory frames from anyone, so an old actor
@@ -389,6 +415,10 @@ class _Conn:
     generation: int = -1
     role: int = ROLE_ACTOR
     caps: int = 0
+    # The fencing epoch the peer believes current (5th hello field;
+    # standbys announce it so the registry shows each one's reign
+    # knowledge — absent = 0 = legacy peer).
+    epoch: int = 0
     send_lock: threading.Lock = dataclasses.field(
         default_factory=threading.Lock
     )
@@ -433,6 +463,7 @@ class LearnerServer:
         param_delta: bool = True,
         param_delta_ring: int = 4,
         param_bf16: bool = False,
+        epoch: int = 0,
         log: Callable[[str], None] | None = None,
     ):
         self._sink = self._make_sink(on_trajectory)
@@ -464,6 +495,14 @@ class LearnerServer:
         self._params_lock = threading.Lock()
         self._param_leaves: List[np.ndarray] = []
         self._param_crcs: List[int] = []
+        # Fencing epoch (quorum control plane): stamped into the high
+        # bits of every published version (and pong), so peers can
+        # attribute frames to a reign. ``_vcount`` is the plain publish
+        # counter; the wire ``_version`` is 0 until the first publish
+        # regardless of epoch ("nothing published yet" stays testable
+        # as == 0 everywhere).
+        self._epoch = int(epoch)
+        self._vcount = 0
         self._version = 0
         self._stopping = threading.Event()
         self._closing = threading.Event()  # graceful drain in progress
@@ -591,7 +630,8 @@ class LearnerServer:
         with self._params_lock:
             self._param_leaves = leaves
             self._param_crcs = crcs
-            self._version += 1
+            self._vcount += 1
+            self._version = (self._epoch << EPOCH_SHIFT) | self._vcount
             version = self._version
             if variants is not None:
                 self._param_ring[version] = variants
@@ -620,7 +660,18 @@ class LearnerServer:
             live = list(self._conns.values())
         sent = 0
         for c in live:
-            if not c.send_lock.acquire(blocking=False):
+            # Tiny BOUNDED lock wait, not a pure try-lock: the serve
+            # thread releases this lock microseconds after its send's
+            # sendmsg returns, but under GIL scheduling the publisher
+            # can race through an entire publish inside one 5 ms
+            # interpreter slice and find the lock "busy" every time —
+            # a skipped notify is never re-sent, so the peer would
+            # only learn the version from its next ack/fetch. The
+            # timed acquire yields the GIL to the holder and almost
+            # always converts that race into delivery; a peer wedged
+            # MID-send (buffers full for seconds) still only costs
+            # the publish 2 ms before being skipped.
+            if not c.send_lock.acquire(timeout=0.002):
                 continue
             try:
                 _, writable, _ = select.select([], [c.sock], [], 0)
@@ -650,6 +701,36 @@ class LearnerServer:
     @property
     def version(self) -> int:
         return self._version
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    @property
+    def alive(self) -> bool:
+        """Still accepting connections (listener thread up, no
+        shutdown begun) — the takeover path's adoption precondition."""
+        return (
+            not self._stopping.is_set()
+            and self._accept_thread.is_alive()
+        )
+
+    def set_epoch(self, epoch: int) -> int:
+        """Adopt a (monotonically larger) fencing epoch — the takeover
+        path stamps an adopted pre-takeover listener with the new
+        reign before its first publish, so every frame the new primary
+        ever emits outranks the deposed one's. Versions already
+        published re-stamp too: their CHANGE is what triggers actor
+        re-fetches onto the new reign's weights. Returns the epoch in
+        force (a smaller argument is ignored — epochs never regress)."""
+        with self._params_lock:
+            if int(epoch) > self._epoch:
+                self._epoch = int(epoch)
+                if self._vcount:
+                    self._version = (
+                        self._epoch << EPOCH_SHIFT
+                    ) | self._vcount
+            return self._epoch
 
     def metrics(self) -> dict:
         """Transport counters for the trainer's log stream."""
@@ -726,6 +807,7 @@ class LearnerServer:
                     "generation": c.generation,
                     "role": c.role,
                     "caps": c.caps,
+                    "epoch": c.epoch,
                 }
                 for c in self._conns.values()
             ]
@@ -785,14 +867,20 @@ class LearnerServer:
         ``KIND_PARAMS``. All payload CRCs are computed once per encode,
         never per peer."""
         encode_args = None
-        if c.role == ROLE_ACTOR and held_version > 0:
+        if (
+            c.role == ROLE_ACTOR
+            and held_version > 0
+            and epoch_of(held_version) == epoch_of(self._version)
+        ):
             with self._reg_lock:
                 # Staleness at fetch (in publishes): the distance the
                 # actor fell behind before asking. Under notify-driven
                 # fetches this hovers near 1; the mid-rollout-fetch
-                # A/B moves it.
+                # A/B moves it. Cross-epoch holds are excluded — two
+                # reigns' sequence counters are not a distance.
                 self._staleness_sum += max(
-                    0, self._version - held_version
+                    0,
+                    version_seq(self._version) - version_seq(held_version),
                 )
                 self._staleness_fetches += 1
         with self._params_lock:
@@ -1005,12 +1093,21 @@ class LearnerServer:
                     # none / legacy client): ring hit -> delta frame.
                     self._send_params(c, held_version=tag)
                 elif kind == KIND_PING:
-                    self._send(c, KIND_PONG, tag)
+                    # The reply carries this learner's fencing epoch in
+                    # the tag's high bits (low bits echo the ping tag):
+                    # a standby's monitor learns the reign it would
+                    # succeed from the same heartbeats that prove
+                    # liveness. Legacy clients ignore pong tags.
+                    self._send(
+                        c, KIND_PONG,
+                        (self._epoch << EPOCH_SHIFT)
+                        | (tag & _EPOCH_SEQ_MASK),
+                    )
                 elif kind == KIND_HELLO:
                     # Identity announcement: [actor_id, generation,
-                    # role, caps] — the trailing fields are optional so
-                    # a legacy 3-field hello (pre-capability actor)
-                    # parses unchanged with caps 0.
+                    # role, caps, epoch] — the trailing fields are
+                    # optional so a legacy 3-/4-field hello parses
+                    # unchanged with caps/epoch 0.
                     # One-way (no reply) so the client never blocks on it.
                     ident = (
                         np.asarray(arrays[0]).reshape(-1)
@@ -1025,6 +1122,8 @@ class LearnerServer:
                             c.role = int(ident[2])
                         if ident.size >= 4:
                             c.caps = int(ident[3])
+                        if ident.size >= 5:
+                            c.epoch = int(ident[4])
                         self._hellos += 1
                 elif kind == KIND_CLOSE:
                     reason = "graceful"
@@ -1052,6 +1151,28 @@ class LearnerServer:
         finally:
             self._retire(c, reason)
             conn.close()
+
+    def recycle_actor_connections(self) -> int:
+        """Force every connected ROLE_ACTOR peer to reconnect (their
+        resilient clients treat the reset as an ordinary transport
+        fault). The standby's re-homing nudge: an actor parked on the
+        standby's early (discard) listener because it lost a startup
+        race against the primary's bind retries its PRIORITY-ordered
+        endpoint list head-first on reconnect and lands back on the
+        healthy primary — only called while the primary is
+        demonstrably alive, so post-failover parked actors are never
+        disturbed. Standby/monitor connections are untouched. Returns
+        how many links were recycled."""
+        with self._reg_lock:
+            actors = [
+                c for c in self._conns.values() if c.role == ROLE_ACTOR
+            ]
+        for c in actors:
+            try:
+                c.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        return len(actors)
 
     def broadcast_handoff(self) -> int:
         """Tell connected STANDBY peers (hello role == ROLE_STANDBY) to

@@ -261,6 +261,23 @@ def measure_params() -> dict:
     return out
 
 
+def measure_election() -> dict:
+    """Quorum control-plane leg (scripts/controlplane_bench.py owns
+    the drill): primary SIGKILLed with N warm quorum standbys armed —
+    kill -> the election winner's first completed learner step, plus
+    the exactly-one-takeover and fencing-epoch witnesses."""
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts"),
+    )
+    import controlplane_bench as cpb
+
+    return cpb.election_leg(
+        n_standbys=int(os.environ.get("BENCH_ELECTION_STANDBYS", 3)),
+        total_iters=int(os.environ.get("BENCH_ELECTION_ITERS", 400)),
+    )
+
+
 def measure_traj() -> dict:
     """Trajectory-plane wire leg (scripts/traj_bench.py owns the
     helpers): fleet-push inbound MB/s + compression ratio with the
@@ -371,6 +388,15 @@ def main() -> int:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         try:
             print(json.dumps(measure_params()))
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+            return 1
+        return 0
+
+    if len(sys.argv) > 1 and sys.argv[1] == "--measure-election":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        try:
+            print(json.dumps(measure_election()))
         except Exception:
             traceback.print_exc(file=sys.stderr)
             return 1
@@ -553,6 +579,27 @@ def main() -> int:
             sys.stderr.write(
                 "[bench] traj plane leg failed\n"
                 + (tchild.stderr[-2000:] if tchild is not None else "")
+            )
+    if os.environ.get("BENCH_ELECTION"):
+        echild = None
+        try:
+            echild = subprocess.run(
+                [
+                    sys.executable, os.path.abspath(__file__),
+                    "--measure-election",
+                ],
+                capture_output=True,
+                text=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                timeout=int(os.environ.get("BENCH_CHILD_TIMEOUT", 900)),
+            )
+            payload["election"] = json.loads(
+                echild.stdout.strip().splitlines()[-1]
+            )
+        except Exception:
+            sys.stderr.write(
+                "[bench] election leg failed\n"
+                + (echild.stderr[-2000:] if echild is not None else "")
             )
     if os.environ.get("BENCH_SHARD"):
         dchild = None

@@ -22,9 +22,14 @@ Legs, each printed as one line of evidence:
      frame), split by training phase (deltas shrink as lr decays).
   5. publish -> actor-visible latency — KIND_PARAMS_NOTIFY wake +
      delta fetch, measured publish() to fetch-complete.
+  6. election (ISSUE 10) — the N-standby quorum drill: primary killed
+     mid-run with N warm standbys armed; measure kill -> the WINNER's
+     first completed learner step, and assert exactly one standby
+     took over (losers re-arm then stand down; the fencing epoch is
+     read back from the winner's run).
 
 Run: JAX_PLATFORMS=cpu python scripts/controlplane_bench.py [leg]
-(legs: checksum guard warm cold params notify all)
+(legs: checksum guard warm cold params notify election all)
 """
 
 import dataclasses
@@ -221,6 +226,172 @@ def failover_leg(mode: str) -> float:
             a.terminate()
     reader.close()
     return gap
+
+
+def election_leg(
+    n_standbys: int = 3, total_iters: int = 400
+) -> dict:
+    """Seconds from primary kill to the ELECTION WINNER's first
+    completed learner step, with ``n_standbys`` warm quorum standbys
+    (rank-ordered peers list, shared checkpoint dir, fencing epochs).
+    Returns the JSON-able dict ``bench.py --measure-election``
+    merges; also printed as a FAILOVER_ELECTION line."""
+    import multiprocessing as mp
+    import tempfile
+    import threading
+
+    from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+        LearnerServer,
+    )
+
+    ctx = mp.get_context("spawn")
+    tmp = tempfile.mkdtemp(prefix="failover-election-")
+    cfg = dataclasses.replace(
+        _cfg(total_iters),
+        election_probe_timeout_s=0.5,
+        election_probe_attempts=2,
+    )
+    spb = cfg.batch_trajectories * cfg.envs_per_actor * cfg.rollout_length
+    probe = socket.create_server(("127.0.0.1", 0))
+    primary_port = probe.getsockname()[1]
+    probe.close()
+    # Rank-ordered standby endpoints (each standby's early listener).
+    peer_probes = [socket.create_server(("127.0.0.1", 0)) for _ in
+                   range(n_standbys)]
+    peers = [("127.0.0.1", p.getsockname()[1]) for p in peer_probes]
+
+    redirector = Redirector("127.0.0.1", primary_port)
+    redirector.set_fallbacks(peers)
+    primary = ctx.Process(
+        target=_primary_main, args=(cfg, primary_port, tmp), daemon=True
+    )
+    primary.start()
+    actors = [
+        ctx.Process(
+            target=impala._actor_process_main,
+            args=(cfg, i, "127.0.0.1", redirector.port, 1000 + i, 0),
+            daemon=True,
+        )
+        for i in range(cfg.num_actors)
+    ]
+    for a in actors:
+        a.start()
+
+    result = {"takeovers": [], "ready": 0}
+    lock = threading.Lock()
+    armed = threading.Event()
+
+    def redirect(h, p, epoch=None):
+        result.setdefault("redirect_t", time.monotonic())
+        result.setdefault("redirect_epoch", epoch)
+        redirector.redirect(h, p, epoch=epoch)
+
+    def on_ready(monitor):
+        with lock:
+            result["ready"] += 1
+            if result["ready"] >= n_standbys:
+                armed.set()
+        result.setdefault("monitor", monitor)
+
+    def standby(rank):
+        ckpt = Checkpointer(tmp, async_save=False)
+        first = []
+
+        def log_fn(s, m):
+            if not first:
+                first.append(time.monotonic())
+                result["first_step_t"] = first[0]
+
+        peer_probes[rank].close()  # hand the reserved port over
+        out = impala.run_impala_standby(
+            cfg,
+            checkpointer=ckpt,
+            primary_host="127.0.0.1", primary_port=primary_port,
+            host="127.0.0.1", port=peers[rank][1],
+            redirect=redirect,
+            heartbeat_interval_s=0.2, takeover_deadline_s=1.0,
+            log_interval=1, log_fn=log_fn,
+            checkpoint_interval=10**9,
+            standby_id=rank, peers=peers,
+            on_ready=on_ready,
+        )
+        if out is not None:
+            with lock:
+                result["takeovers"].append(rank)
+            # Final save so the losers' completion check recognizes
+            # the finished job and stands down (the CLI's finalize).
+            ckpt.save(int(out[0].step) * spb, out[0])
+            ckpt.wait()
+        ckpt.close()
+
+    threads = [
+        threading.Thread(target=standby, args=(r,), daemon=True)
+        for r in range(n_standbys)
+    ]
+    for t in threads:
+        t.start()
+
+    reader = Checkpointer(tmp, async_save=False)
+    while True:
+        reader.refresh()
+        latest = reader.latest_step()
+        if latest is not None and latest >= 4 * spb:
+            break
+        time.sleep(0.1)
+    if not armed.wait(timeout=240.0):
+        raise RuntimeError("standby quorum never armed")
+    mon = result["monitor"]
+    arm_deadline = time.monotonic() + 60.0
+    while mon.pongs < 1 and time.monotonic() < arm_deadline:
+        time.sleep(0.05)
+    time.sleep(2.0)
+
+    os.kill(primary.pid, signal.SIGKILL)
+    t_kill = time.monotonic()
+    # The freed port must stay DEAD for the drill (probe-close
+    # honesty): hold it bound-but-not-listening.
+    dead = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    dead.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    try:
+        dead.bind(("127.0.0.1", primary_port))
+    except OSError:
+        pass
+    for t in threads:
+        t.join(timeout=570.0)
+    dead.close()
+    primary.join(timeout=5.0)
+    redirector.close()
+    for a in actors:
+        a.join(timeout=10.0)
+        if a.is_alive():
+            a.terminate()
+    reader.close()
+
+    gap = result["first_step_t"] - t_kill
+    out = {
+        "election_gap_s": round(gap, 3),
+        "standbys": n_standbys,
+        "takeovers": sorted(result["takeovers"]),
+        "winner_rank": (
+            result["takeovers"][0] if result["takeovers"] else None
+        ),
+        "losers_stood_down": len(result["takeovers"]) == 1,
+        "fencing_epoch": result.get("redirect_epoch"),
+        "detect_elect_bind_s": round(
+            result["redirect_t"] - t_kill, 3
+        ),
+    }
+    print(
+        f"FAILOVER_ELECTION gap={out['election_gap_s']}s "
+        f"standbys={n_standbys} winner_rank={out['winner_rank']} "
+        f"takeovers={out['takeovers']} "
+        f"detect+elect+bind={out['detect_elect_bind_s']}s "
+        f"fencing_epoch={out['fencing_epoch']} "
+        f"(kill -> winner's first learner step; losers re-armed then "
+        f"stood down)",
+        flush=True,
+    )
+    return out
 
 
 def guard_fetch_leg():
@@ -478,3 +649,5 @@ if __name__ == "__main__":
         print(f"FAILOVER_WARM gap={g:.3f}s (kill -> first learner step)")
     if leg in ("all", "cold"):
         failover_leg("cold")  # prints COLD_FIRST_STEP from the child
+    if leg in ("all", "election"):
+        election_leg()

@@ -28,16 +28,29 @@ class PortReservation:
         instead of being re-derived per test.
     """
 
-    def __init__(self, host: str = "127.0.0.1"):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         if hasattr(socket, "SO_REUSEPORT"):
             self._sock.setsockopt(
                 socket.SOL_SOCKET, socket.SO_REUSEPORT, 1
             )
-        self._sock.bind((host, 0))
+        self._sock.bind((host, port))
         self.host = host
         self.port = self._sock.getsockname()[1]
+
+    @classmethod
+    def hold(cls, host: str, port: int) -> "PortReservation":
+        """Re-reserve a SPECIFIC just-freed port — the dead-peer
+        guarantee. A test that closes a server and keeps using its
+        port as a "nothing listens here" address (the probe-close
+        residue of the old idiom) races every other process on the
+        box: anyone can rebind the freed port and turn "connection
+        refused" into "connected to a stranger". Holding the port
+        bound-but-never-listening the moment the server dies keeps it
+        refusing for the rest of the test. (SO_REUSEADDR clears the
+        listener's TIME_WAIT residue.)"""
+        return cls(host, port)
 
     def release(self) -> int:
         """Close the reservation (just-in-time handoff for servers

@@ -99,6 +99,121 @@ def test_controlplane_flags_parse_and_validate():
         cli._run(args, "impala", cfg, None)
 
 
+def test_standby_quorum_flags_parse_and_validate():
+    """--standby-rank/--standby-peers (ISSUE 10): the quorum flags'
+    parsing, dependency guards, and rank-range validation."""
+    # Quorum flags ride --standby.
+    args = cli.build_parser().parse_args(
+        ["--preset", "impala-cartpole", "--standby-rank", "1"]
+    )
+    with pytest.raises(SystemExit, match="require --standby"):
+        cli._run(args, "impala", None, None)
+    args = cli.build_parser().parse_args(
+        ["--preset", "impala-cartpole",
+         "--standby-peers", "h1:7001,h2:7001"]
+    )
+    with pytest.raises(SystemExit, match="require --standby"):
+        cli._run(args, "impala", None, None)
+    # A rank without the peers list it indexes is meaningless.
+    args = cli.build_parser().parse_args(
+        ["--preset", "impala-cartpole",
+         "--standby", "127.0.0.1:7000", "--standby-rank", "1",
+         "--checkpoint-dir", "/tmp/nope"]
+    )
+    _, cfg = cli.make_config(args)
+    with pytest.raises(SystemExit, match="needs --standby-peers"):
+        cli._run(args, "impala", cfg, None)
+    # Rank outside the peers list.
+    args = cli.build_parser().parse_args(
+        ["--preset", "impala-cartpole",
+         "--standby", "127.0.0.1:7000", "--standby-rank", "3",
+         "--standby-peers", "h1:7001,h2:7001",
+         "--checkpoint-dir", "/tmp/nope"]
+    )
+    _, cfg = cli.make_config(args)
+    with pytest.raises(SystemExit, match="outside the 2-entry"):
+        cli._run(args, "impala", cfg, None)
+    # Peers entries need explicit ports (they name peers, not binds).
+    args = cli.build_parser().parse_args(
+        ["--preset", "impala-cartpole",
+         "--standby", "127.0.0.1:7000",
+         "--standby-peers", "h1,h2:7001",
+         "--checkpoint-dir", "/tmp/nope"]
+    )
+    _, cfg = cli.make_config(args)
+    with pytest.raises(SystemExit, match="explicit port"):
+        cli._run(args, "impala", cfg, None)
+
+
+def test_quorum_bind_must_pin_own_peers_entry():
+    """A quorum standby's listener must live exactly where the peers
+    list says it does (elections and fallback walks probe that
+    address); an ephemeral or mismatched --learner-bind is refused."""
+    base = [
+        "--preset", "impala-cartpole",
+        "--standby", "127.0.0.1:7000", "--standby-rank", "1",
+        "--standby-peers", "h0:7001,h1:7002",
+        "--checkpoint-dir", "/tmp/nope",
+    ]
+    # No --learner-bind at all: the default ephemeral port mismatches.
+    args = cli.build_parser().parse_args(base)
+    _, cfg = cli.make_config(args)
+    with pytest.raises(SystemExit, match="pin this standby's own"):
+        cli._run(args, "impala", cfg, None)
+    # Wrong port: same refusal.
+    args = cli.build_parser().parse_args(
+        base + ["--learner-bind", "0.0.0.0:7009"]
+    )
+    _, cfg = cli.make_config(args)
+    with pytest.raises(SystemExit, match="pin this standby's own"):
+        cli._run(args, "impala", cfg, None)
+    # Sharded standby without a pinned bind: the port..port+N-1
+    # listener contract cannot ride ephemeral ports.
+    args = cli.build_parser().parse_args(
+        ["--preset", "impala-cartpole",
+         "--standby", "127.0.0.1:7000", "--set", "shard_count=2",
+         "--checkpoint-dir", "/tmp/nope"]
+    )
+    _, cfg = cli.make_config(args)
+    with pytest.raises(SystemExit, match="explicit --learner-bind"):
+        cli._run(args, "impala", cfg, None)
+
+
+def test_redirector_rejected_for_sharded_standby():
+    """One redirector has one target: with shard_count > 1 its
+    last-wins re-point would route every actor to shard N-1 and
+    starve the rest — refused at configuration time."""
+    args = cli.build_parser().parse_args(
+        ["--preset", "impala-cartpole",
+         "--standby", "127.0.0.1:7000", "--redirector", "7100",
+         "--set", "shard_count=2", "--checkpoint-dir", "/tmp/nope"]
+    )
+    _, cfg = cli.make_config(args)
+    with pytest.raises(SystemExit, match="single-stack"):
+        cli._run(args, "impala", cfg, None)
+
+
+def test_election_knobs_coerce_via_set():
+    """The quorum knobs ride --set with the config's type coercion
+    (the satellite alongside the sentinel-knob test above)."""
+    args = cli.build_parser().parse_args(
+        ["--preset", "impala-cartpole",
+         "--set", "standby_never_seen_grace_s=2.5",
+         "--set", "election_probe_timeout_s=0.25",
+         "--set", "election_probe_attempts=5"]
+    )
+    _, cfg = cli.make_config(args)
+    assert cfg.standby_never_seen_grace_s == 2.5
+    assert cfg.election_probe_timeout_s == 0.25
+    assert cfg.election_probe_attempts == 5
+    # Defaults: grace 0 = "use 10x the takeover deadline".
+    _, cfg = cli.make_config(
+        cli.build_parser().parse_args(["--preset", "impala-cartpole"])
+    )
+    assert cfg.standby_never_seen_grace_s == 0.0
+    assert cfg.election_probe_attempts == 3
+
+
 def test_coordinator_leader_follower_roundtrip_via_cli_specs():
     """make_coordinator builds a working leader/follower pair."""
     import threading

@@ -34,7 +34,7 @@ from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
     ActorClient,
     LearnerServer,
 )
-from tests.helpers import time_limit
+from tests.helpers import PortReservation, time_limit
 
 
 def _quiet_server(sink=None, **kw):
@@ -428,8 +428,17 @@ def test_outbound_metrics_account_param_sends():
         # The full first fetch carries at least the payload bytes.
         payload_mb = sum(x.nbytes for x in leaves) / 1e6
         assert m["transport_param_mb_out"] >= payload_mb
-        # mb_out also counts the tiny ACK the push got.
-        assert m["transport_mb_out"] > m["transport_param_mb_out"]
+        # mb_out also counts the tiny ACK the push got. The counter
+        # update runs on the serve thread AFTER its sendmsg returns,
+        # so the client can observe the ack a scheduler beat before
+        # the accounting lands — poll briefly instead of racing it.
+        deadline = time.monotonic() + 5.0
+        while not (
+            server.metrics()["transport_mb_out"]
+            > server.metrics()["transport_param_mb_out"]
+        ):
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
         client.close()
     finally:
         server.close()
@@ -484,7 +493,12 @@ def test_poll_notified_drains_already_arrived_notifies():
         for _ in range(3):
             cur = _perturb(cur, rng)
             server.publish(cur)
-        deadline = time.monotonic() + 5.0
+        # Generous deadline: the notify is best-effort and its
+        # delivery rides the server's conn thread, which a loaded box
+        # can deschedule for whole seconds (observed once at 5 s
+        # mid-suite; the signal under test is coalescing, not
+        # latency).
+        deadline = time.monotonic() + 20.0
         # Newest-wins: three pending notifies collapse to version 4.
         while client.poll_notified() < 4:
             assert time.monotonic() < deadline
@@ -710,6 +724,7 @@ def test_redirector_fallback_lands_actors_on_standby():
         )
         standby.publish([np.ones(4, np.float32)], notify=False)
         proxy = Redirector("127.0.0.1", primary.port)
+        dead = None
         try:
             proxy.set_fallback("127.0.0.1", standby.port)
             client = ResilientActorClient(
@@ -721,7 +736,13 @@ def test_redirector_fallback_lands_actors_on_standby():
 
             # The primary DIES (no goodbye frame): listener gone, live
             # links reset — the crash the fallback route exists for.
+            # The freed port is immediately RE-HELD (bound, never
+            # listening) so the proxy's target keeps refusing for the
+            # rest of the test instead of racing whoever on this box
+            # binds it next (the probe-close deflake pattern,
+            # tests/helpers.py PortReservation).
             primary.close(graceful=False)
+            dead = PortReservation.hold("127.0.0.1", primary.port)
             # The next operations land on the standby via the fallback
             # route: pushes are absorbed (ACKed + discarded), fetches
             # serve the standby's (tailed) params.
@@ -732,6 +753,8 @@ def test_redirector_fallback_lands_actors_on_standby():
             assert proxy.fallback_connections >= 1
             client.close()
         finally:
+            if dead is not None:
+                dead.release()
             proxy.close()
             standby.close()
 

@@ -1,0 +1,1074 @@
+"""Quorum control plane (ISSUE 10): N-standby election, fencing
+epochs, the redundant redirector tier, and sharded-learner failover.
+
+Tier-1 units drive the election/fencing pieces against real sockets;
+the two acceptance chaos e2es (3 standbys + 2 redirectors surviving a
+primary SIGKILL + a redirector death; a 2-shard learner's standby
+adopting both shard listeners) are ``slow`` — each spawns several jax
+processes and compiles multiple learner program sets.
+"""
+
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from actor_critic_algs_on_tensorflow_tpu.distributed.controlplane import (
+    ParamTailer,
+    PrimaryMonitor,
+    Redirector,
+    ShardDesync,
+    StandbyElection,
+)
+from actor_critic_algs_on_tensorflow_tpu.distributed.resilience import (
+    ResilientActorClient,
+    RetryPolicy,
+)
+from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+    EPOCH_SHIFT,
+    ROLE_STANDBY,
+    ActorClient,
+    LearnerServer,
+    epoch_of,
+    version_seq,
+)
+from tests.helpers import PortReservation, reserve_port, time_limit
+
+
+def _quiet_server(sink=None, **kw):
+    return LearnerServer(
+        sink if sink is not None else (lambda t, e: True),
+        log=lambda m: None,
+        **kw,
+    )
+
+
+def _mk_policy(deadline_s=15.0):
+    return RetryPolicy(
+        base_delay_s=0.01, max_delay_s=0.05, deadline_s=deadline_s
+    )
+
+
+# ---------------------------------------------------------------------
+# Fencing epoch on the wire: versions, pongs, hello, registry.
+# ---------------------------------------------------------------------
+
+def test_publish_version_carries_epoch_and_set_epoch_restamps():
+    server = _quiet_server(epoch=2)
+    try:
+        # "Nothing published yet" stays version 0 in EVERY epoch.
+        assert server.version == 0
+        v = server.publish([np.zeros(4, np.float32)], notify=False)
+        assert epoch_of(v) == 2 and version_seq(v) == 1
+        assert v == (2 << EPOCH_SHIFT) | 1
+        # Adopting a newer reign re-stamps the published version (the
+        # CHANGE is what makes actors re-fetch onto the new reign).
+        assert server.set_epoch(3) == 3
+        assert epoch_of(server.version) == 3
+        assert version_seq(server.version) == 1
+        # Epochs never regress.
+        assert server.set_epoch(1) == 3
+        assert epoch_of(server.version) == 3
+    finally:
+        server.close()
+
+
+def test_pong_tag_carries_epoch_and_monitor_learns_it():
+    server = _quiet_server(epoch=5)
+    monitor = PrimaryMonitor(
+        "127.0.0.1", server.port,
+        interval_s=0.05, deadline_s=5.0, log=lambda m: None,
+    )
+    try:
+        deadline = time.monotonic() + 5.0
+        while monitor.pongs == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert monitor.pongs >= 1
+        assert monitor.epoch_seen == 5
+    finally:
+        monitor.close()
+        server.close()
+
+
+def test_hello_epoch_field_recorded_in_registry():
+    server = _quiet_server()
+    try:
+        # 5-field hello: [actor_id, generation, role, caps, epoch].
+        c5 = ActorClient(
+            "127.0.0.1", server.port, hello=(3, 0, ROLE_STANDBY, 0, 7)
+        )
+        # Legacy 4-field hello parses with epoch 0.
+        c4 = ActorClient(
+            "127.0.0.1", server.port, hello=(4, 0, ROLE_STANDBY, 0)
+        )
+        deadline = time.monotonic() + 5.0
+        while (
+            server.metrics()["transport_hellos"] < 2
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.02)
+        by_id = {c["actor_id"]: c for c in server.connections()}
+        assert by_id[3]["epoch"] == 7
+        assert by_id[4]["epoch"] == 0
+        c5.close()
+        c4.close()
+    finally:
+        server.close()
+
+
+def test_monitor_and_tailer_share_one_distinct_standby_id():
+    """The N-standby identity fix: the monitor and the param tailer
+    both announce the standby's OWN rank (derived once), so two
+    standbys' hello identities never collide in the registry."""
+    server = _quiet_server()
+    server.publish([np.zeros(2, np.float32)], notify=False)
+    parts = []
+    try:
+        for rank in (4, 7):
+            parts.append(PrimaryMonitor(
+                "127.0.0.1", server.port,
+                interval_s=0.05, deadline_s=5.0,
+                standby_id=rank, log=lambda m: None,
+            ))
+            parts.append(ParamTailer(
+                "127.0.0.1", server.port,
+                standby_id=rank, poll_interval_s=0.1,
+                log=lambda m: None,
+            ))
+        deadline = time.monotonic() + 5.0
+        while (
+            server.metrics()["transport_hellos"] < 4
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.02)
+        standby_ids = sorted(
+            c["actor_id"] for c in server.connections()
+            if c["role"] == ROLE_STANDBY
+        )
+        assert standby_ids == [4, 4, 7, 7]
+    finally:
+        for p in parts:
+            p.close()
+        server.close()
+
+
+# ---------------------------------------------------------------------
+# Election: lowest live rank wins.
+# ---------------------------------------------------------------------
+
+def _election(rank, peers, **kw):
+    kw.setdefault("probe_timeout_s", 0.3)
+    kw.setdefault("probe_attempts", 2)
+    kw.setdefault("log", lambda m: None)
+    return StandbyElection(rank, peers, **kw)
+
+
+def test_election_lowest_live_rank_wins():
+    with time_limit(30, "election"):
+        servers = [_quiet_server() for _ in range(3)]
+        peers = [("127.0.0.1", s.port) for s in servers]
+        try:
+            # Rank 0 never probes: it IS the lowest rank.
+            assert _election(0, peers).elect() == 0
+            # Higher ranks defer to the live rank 0.
+            assert _election(1, peers).elect() == 0
+            assert _election(2, peers).elect() == 0
+            # Rank 0 dies (port re-held so it stays refusing): the
+            # next live rank wins; rank 2 follows IT, not itself.
+            servers[0].close(graceful=False)
+            with PortReservation.hold("127.0.0.1", peers[0][1]):
+                assert _election(1, peers).elect() == 1
+                assert _election(2, peers).elect() == 1
+                # Rank 1 also gone: rank 2 is the lowest live rank.
+                servers[1].close(graceful=False)
+                with PortReservation.hold("127.0.0.1", peers[1][1]):
+                    assert _election(2, peers).elect() == 2
+        finally:
+            for s in servers:
+                s.close()
+
+
+def test_election_rank_validated():
+    with pytest.raises(ValueError, match="rank"):
+        StandbyElection(2, [("127.0.0.1", 1)])
+
+
+def test_election_stop_event_short_circuits_probes():
+    with time_limit(30, "election stop"), reserve_port() as r:
+        # The only lower peer never answers (held, not listening);
+        # with the stop event set, elect() must not burn the full
+        # probe budget on it.
+        stop = threading.Event()
+        stop.set()
+        e = _election(1, [("127.0.0.1", r.port), ("127.0.0.1", 1)],
+                      probe_timeout_s=5.0, probe_attempts=10)
+        t0 = time.monotonic()
+        assert e.elect(stop) == 1
+        assert time.monotonic() - t0 < 2.0
+
+
+# ---------------------------------------------------------------------
+# Fencing: deposed-reign publishes and redirects are rejected.
+# ---------------------------------------------------------------------
+
+def test_param_tailer_fences_stale_epoch_publish():
+    """The deposed primary's LATE publish: a tailer re-armed at a
+    newer reign (min_epoch) must drop sub-epoch frames — recording or
+    republishing them would be the split-brain double-publish."""
+    with time_limit(30, "tailer fencing"):
+        deposed = _quiet_server(epoch=0)  # the old reign
+        republished = []
+        tailer = ParamTailer(
+            "127.0.0.1", deposed.port,
+            min_epoch=1, poll_interval_s=0.1,
+            on_params=lambda v, leaves: republished.append(v),
+            log=lambda m: None,
+        )
+        try:
+            deposed.publish([np.ones(8, np.float32)])
+            deadline = time.monotonic() + 10.0
+            while tailer.fenced == 0:
+                assert time.monotonic() < deadline
+                time.sleep(0.02)
+            assert tailer.newest() == (0, None)  # never recorded
+            assert not republished                # never republished
+            # A publish from the CURRENT reign still tails normally.
+            current = _quiet_server(epoch=1)
+            tailer2 = ParamTailer(
+                "127.0.0.1", current.port,
+                min_epoch=1, poll_interval_s=0.1, log=lambda m: None,
+            )
+            try:
+                v = current.publish([np.ones(8, np.float32)])
+                deadline = time.monotonic() + 10.0
+                while tailer2.newest()[0] != v:
+                    assert time.monotonic() < deadline
+                    time.sleep(0.02)
+                assert tailer2.fenced == 0
+            finally:
+                tailer2.close()
+                current.close()
+        finally:
+            tailer.close()
+            deposed.close()
+
+
+def test_redirector_refuses_stale_epoch_redirect():
+    with time_limit(30, "redirect fencing"), reserve_port() as r:
+        s1, s2 = _quiet_server(), _quiet_server()
+        proxy = Redirector("127.0.0.1", r.port)
+        try:
+            # Reign 1 points the fleet at s1.
+            assert proxy.redirect("127.0.0.1", s1.port, epoch=1) >= 0
+            assert proxy.epoch == 1
+            # The deposed reign-0 primary tries to pull it back: NO.
+            assert proxy.redirect("127.0.0.1", s2.port, epoch=0) == -1
+            assert proxy.stale_redirects == 1
+            client = ActorClient("127.0.0.1", proxy.port)
+            client.push_trajectory([np.zeros(2, np.float32)])
+            assert s1.metrics()["transport_trajectories"] == 1
+            assert s2.metrics()["transport_trajectories"] == 0
+            # A newer reign re-points fine; epoch-less calls (chaos
+            # tooling) bypass the fence entirely.
+            assert proxy.redirect("127.0.0.1", s2.port, epoch=2) >= 0
+            assert proxy.epoch == 2
+            assert proxy.redirect("127.0.0.1", s1.port) >= 0
+            client.close()
+        finally:
+            proxy.close()
+            s1.close()
+            s2.close()
+
+
+def test_redirector_rank_tiebreak_on_equal_epoch():
+    """The dual-win round: two standbys whose mutual probes failed
+    both take over at the SAME epoch. The LOWER rank — the election's
+    legitimate winner — must claim the redirector deterministically;
+    the outranked winner's re-point is refused, the same winner may
+    re-point itself, and a later reign beats any rank."""
+    with time_limit(30, "rank tiebreak"), reserve_port() as r:
+        proxy = Redirector("127.0.0.1", r.port)
+        try:
+            # Rank 2 lands first (epoch 1)...
+            assert proxy.redirect("127.0.0.1", 9101, epoch=1, rank=2) >= 0
+            assert (proxy.epoch, proxy.epoch_rank) == (1, 2)
+            # ...rank 1 outranks it at the same epoch...
+            assert proxy.redirect("127.0.0.1", 9102, epoch=1, rank=1) >= 0
+            assert proxy.epoch_rank == 1
+            # ...rank 2's retry is refused (no flapping)...
+            assert proxy.redirect(
+                "127.0.0.1", 9101, epoch=1, rank=2
+            ) == -1
+            # ...the holder may re-point itself...
+            assert proxy.redirect("127.0.0.1", 9103, epoch=1, rank=1) >= 0
+            # ...an equal-epoch rank-less call cannot displace a
+            # ranked holder (unordered: first wins)...
+            assert proxy.redirect("127.0.0.1", 9104, epoch=1) == -1
+            # ...and the next reign beats any rank.
+            assert proxy.redirect("127.0.0.1", 9105, epoch=2, rank=3) >= 0
+            assert (proxy.epoch, proxy.epoch_rank) == (2, 3)
+            assert proxy.stale_redirects == 2
+        finally:
+            proxy.close()
+
+
+# ---------------------------------------------------------------------
+# Redundant redirector tier: fallback walks, endpoint rotation.
+# ---------------------------------------------------------------------
+
+def test_fallback_list_walks_to_first_live_endpoint():
+    """set_fallbacks is ORDERED: a dead entry is skipped, the first
+    live one gets the connection — give every redirector the standby
+    list in rank order and the walk converges on the election
+    winner."""
+    with time_limit(30, "fallback walk"):
+        with reserve_port() as dead_target, reserve_port() as dead_fb:
+            live = _quiet_server()
+            live.publish([np.ones(4, np.float32)], notify=False)
+            proxy = Redirector("127.0.0.1", dead_target.port)
+            try:
+                proxy.set_fallbacks([
+                    ("127.0.0.1", dead_fb.port),   # rank 0: dead
+                    ("127.0.0.1", live.port),      # rank 1: live
+                ])
+                client = ResilientActorClient(
+                    "127.0.0.1", proxy.port, retry=_mk_policy(),
+                )
+                _, leaves = client.fetch_params()
+                np.testing.assert_array_equal(
+                    leaves[0], np.ones(4, np.float32)
+                )
+                assert proxy.fallback_connections >= 1
+                client.close()
+            finally:
+                proxy.close()
+                live.close()
+
+
+def test_fallback_connections_under_concurrent_redirect():
+    """The satellite gap: set_fallback()/fallback_connections raced
+    against a concurrent redirect() — previously only the single
+    static-redirector path was pinned. A churner thread flips the
+    target between a dead address and a live server (resetting links
+    each time) while a client streams pushes; every push must land
+    SOMEWHERE (target or fallback), the fallback counter must move,
+    and nothing may crash or wedge."""
+    with time_limit(60, "concurrent redirect"), reserve_port() as dead:
+        got_live, got_fb = [], []
+        live = _quiet_server(lambda t, e: got_live.append(1) or True)
+        fb = _quiet_server(lambda t, e: got_fb.append(1) or True)
+        fb.publish([np.zeros(1, np.float32)], notify=False)
+        live.publish([np.zeros(1, np.float32)], notify=False)
+        proxy = Redirector("127.0.0.1", dead.port)
+        proxy.set_fallback("127.0.0.1", fb.port)
+        stop = threading.Event()
+
+        def churn():
+            i = 0
+            while not stop.is_set():
+                proxy.redirect(
+                    "127.0.0.1",
+                    dead.port if i % 2 else live.port,
+                )
+                i += 1
+                time.sleep(0.01)
+
+        t = None
+        try:
+            # Deterministic fallback landing FIRST: the target is dead
+            # when the client connects, so the very first link walks
+            # the fallback route — then the redirect churn starts.
+            client = ResilientActorClient(
+                "127.0.0.1", proxy.port,
+                retry=_mk_policy(deadline_s=30.0),
+                heartbeat_interval_s=0.1, idle_timeout_s=2.0,
+            )
+            for i in range(5):
+                client.push_trajectory([np.array([i], np.int64)])
+            assert proxy.fallback_connections >= 1
+            t = threading.Thread(target=churn, daemon=True)
+            t.start()
+            for i in range(5, 30):
+                client.push_trajectory([np.array([i], np.int64)])
+                time.sleep(0.005)
+            client.close()
+        finally:
+            stop.set()
+            if t is not None:
+                t.join(timeout=5.0)
+            proxy.close()
+        # At-least-once across the churn: every push delivered to the
+        # live target or absorbed by the fallback; the dead-target
+        # windows forced at least one fallback landing.
+        assert len(got_live) + len(got_fb) >= 30
+        assert proxy.fallback_connections >= 1
+        assert len(got_fb) >= 1
+
+
+def test_resilient_client_rotates_across_endpoint_list():
+    """The redundant-redirector client contract: losing the endpoint
+    an actor is connected through costs one rotation, not the actor."""
+    with time_limit(30, "endpoint rotation"):
+        got1, got2 = [], []
+        s1 = _quiet_server(lambda t, e: got1.append(1) or True)
+        s2 = _quiet_server(lambda t, e: got2.append(1) or True)
+        client = ResilientActorClient(
+            "127.0.0.1", 0,
+            retry=_mk_policy(),
+            heartbeat_interval_s=0.1, idle_timeout_s=2.0,
+            endpoints=[
+                ("127.0.0.1", s1.port), ("127.0.0.1", s2.port),
+            ],
+        )
+        try:
+            client.push_trajectory([np.zeros(2, np.float32)])
+            assert got1 and not got2
+            # Endpoint 1 dies hard; its port is re-held so the
+            # reconnect is REFUSED (not answered by a stranger).
+            s1.close(graceful=False)
+            with PortReservation.hold("127.0.0.1", s1.port):
+                client.push_trajectory([np.zeros(2, np.float32)])
+                assert got2
+                assert client.stats()["endpoint_switches"] >= 1
+                assert client.stats()["endpoint"] == 1
+        finally:
+            client.close()
+            s1.close()
+            s2.close()
+
+
+def test_takeover_epoch_learned_from_peer_hellos():
+    """The replacement-standby case: a standby that never observed
+    the current reign (no pong, no tailed publish) must learn it
+    from the veteran peers that re-armed behind it — their
+    monitor/tailer hellos announce their believed epoch, and the
+    takeover epoch is the max over everything anyone knows. Without
+    this, the replacement would open a STALE reign the veterans'
+    min_epoch fences out wholesale."""
+    from actor_critic_algs_on_tensorflow_tpu.algos.impala import (
+        _peer_epoch_knowledge,
+    )
+
+    server = _quiet_server()
+    monitors = []
+    try:
+        assert _peer_epoch_knowledge([server]) == 0
+        # Two veteran standbys re-arm behind this (would-be) winner,
+        # announcing reigns 2 and 1; an ACTOR peer's field is ignored.
+        for rank, ep in ((1, 2), (2, 1)):
+            monitors.append(PrimaryMonitor(
+                "127.0.0.1", server.port,
+                interval_s=0.05, deadline_s=5.0,
+                standby_id=rank, epoch=ep, log=lambda m: None,
+            ))
+        deadline = time.monotonic() + 5.0
+        while (
+            server.metrics()["transport_hellos"] < 2
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.02)
+        assert _peer_epoch_knowledge([server]) == 2
+    finally:
+        for m in monitors:
+            m.close()
+        server.close()
+
+
+def test_parked_actor_rehomes_head_first_after_recycle():
+    """An actor that lost the startup race (primary not listening
+    yet) falls through its priority endpoint list onto the standby's
+    discard listener. Once the primary is up, recycling the parked
+    link (the standby's re-homing nudge) must send it BACK to the
+    head of the list — the primary — not leave it feeding a discard
+    sink forever."""
+    with time_limit(30, "rehome"):
+        parked = []
+        park = _quiet_server(lambda t, e: parked.append(1) or True)
+        primary = None
+        with reserve_port() as pr:
+            primary_port = pr.port
+            client = ResilientActorClient(
+                "127.0.0.1", 0,
+                retry=_mk_policy(),
+                heartbeat_interval_s=0.1, idle_timeout_s=2.0,
+                endpoints=[
+                    ("127.0.0.1", primary_port),   # not up yet
+                    ("127.0.0.1", park.port),      # the parking lot
+                ],
+            )
+            try:
+                client.push_trajectory([np.zeros(2, np.float32)])
+                assert parked  # landed on the standby's listener
+                # The primary comes up on its reserved port (narrowed
+                # handoff), and the standby's nudge recycles the
+                # parked link.
+                fed = []
+                primary = LearnerServer(
+                    lambda t, e: fed.append(1) or True,
+                    host="127.0.0.1", port=pr.release(),
+                    log=lambda m: None,
+                )
+                assert park.recycle_actor_connections() == 1
+                client.push_trajectory([np.zeros(2, np.float32)])
+                assert fed  # re-homed: head of the list wins again
+                assert client.stats()["endpoint"] == 0
+            finally:
+                client.close()
+                park.close()
+                if primary is not None:
+                    primary.close()
+
+
+# ---------------------------------------------------------------------
+# Sharded stitch join: straggler bound -> ShardDesync.
+# ---------------------------------------------------------------------
+
+class _FakePipe:
+    """Minimal LearnerPipeline stand-in for the stitcher's join."""
+
+    def __init__(self, items):
+        self._items = list(items)
+        self.batches = 0
+
+    def get(self, timeout=0.5, stop=None, max_wait_s=None):
+        if self._items:
+            return self._items.pop(0)
+        # Same precedence as the real pipeline: a stop always wins
+        # over the bounded-wait timeout.
+        if stop is not None and stop.is_set():
+            return None
+        if max_wait_s is not None:
+            time.sleep(min(max_wait_s, 0.05))
+            raise TimeoutError("starved")
+        # Unbounded wait: honor only the stop event (like the real
+        # pipeline's block-until-staged contract).
+        while True:
+            if stop is not None and stop.is_set():
+                return None
+            time.sleep(0.01)
+
+    def metrics(self):
+        return {}
+
+    def close(self):
+        pass
+
+
+def test_sharded_ingest_raises_desync_on_starved_sibling():
+    from actor_critic_algs_on_tensorflow_tpu.distributed.sharding import (
+        ShardedIngest,
+    )
+
+    with time_limit(30, "stitch desync"):
+        staged = ([np.zeros((2, 2), np.float32)], [], 0)
+        ingest = ShardedIngest(
+            [_FakePipe([staged]), _FakePipe([])],
+            treedef=None, global_shapes=[], shardings=[],
+            desync_timeout_s=0.2, armed=True,
+        )
+        with pytest.raises(ShardDesync, match=r"\[1\]"):
+            ingest.get()
+
+        # Index order must not matter: a starved shard 0 with a
+        # staged shard 1 desyncs just the same (the round-robin poll
+        # lets ANY staged sibling start the clock — an in-order walk
+        # would block on pipe 0 forever and never see pipe 1).
+        ingest0 = ShardedIngest(
+            [_FakePipe([]), _FakePipe([staged])],
+            treedef=None, global_shapes=[], shardings=[],
+            desync_timeout_s=0.2, armed=True,
+        )
+        with pytest.raises(ShardDesync, match=r"\[0\]"):
+            ingest0.get()
+
+        # Unarmed (cold start), the straggler wait stays unbounded:
+        # the stop event — not a timeout — ends the join.
+        ingest2 = ShardedIngest(
+            [_FakePipe([staged]), _FakePipe([])],
+            treedef=None, global_shapes=[], shardings=[],
+            desync_timeout_s=0.2, armed=False,
+        )
+        stop = threading.Event()
+        out = {}
+        t = threading.Thread(
+            target=lambda: out.setdefault("got", ingest2.get(stop=stop)),
+            daemon=True,
+        )
+        t.start()
+        time.sleep(0.5)  # well past the (unarmed) desync budget
+        assert "got" not in out
+        stop.set()
+        t.join(timeout=5.0)
+        assert out["got"] is None
+
+
+def test_standby_guards_quorum_and_shard_preconditions():
+    """Quorum and sharded standbys both need the early listeners (the
+    probe surface / the per-shard parking lots) — reject the
+    misconfiguration before anything compiles."""
+    import dataclasses
+
+    from actor_critic_algs_on_tensorflow_tpu.algos.impala import (
+        ImpalaConfig,
+        run_impala_standby,
+    )
+
+    base = ImpalaConfig(standby_serve_early=False)
+    with pytest.raises(ValueError, match="standby_serve_early"):
+        run_impala_standby(
+            dataclasses.replace(base, shard_count=2),
+            checkpointer=None, primary_host="127.0.0.1",
+            primary_port=1,
+        )
+    with pytest.raises(ValueError, match="standby_serve_early"):
+        run_impala_standby(
+            base,
+            checkpointer=None, primary_host="127.0.0.1",
+            primary_port=1, standby_id=0,
+            peers=[("127.0.0.1", 1), ("127.0.0.1", 2)],
+        )
+    with pytest.raises(ValueError, match="rank"):
+        run_impala_standby(
+            ImpalaConfig(),
+            checkpointer=None, primary_host="127.0.0.1",
+            primary_port=1, standby_id=5,
+            peers=[("127.0.0.1", 1), ("127.0.0.1", 2)],
+        )
+
+
+# ---------------------------------------------------------------------
+# Acceptance chaos e2es (slow tier).
+# ---------------------------------------------------------------------
+
+def _quorum_cfg(total_iters: int, **kw):
+    from actor_critic_algs_on_tensorflow_tpu.algos.impala import (
+        ImpalaConfig,
+    )
+
+    base = dict(
+        env="CartPole-v1", num_actors=2, envs_per_actor=4,
+        rollout_length=8, batch_trajectories=2, queue_size=4,
+        total_env_steps=2 * 4 * 8 * total_iters, num_devices=1,
+        transport_heartbeat_s=0.2, transport_idle_timeout_s=10.0,
+        transport_retry_deadline_s=60.0,
+        election_probe_timeout_s=0.5, election_probe_attempts=2,
+    )
+    base.update(kw)
+    return ImpalaConfig(**base)
+
+
+def _quorum_primary_main(cfg, port, ckpt_dir):
+    """Primary learner process (top-level for mp-spawn pickling)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from actor_critic_algs_on_tensorflow_tpu.algos import impala
+    from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
+        Checkpointer,
+    )
+
+    ckpt = Checkpointer(ckpt_dir, async_save=False)
+    impala.run_impala_distributed(
+        cfg, log_interval=1, log_fn=lambda s, m: None,
+        host="127.0.0.1", port=port,
+        checkpointer=ckpt, checkpoint_interval=2,
+        external_actors=True,
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_quorum_failover_three_standbys_two_redirectors(tmp_path):
+    """ISSUE 10 acceptance: 3 standbys, 2 redirectors. The primary is
+    SIGKILLed and one redirector dies with it, mid-training. Exactly
+    ONE standby (the lowest live rank) takes over and finishes the
+    whole remaining budget; the losers re-arm behind it and stand
+    down when it completes; the fencing epoch is asserted on the
+    survivors' redirector (a deposed-reign re-point is refused) and
+    in the winner's own log stream; the actors reconnect through the
+    surviving redirector."""
+    import multiprocessing as mp
+
+    from actor_critic_algs_on_tensorflow_tpu.algos import impala
+    from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
+        Checkpointer,
+    )
+
+    with time_limit(570, "quorum failover e2e"):
+        total_iters = 150
+        cfg = _quorum_cfg(total_iters)
+        spb = (
+            cfg.batch_trajectories * cfg.envs_per_actor
+            * cfg.rollout_length
+        )
+        ckpt_dir = str(tmp_path / "ck")
+
+        primary_r = reserve_port()
+        primary_port = primary_r.port
+        # Standby early listeners on held-then-released fixed ports:
+        # the rank-ordered peers list every standby (and redirector
+        # fallback walk) shares.
+        peer_rs = [reserve_port() for _ in range(3)]
+        peers = [("127.0.0.1", r.port) for r in peer_rs]
+
+        redirectors = [
+            Redirector("127.0.0.1", primary_port) for _ in range(2)
+        ]
+        for rd in redirectors:
+            rd.set_fallbacks(peers)
+        endpoints = [("127.0.0.1", rd.port) for rd in redirectors]
+
+        ctx = mp.get_context("spawn")
+        primary = ctx.Process(
+            target=_quorum_primary_main,
+            args=(cfg, primary_port, ckpt_dir), daemon=True,
+        )
+        primary_r.release()
+        primary.start()
+        actors = [
+            ctx.Process(
+                target=impala._actor_process_main,
+                args=(cfg, i, "127.0.0.1", endpoints, 1000 + i, 0),
+                daemon=True,
+            )
+            for i in range(cfg.num_actors)
+        ]
+        for a in actors:
+            a.start()
+
+        # The winner re-points EVERY redirector with its fencing
+        # epoch; losers never call this.
+        redirect_calls = []
+
+        def redirect(h, p, epoch=None):
+            redirect_calls.append((h, p, epoch))
+            for rd in redirectors:
+                rd.redirect(h, p, epoch=epoch)
+
+        results = {}
+
+        def standby(rank):
+            ckpt = Checkpointer(ckpt_dir, async_save=False)
+            try:
+                peer_rs[rank].release()  # just-in-time port handoff
+                out = impala.run_impala_standby(
+                    cfg,
+                    checkpointer=ckpt,
+                    primary_host="127.0.0.1",
+                    primary_port=primary_port,
+                    host="127.0.0.1", port=peers[rank][1],
+                    redirect=redirect,
+                    heartbeat_interval_s=0.2,
+                    takeover_deadline_s=1.0,
+                    log_interval=1, log_fn=lambda s, m: None,
+                    checkpoint_interval=10**9,
+                    standby_id=rank, peers=peers,
+                )
+                results[rank] = out
+                if out is not None:
+                    # The production wiring (cli._run_standby) saves
+                    # the takeover run's final state; the losers'
+                    # completion check reads it to recognize a
+                    # FINISHED job instead of re-taking it over.
+                    ckpt.save(int(out[0].step) * spb, out[0])
+                    ckpt.wait()
+            except BaseException as e:
+                results[f"{rank}_error"] = e
+            finally:
+                ckpt.close()
+
+        threads = [
+            threading.Thread(target=standby, args=(r,), daemon=True)
+            for r in range(3)
+        ]
+        for t in threads:
+            t.start()
+
+        reader = Checkpointer(ckpt_dir, async_save=False)
+        dead_ports = []
+        try:
+            deadline = time.monotonic() + 300.0
+            while time.monotonic() < deadline:
+                reader.refresh()
+                latest = reader.latest_step()
+                if latest is not None and latest >= 4 * spb:
+                    break
+                time.sleep(0.1)
+            reader.refresh()
+            killed_at = reader.latest_step()
+            assert killed_at is not None, "primary never checkpointed"
+
+            # THE FAULT: primary SIGKILLed, redirector 0 dies with it.
+            os.kill(primary.pid, signal.SIGKILL)
+            primary.join(timeout=10.0)
+            dead_ports.append(
+                PortReservation.hold("127.0.0.1", primary_port)
+            )
+            r0_port = redirectors[0].port
+            redirectors[0].close()
+            dead_ports.append(
+                PortReservation.hold("127.0.0.1", r0_port)
+            )
+
+            for t in threads:
+                t.join(timeout=480.0)
+            assert not any(t.is_alive() for t in threads), results
+            for r in range(3):
+                assert f"{r}_error" not in results, (
+                    results[f"{r}_error"]
+                )
+
+            # Exactly ONE standby took over: the lowest live rank.
+            takeovers = [
+                r for r in range(3) if results.get(r) is not None
+            ]
+            assert takeovers == [0], takeovers
+
+            state, history = results[0]
+            assert int(state.step) == total_iters
+            final = history[-1][1]
+            # Training resumed from the tailed step: every remaining
+            # batch was delivered by the redirected actors.
+            resumed_iters = total_iters - killed_at // spb
+            assert final["transport_trajectories"] >= (
+                0.95 * resumed_iters * cfg.batch_trajectories
+            )
+            assert np.isfinite(final["loss"])
+            # Fencing epoch asserted in the winner's own metrics...
+            assert final.get("param_epoch") == 1
+            # ...on the surviving redirector (reign 1 pointed it)...
+            assert redirectors[1].epoch == 1
+            assert redirect_calls and redirect_calls[0][2] == 1
+            # ...and against the deposed reign: a late epoch-0
+            # re-point (what the dead primary would issue if it
+            # revived) is refused.
+            assert redirectors[1].redirect(
+                "127.0.0.1", primary_port, epoch=0
+            ) == -1
+            # The actors reconnected THROUGH the surviving redirector
+            # (directly, or via its rank-ordered fallback walk while
+            # the winner was still coming up).
+            assert redirectors[1].connections_total >= 1
+        finally:
+            reader.close()
+            for dp in dead_ports:
+                dp.release()
+            for rd in redirectors[1:]:
+                rd.close()
+            if primary.is_alive():
+                primary.terminate()
+            for a in actors:
+                a.join(timeout=10.0)
+                if a.is_alive():
+                    a.terminate()
+
+
+@pytest.mark.slow
+def test_bench_election_full_leg_subprocess():
+    """The BENCH_ELECTION=1 contract end-to-end: child-mode bench.py
+    prints one JSON line with the kill->winner-first-step gap, the
+    exactly-one-takeover witness, and the fencing epoch."""
+    import json
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu", BENCH_ELECTION_ITERS="200"
+    )
+    child = subprocess.run(
+        [
+            sys.executable, os.path.join(root, "bench.py"),
+            "--measure-election",
+        ],
+        capture_output=True, text=True, cwd=root, timeout=560, env=env,
+    )
+    assert child.returncode == 0, child.stderr[-2000:]
+    out = json.loads(child.stdout.strip().splitlines()[-1])
+    assert out["standbys"] == 3
+    assert out["takeovers"] == [out["winner_rank"]]
+    assert out["losers_stood_down"] is True
+    assert out["fencing_epoch"] == 1
+    assert 0 < out["election_gap_s"] < 120
+
+
+def _shard_primary_main(cfg, port, ckpt_dir):
+    """2-shard in-process primary (top-level for mp-spawn pickling).
+    Binds port and port+1 (one listener per ingest shard)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from actor_critic_algs_on_tensorflow_tpu.algos import impala
+    from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
+        Checkpointer,
+    )
+
+    ckpt = Checkpointer(ckpt_dir, async_save=False)
+    impala.run_impala_distributed(
+        cfg, log_interval=1, log_fn=lambda s, m: None,
+        host="127.0.0.1", port=port,
+        checkpointer=ckpt, checkpoint_interval=2,
+        external_actors=True,
+    )
+
+
+def _reserve_consecutive(n: int, tries: int = 50):
+    """n consecutive reserved ports (the sharded listener layout:
+    port, port+1, ...). Retry until a free run exists."""
+    for _ in range(tries):
+        first = reserve_port()
+        rest = []
+        try:
+            for k in range(1, n):
+                rest.append(
+                    PortReservation("127.0.0.1", first.port + k)
+                )
+            return [first] + rest
+        except OSError:
+            first.release()
+            for r in rest:
+                r.release()
+    raise RuntimeError(f"no {n} consecutive free ports found")
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_sharded_standby_adopts_both_shard_listeners(tmp_path):
+    """ISSUE 10 acceptance (second e2e): the primary is a 2-shard
+    in-process learner (two listeners, disjoint actor slices). Its
+    standby pre-binds BOTH per-shard ports, tails shard 0's
+    checkpoints + the merged param stream, and at the SIGKILL adopts
+    both listeners via run_impala_distributed(shard=): each actor
+    rotates (endpoint list) onto its own shard's standby listener,
+    both arenas assemble, and training finishes the full budget from
+    the tailed step."""
+    import multiprocessing as mp
+
+    from actor_critic_algs_on_tensorflow_tpu.algos import impala
+    from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
+        Checkpointer,
+    )
+
+    with time_limit(570, "sharded standby e2e"):
+        total_iters = 120
+        cfg = _quorum_cfg(
+            total_iters, num_devices=2, shard_count=2, queue_size=8,
+            lr_decay=False,
+        )
+        spb = (
+            cfg.batch_trajectories * cfg.envs_per_actor
+            * cfg.rollout_length
+        )
+        ckpt_dir = str(tmp_path / "ck")
+
+        primary_rs = _reserve_consecutive(2)
+        standby_rs = _reserve_consecutive(2)
+        p_port = primary_rs[0].port
+        s_port = standby_rs[0].port
+
+        ctx = mp.get_context("spawn")
+        primary = ctx.Process(
+            target=_shard_primary_main,
+            args=(cfg, p_port, ckpt_dir), daemon=True,
+        )
+        for r in primary_rs:
+            r.release()
+        primary.start()
+        # Actor k belongs to shard k's slice: primary shard-k port
+        # first, then the standby's shard-k port — losing the primary
+        # rotates each actor onto ITS OWN shard's standby listener.
+        actors = [
+            ctx.Process(
+                target=impala._actor_process_main,
+                args=(
+                    cfg, i, "127.0.0.1",
+                    [("127.0.0.1", p_port + i),
+                     ("127.0.0.1", s_port + i)],
+                    1000 + i, 0,
+                ),
+                daemon=True,
+            )
+            for i in range(cfg.num_actors)
+        ]
+        for a in actors:
+            a.start()
+
+        result = {}
+
+        def standby():
+            try:
+                for r in standby_rs:
+                    r.release()
+                result["out"] = impala.run_impala_standby(
+                    cfg,
+                    checkpointer=Checkpointer(
+                        ckpt_dir, async_save=False
+                    ),
+                    primary_host="127.0.0.1", primary_port=p_port,
+                    host="127.0.0.1", port=s_port,
+                    heartbeat_interval_s=0.2,
+                    takeover_deadline_s=1.0,
+                    log_interval=1,
+                    log_fn=lambda s, m: result.setdefault(
+                        "history", []
+                    ).append((s, m)),
+                    checkpoint_interval=10**9,
+                )
+            except BaseException as e:
+                result["error"] = e
+
+        t = threading.Thread(target=standby, daemon=True)
+        t.start()
+
+        reader = Checkpointer(ckpt_dir, async_save=False)
+        dead_ports = []
+        try:
+            deadline = time.monotonic() + 300.0
+            while time.monotonic() < deadline:
+                reader.refresh()
+                latest = reader.latest_step()
+                if latest is not None and latest >= 4 * spb:
+                    break
+                time.sleep(0.1)
+            reader.refresh()
+            killed_at = reader.latest_step()
+            assert killed_at is not None, "primary never checkpointed"
+
+            os.kill(primary.pid, signal.SIGKILL)
+            primary.join(timeout=10.0)
+            for k in range(2):
+                dead_ports.append(
+                    PortReservation.hold("127.0.0.1", p_port + k)
+                )
+
+            t.join(timeout=480.0)
+            assert not t.is_alive()
+            assert "error" not in result, result["error"]
+            assert result["out"] is not None, "standby never took over"
+            state, history = result["out"]
+            assert int(state.step) == total_iters
+            final = history[-1][1]
+            # BOTH adopted shard listeners served their own slice:
+            # one actor each, no foreign peers, both arenas fed.
+            assert final["shard0_conns"] == 1
+            assert final["shard1_conns"] == 1
+            assert final["shard0_foreign_peers"] == 0
+            assert final["shard1_foreign_peers"] == 0
+            assert final["shard0_trajectories"] > 0
+            assert final["shard1_trajectories"] > 0
+            assert final["pipeline_shard_batches_min"] > 0
+            assert final.get("param_epoch") == 1
+            assert np.isfinite(final["loss"])
+        finally:
+            reader.close()
+            for dp in dead_ports:
+                dp.release()
+            if primary.is_alive():
+                primary.terminate()
+            for a in actors:
+                a.join(timeout=10.0)
+                if a.is_alive():
+                    a.terminate()

@@ -402,7 +402,12 @@ def test_chaos_end_to_end_delivery():
                     client.push_trajectory(
                         [np.array([aid, i], np.int64), payload]
                     )
-                    time.sleep(0.002)
+                    # Pace the stream so it OUTLASTS the ~0.4 s fault
+                    # schedule below: on a fast box 2 ms pushes let
+                    # every actor finish before the first fault even
+                    # landed, and the test asserted reconnects that
+                    # never had a reason to happen.
+                    time.sleep(0.01)
                 client.close()
             except BaseException as e:  # noqa: BLE001 - the assertion IS "no crash"
                 errors.append((aid, repr(e)))
@@ -415,7 +420,11 @@ def test_chaos_end_to_end_delivery():
             t.start()
         start.wait(timeout=10.0)
 
-        # Fault 1: reset every live link mid-stream.
+        # Fault 1: reset every live link mid-stream — but only once
+        # every actor's link is REGISTERED (links appear on the accept
+        # thread; injecting on a timer could miss some or all of them
+        # — the PR-6 wait_links deflake pattern).
+        proxy.wait_links(n_actors, timeout=10.0)
         time.sleep(0.08)
         proxy.reset_all()
         # Fault 2: the next reconnecting link dies mid-frame.

@@ -45,7 +45,7 @@ from actor_critic_algs_on_tensorflow_tpu.utils.metrics import (
     LatencyStats,
     percentile,
 )
-from tests.helpers import time_limit
+from tests.helpers import PortReservation, time_limit
 
 B, D = 2, 3  # env rows per request / obs feature dim in the unit tests
 
@@ -488,12 +488,18 @@ def test_shim_survives_server_restart_through_redirector():
         t.start()
         while steps_done[0] < 10 and not stop.is_set():
             time.sleep(0.01)
-        # Hard kill: no goodbye frame, mid-protocol.
+        # Hard kill: no goodbye frame, mid-protocol. The freed port is
+        # re-held at once (bound, never listening) so the redirector's
+        # stale target keeps REFUSING the reconnecting shim until the
+        # redirect below — not racing whoever binds the port next
+        # (tests/helpers.py PortReservation, the probe-close deflake).
         server_a.close(graceful=False)
+        dead = PortReservation.hold("127.0.0.1", server_a.port)
         serving_a.close()
         server_b, serving_b = mk_server(segs_b)
         redirector.redirect("127.0.0.1", server_b.port)
         t.join(timeout=45)
+        dead.release()
         assert not t.is_alive()
     try:
         assert not errors, errors
