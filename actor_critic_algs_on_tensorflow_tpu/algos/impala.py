@@ -46,6 +46,8 @@ from actor_critic_algs_on_tensorflow_tpu.distributed.queue import (
     TrajectoryQueue,
 )
 from actor_critic_algs_on_tensorflow_tpu.ops import (
+    SPVTraceOutput,
+    VTraceOutput,
     entropy_loss,
     sp_vtrace,
     value_loss,
@@ -56,6 +58,7 @@ from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
     device_count,
     make_mesh,
     put_replicated_tree,
+    shard_batch_specs,
     shard_map,
 )
 from actor_critic_algs_on_tensorflow_tpu.utils import health as health_lib
@@ -208,6 +211,28 @@ class ImpalaConfig:
     # runner only; incompatible with recurrent=True (the LSTM carry
     # would have to live server-side).
     actor_mode: str = "fetch_params"
+    # --- device-resident fast path (Podracer/Anakin, Hessel et al.
+    # 2021) -----------------------------------------------------------
+    # "host" (classic IMPALA): rollouts are collected by actor threads
+    # or processes and reach the learner through host queues/sockets.
+    # "device": env.step + policy act + segment assembly + the V-trace
+    # learner_step compile into ONE jitted ``lax.scan`` program
+    # (``ImpalaPrograms.fused_iteration``), sharded over the data mesh
+    # via shard_map with pmean'd gradients — zero host transfer in the
+    # hot loop. Pure-JAX envs only (the registered set), in-process
+    # runner only, non-recurrent only. "mixed": device-resident
+    # self-play batches (``collect_batch``, still zero-copy on device)
+    # interleave with wire-attached classic actors at the learner loop
+    # of ``run_impala_distributed`` — both feed the same learner
+    # state, ParamStore/publish path, sentinel guards, checkpoints,
+    # and log stream (``device_*`` metrics next to ``pipeline_*``).
+    rollout_mode: str = "host"
+    # Mixed mode's interleave schedule: this many device self-play
+    # batches are trained for every ONE wire batch (deterministic
+    # round-robin, so a test — or a budget plan — can count on both
+    # sources feeding; the wire turn blocks exactly like host mode's
+    # queue drain does).
+    mixed_device_per_wire: int = 1
     # Dynamic-batch knobs: a tick fires when this many requests are
     # pending (0 = the fleet size, num_actors) or serve_max_wait_ms
     # after the first pending arrival, whichever comes first.
@@ -357,6 +382,27 @@ class ImpalaPrograms:
     # the whole env-shim fleet's concatenated observations. None for
     # recurrent policies (the carry would have to live server-side).
     act: Any = None
+    # --- device-resident fast path (rollout_mode="device"/"mixed") ----
+    # ``env_reset_device(key) -> (env_state, obs)`` resets the fused
+    # env fleet (B = batch_trajectories * envs_per_actor envs, sharded
+    # on the data axis); ``collect_batch(params, env_state, obs, key)
+    # -> (env_state, obs, batch, ep)`` collects one learner batch
+    # entirely on device (the mixed-mode batch source);
+    # ``fused_iteration(state, env_state, obs, key) -> (state,
+    # env_state, obs, metrics, ep)`` is the Anakin program: collect +
+    # V-trace learner_step as ONE jitted shard_map dispatch, zero host
+    # transfer. ``fused_iteration_donated`` recycles state + env carry
+    # buffers in place (same discipline as learner_step_donated).
+    # ``vtrace_targets(params, batch) -> VTraceOutput`` is the shared
+    # target computation as a standalone program — the cross-mode
+    # bit-identity probe (all modes' targets come from this one code
+    # path), built in EVERY mode. The four env/fused fields below are
+    # None when rollout_mode="host".
+    env_reset_device: Any = None
+    collect_batch: Any = None
+    fused_iteration: Any = None
+    fused_iteration_donated: Any = None
+    vtrace_targets: Any = None
 
     def __iter__(self):
         return iter(
@@ -564,6 +610,65 @@ def make_impala(cfg: ImpalaConfig):
             "actor_mode='env_shim' requires recurrent=False (the LSTM "
             "carry would have to live on the inference server)"
         )
+    if cfg.rollout_mode not in ("host", "device", "mixed"):
+        raise ValueError(
+            f"rollout_mode must be 'host', 'device', or 'mixed', got "
+            f"{cfg.rollout_mode!r}"
+        )
+    if cfg.rollout_mode != "host":
+        mode = cfg.rollout_mode
+        if cfg.actor_mode == "env_shim":
+            raise ValueError(
+                f"rollout_mode={mode!r} compiles env.step into the "
+                f"learner program; actor_mode='env_shim' (central "
+                f"inference for wire shims) cannot combine with it — "
+                f"use actor_mode='fetch_params'"
+            )
+        if cfg.recurrent:
+            raise ValueError(
+                f"rollout_mode={mode!r} requires recurrent=False (the "
+                f"fused program does not thread the LSTM carry through "
+                f"the learner scan; run rollout_mode='host')"
+            )
+        if cfg.env.startswith(("gym:", "native:")):
+            raise ValueError(
+                f"rollout_mode={mode!r} needs a pure-JAX env compiled "
+                f"into the fused program; host-bridged env {cfg.env!r} "
+                f"steps through io_callback — run rollout_mode='host' "
+                f"or pick a registered on-device env "
+                f"(envs.registered_names())"
+            )
+        if cfg.time_shards > 1:
+            raise ValueError(
+                f"rollout_mode={mode!r} requires time_shards=1 (the "
+                f"fused program shards the env fleet on the data axis "
+                f"only)"
+            )
+        if cfg.shard_count > 1:
+            raise ValueError(
+                f"rollout_mode={mode!r} shards envs over the data mesh "
+                f"inside one program; the per-stack ingest shard plane "
+                f"(shard_count>1) is a host-ingest topology — use "
+                f"shard_count=1"
+            )
+        if cfg.mid_rollout_fetch:
+            raise ValueError(
+                f"rollout_mode={mode!r} acts with the step's own "
+                f"params; mid_rollout_fetch is a wire-actor staleness "
+                f"knob — drop it"
+            )
+        if mode == "mixed" and not cfg.pipeline:
+            raise ValueError(
+                "rollout_mode='mixed' requires pipeline=True (the wire "
+                "leg of the interleave ingests through the arena "
+                "pipeline)"
+            )
+        if mode == "mixed" and cfg.mixed_device_per_wire < 1:
+            raise ValueError(
+                f"mixed_device_per_wire must be >= 1, got "
+                f"{cfg.mixed_device_per_wire} (0 device batches per "
+                f"wire batch is rollout_mode='host')"
+            )
     if cfg.mid_rollout_fetch:
         if cfg.mid_rollout_chunks < 2:
             raise ValueError(
@@ -775,61 +880,78 @@ def make_impala(cfg: ImpalaConfig):
         (DATA_AXIS, TIME_AXIS) if cfg.time_shards > 1 else (DATA_AXIS,)
     )
 
+    def _batch_forward(params, batch: ActorTrajectory):
+        """The learner's forward pass over one ``[T_local, B_local]``
+        batch: ``(dist, values, last_value, target_log_probs)`` —
+        shared by the loss, the fused device iteration (through the
+        loss), and the standalone ``vtrace_targets`` probe."""
+        if cfg.recurrent:
+            resets = common.replay_resets(
+                batch.entry_prev_done, batch.dones
+            )
+            dist, values, carry_end = seq_dist_value(
+                params, batch.obs, resets, batch.entry_lstm
+            )
+            # Bootstrap value of last_obs continues the sequence
+            # from the replayed end-of-rollout carry.
+            _, last_value_tb, _ = seq_dist_value(
+                params, batch.last_obs[None], batch.dones[-1][None],
+                carry_end,
+            )
+            last_value = last_value_tb[0]
+        else:
+            dist, values = dist_and_value(params, batch.obs)
+            _, last_value = dist_and_value(params, batch.last_obs)
+        target_log_probs = dist.log_prob(batch.actions)
+        return dist, values, last_value, target_log_probs
+
+    def _vtrace_of(batch, target_log_probs, values, last_value):
+        """V-trace targets from the forward pass — the ONE code path
+        every mode's targets come from (host learner_step, the fused
+        Anakin iteration, and ``ImpalaPrograms.vtrace_targets``), so an
+        identical trajectory stream yields bit-identical targets
+        across modes by construction."""
+        if cfg.correction == "none":
+            # A3C: no importance weighting — with rho = c = 1 the
+            # V-trace recursion reduces exactly to n-step TD(lam)
+            # returns, the classic async-A2C/A3C target.
+            behaviour = jax.lax.stop_gradient(target_log_probs)
+        else:
+            behaviour = batch.behaviour_log_probs
+        vtrace_args = (
+            behaviour,
+            jax.lax.stop_gradient(target_log_probs),
+            batch.rewards,
+            jax.lax.stop_gradient(values),
+            batch.dones,
+            jax.lax.stop_gradient(last_value),
+        )
+        vtrace_kw = dict(
+            gamma=cfg.gamma,
+            lam=cfg.vtrace_lam,
+            rho_bar=cfg.rho_bar,
+            c_bar=cfg.c_bar,
+        )
+        if cfg.time_shards > 1:
+            return sp_vtrace(
+                *vtrace_args, axis_name=TIME_AXIS, **vtrace_kw
+            )
+        return vtrace(
+            *vtrace_args,
+            use_pallas=cfg.use_pallas_scan,
+            **vtrace_kw,
+        )
+
     def local_learner_step(state: LearnerState, batch: ActorTrajectory):
         """Batch fields are ``[T_local, B_local, ...]`` (B sharded on
         ``data``; T additionally sharded on ``time`` when
         ``cfg.time_shards > 1``, with V-trace sequence-parallel)."""
 
         def loss_fn(params):
-            if cfg.recurrent:
-                resets = common.replay_resets(
-                    batch.entry_prev_done, batch.dones
-                )
-                dist, values, carry_end = seq_dist_value(
-                    params, batch.obs, resets, batch.entry_lstm
-                )
-                # Bootstrap value of last_obs continues the sequence
-                # from the replayed end-of-rollout carry.
-                _, last_value_tb, _ = seq_dist_value(
-                    params, batch.last_obs[None], batch.dones[-1][None],
-                    carry_end,
-                )
-                last_value = last_value_tb[0]
-            else:
-                dist, values = dist_and_value(params, batch.obs)
-                _, last_value = dist_and_value(params, batch.last_obs)
-            target_log_probs = dist.log_prob(batch.actions)
-            if cfg.correction == "none":
-                # A3C: no importance weighting — with rho = c = 1 the
-                # V-trace recursion reduces exactly to n-step TD(lam)
-                # returns, the classic async-A2C/A3C target.
-                behaviour = jax.lax.stop_gradient(target_log_probs)
-            else:
-                behaviour = batch.behaviour_log_probs
-            vtrace_args = (
-                behaviour,
-                jax.lax.stop_gradient(target_log_probs),
-                batch.rewards,
-                jax.lax.stop_gradient(values),
-                batch.dones,
-                jax.lax.stop_gradient(last_value),
+            dist, values, last_value, target_log_probs = _batch_forward(
+                params, batch
             )
-            vtrace_kw = dict(
-                gamma=cfg.gamma,
-                lam=cfg.vtrace_lam,
-                rho_bar=cfg.rho_bar,
-                c_bar=cfg.c_bar,
-            )
-            if cfg.time_shards > 1:
-                vt = sp_vtrace(
-                    *vtrace_args, axis_name=TIME_AXIS, **vtrace_kw
-                )
-            else:
-                vt = vtrace(
-                    *vtrace_args,
-                    use_pallas=cfg.use_pallas_scan,
-                    **vtrace_kw,
-                )
+            vt = _vtrace_of(batch, target_log_probs, values, last_value)
             adv = jax.lax.stop_gradient(vt.pg_advantages)
             if cfg.normalize_advantages:
                 adv = common.global_normalize_advantages(
@@ -923,6 +1045,125 @@ def make_impala(cfg: ImpalaConfig):
     copy_tree = jax.jit(
         lambda t: jax.tree_util.tree_map(jnp.copy, t)
     )
+
+    # Standalone V-trace target probe: the SAME _batch_forward +
+    # _vtrace_of every mode's update runs, as its own jitted program —
+    # the cross-mode bit-identity witness (tests feed one trajectory
+    # stream through the host and device builds and compare bitwise).
+    params_spec = jax.tree_util.tree_map(lambda _: P(), example.params)
+    vt_cls = SPVTraceOutput if cfg.time_shards > 1 else VTraceOutput
+    vt_spec = vt_cls(
+        vs=P(t_axis, DATA_AXIS),
+        pg_advantages=P(t_axis, DATA_AXIS),
+        rhos=P(t_axis, DATA_AXIS),
+    )
+
+    def _local_vtrace_targets(params, batch):
+        _, values, last_value, target_log_probs = _batch_forward(
+            params, batch
+        )
+        return _vtrace_of(batch, target_log_probs, values, last_value)
+
+    vtrace_targets = jax.jit(shard_map(
+        _local_vtrace_targets,
+        mesh=mesh,
+        in_specs=(params_spec, batch_spec),
+        out_specs=vt_spec,
+        check_vma=False,
+    ))
+
+    # ---- device-resident fast path (rollout_mode="device"/"mixed") ----
+    # The Anakin program (Hessel et al. 2021): env.step + policy act +
+    # segment assembly + the V-trace learner_step compile into ONE
+    # jitted shard_map dispatch over the data mesh. Each shard owns a
+    # VecEnv slice of the fused fleet (B = batch_trajectories *
+    # envs_per_actor envs total, B/d per shard), collects its
+    # [T, B/d] segment with the same collect_rollout scan the host
+    # actors run, and feeds it straight into local_learner_step —
+    # batch layout, budget accounting, and V-trace math identical to a
+    # wire batch, with zero host transfer in the hot loop.
+    env_reset_device = collect_batch = None
+    fused_iteration = fused_iteration_donated = None
+    if cfg.rollout_mode != "host":
+        b_local = (cfg.batch_trajectories * cfg.envs_per_actor) // d_data
+        denv, denv_params = envs_lib.make(
+            cfg.env, num_envs=b_local, frame_stack=cfg.frame_stack
+        )
+
+        def _device_collect_local(params, env_state, obs, key):
+            # Distinct PRNG stream per shard: fold the mesh position
+            # in (the replicated key alone would step every shard's
+            # env slice identically).
+            k = jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
+            env_state, obs, traj, ep_info = common.collect_rollout(
+                denv, denv_params, policy_fn,
+                params, env_state, obs, k, cfg.rollout_length,
+            )
+            batch = ActorTrajectory(
+                obs=traj.obs,
+                actions=traj.actions,
+                rewards=traj.rewards,
+                dones=traj.dones,
+                behaviour_log_probs=traj.log_probs,
+                last_obs=obs,
+            )
+            ep = {
+                "episode_return": ep_info["episode_return"],
+                "done_episode": ep_info["done_episode"],
+            }
+            return env_state, obs, batch, ep
+
+        def _device_reset_local(key):
+            k = jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
+            return denv.reset(k, denv_params)
+
+        es_shape, obs_shape = jax.eval_shape(
+            lambda k: denv.reset(k, denv_params), jax.random.PRNGKey(0)
+        )
+        env_spec = shard_batch_specs(es_shape)
+        obs_spec = shard_batch_specs(obs_shape)
+        ep_spec = {
+            "episode_return": P(None, DATA_AXIS),
+            "done_episode": P(None, DATA_AXIS),
+        }
+        env_reset_device = jax.jit(shard_map(
+            _device_reset_local,
+            mesh=mesh,
+            in_specs=(P(),),
+            out_specs=(env_spec, obs_spec),
+            check_vma=False,
+        ))
+        collect_batch = jax.jit(shard_map(
+            _device_collect_local,
+            mesh=mesh,
+            in_specs=(params_spec, env_spec, obs_spec, P()),
+            out_specs=(env_spec, obs_spec, batch_spec, ep_spec),
+            check_vma=False,
+        ))
+
+        def _fused_local(state, env_state, obs, key):
+            env_state, obs, batch, ep = _device_collect_local(
+                state.params, env_state, obs, key
+            )
+            state, metrics = local_learner_step(state, batch)
+            return state, env_state, obs, metrics, ep
+
+        fused_sharded = shard_map(
+            _fused_local,
+            mesh=mesh,
+            in_specs=(state_spec, env_spec, obs_spec, P()),
+            out_specs=(state_spec, env_spec, obs_spec, P(), ep_spec),
+            check_vma=False,
+        )
+        fused_iteration = jax.jit(fused_sharded)
+        # Donated variant: learner state AND env carry recycled in
+        # place each iteration (the run loop rebinds all three; publish
+        # snapshots params via copy_params exactly as the wire loops
+        # do).
+        fused_iteration_donated = jax.jit(
+            fused_sharded, donate_argnums=(0, 1, 2)
+        )
+
     return ImpalaPrograms(
         init=init,
         learner_step=learner_step,
@@ -934,6 +1175,11 @@ def make_impala(cfg: ImpalaConfig):
         batch_time_axis=t_axis,
         num_actions=getattr(action_space, "n", None),
         act=act_program,
+        env_reset_device=env_reset_device,
+        collect_batch=collect_batch,
+        fused_iteration=fused_iteration,
+        fused_iteration_donated=fused_iteration_donated,
+        vtrace_targets=vtrace_targets,
     )
 
 
@@ -1043,6 +1289,7 @@ def _learner_loop(
     corrupt_batch=None,
     ingest=None,
     step_barrier=None,
+    fused_step=None,
 ) -> Tuple[LearnerState, List[Tuple[int, Dict[str, float]]]]:
     """Shared learner loop of the in-process and cross-process modes.
 
@@ -1088,6 +1335,14 @@ def _learner_loop(
     preemption is under way somewhere in the fleet and this host must
     join the stop-step consensus instead of dispatching (the wait is
     accounted as ``pipeline_barrier_wait_s``).
+
+    Device-resident fast path: ``fused_step(state, it) -> (state,
+    metrics, eps)`` dispatches the whole iteration (on-device collect +
+    learner step) as ONE jitted program — the loop then builds no
+    pipeline and touches no queue, and the dispatch+sync time is
+    surfaced as ``device_step_s``. Everything else (sentinel,
+    checkpoints, publish cadence, stop/coordinator handling, the log
+    stream) is shared with the wire modes.
     """
     from actor_critic_algs_on_tensorflow_tpu.data.pipeline import (
         LearnerPipeline,
@@ -1156,8 +1411,9 @@ def _learner_loop(
             return None
         return tree
 
+    device_split = TimeSplit(prefix="device_")
     pipe = ingest
-    if pipe is None and cfg.pipeline:
+    if pipe is None and cfg.pipeline and fused_step is None:
 
         def poll(n):
             check_health(it_box[0])
@@ -1233,6 +1489,20 @@ def _learner_loop(
         agreed stop step equals every host's local step, so catch-up
         trains no steps — and the barrier peers are already inside the
         consensus exchange.)"""
+        if fused_step is not None:
+            # Device-resident iteration: ONE jitted dispatch covers
+            # collect + learn; nothing to drain, nothing to stack.
+            if stop_evt is not None and stop_evt.is_set():
+                return None
+            td = time.perf_counter()
+            if exec_lock is None:
+                out = fused_step(state, it)
+            else:
+                with exec_lock:
+                    out = fused_step(state, it)
+                    jax.block_until_ready(out[1])
+            device_split.add("step_s", time.perf_counter() - td)
+            return out
         if pipe is not None:
             got = pipe.get(stop=stop_evt)
             if got is None:
@@ -1340,8 +1610,11 @@ def _learner_loop(
                         (i + 1) * steps_per_batch / max(now - t0, 1e-9)
                     )
                 last_log_i, last_log_t = i + 1, now
-                m.update(q.metrics())
+                if q is not None:
+                    m.update(q.metrics())
                 m.update(split.window())
+                if fused_step is not None:
+                    m.update(device_split.window())
                 if pipe is not None:
                     pm = pipe.metrics()
                     # Overlap efficiency: the fraction of ingest work
@@ -1478,6 +1751,7 @@ def run_impala(
     initial_state: LearnerState | None = None,
     stop_event: threading.Event | None = None,
     coordinator=None,
+    programs: ImpalaPrograms | None = None,
 ) -> Tuple[LearnerState, List[Tuple[int, Dict[str, float]]]]:
     """Drive actors + learner until the env-step budget is consumed.
 
@@ -1510,7 +1784,37 @@ def run_impala(
             "(run_impala_distributed / --actor-processes); in-process "
             "actor threads already feed one learner stack"
         )
-    programs = make_impala(cfg)
+    if cfg.rollout_mode == "mixed":
+        raise ValueError(
+            "rollout_mode='mixed' pairs device self-play with "
+            "wire-attached actor processes (run_impala_distributed / "
+            "--actor-processes); in-process, rollout_mode='device' "
+            "already IS the fused fast path"
+        )
+    if cfg.rollout_mode == "device":
+        if any(
+            h is not None
+            for h in (inject_failure_at, inject_nan_at, inject_poison_at)
+        ):
+            raise ValueError(
+                "rollout_mode='device' has no actor fleet or host "
+                "batch staging; the inject_* fault hooks only apply "
+                "to rollout_mode='host'"
+            )
+        return _run_impala_device(
+            cfg,
+            log_interval=log_interval,
+            log_fn=log_fn,
+            summary_writer=summary_writer,
+            checkpointer=checkpointer,
+            checkpoint_interval=checkpoint_interval,
+            initial_state=initial_state,
+            stop_event=stop_event,
+            coordinator=coordinator,
+            programs=programs,
+        )
+    if programs is None:
+        programs = make_impala(cfg)
     init, learner_step, make_actor_programs, mesh = programs
     state = (
         initial_state if initial_state is not None
@@ -1663,6 +1967,105 @@ def run_impala(
         for a in actors:
             a.join(timeout=5.0)
     return state, history
+
+
+# ---- device-resident mode: the fused Anakin loop (zero host transfer) --
+
+def _run_impala_device(
+    cfg: ImpalaConfig,
+    *,
+    log_interval: int = 20,
+    log_fn=None,
+    summary_writer=None,
+    checkpointer=None,
+    checkpoint_interval: int = 200,
+    initial_state: LearnerState | None = None,
+    stop_event: threading.Event | None = None,
+    coordinator=None,
+    programs: ImpalaPrograms | None = None,
+) -> Tuple[LearnerState, List[Tuple[int, Dict[str, float]]]]:
+    """The ``rollout_mode='device'`` runner: every iteration is ONE
+    jitted dispatch of ``ImpalaPrograms.fused_iteration`` — env.step +
+    act + segment assembly + V-trace learner step, sharded over the
+    data mesh, zero host transfer in the hot loop (the host only
+    dispatches, reads log-window metrics, and writes checkpoints).
+
+    Shares ``_learner_loop``'s sentinel/checkpoint/publish/stop
+    machinery through the ``fused_step`` hook, so device-resident runs
+    carry the same guarantees as the wire modes; the ``ParamStore``
+    publish path keeps ``param_version`` accounting (and the sentinel's
+    rollback re-publish) identical too. Env state is NOT checkpointed —
+    a resumed run restarts the env fleet fresh, exactly like restarted
+    actors in host mode."""
+    from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
+        donation_supported,
+    )
+
+    if programs is None:
+        programs = make_impala(cfg)
+    assert programs.fused_iteration is not None, (
+        "programs were built without the device fast path "
+        "(rollout_mode='host' config passed to the device runner)"
+    )
+    state = (
+        initial_state if initial_state is not None
+        else programs.init(jax.random.PRNGKey(cfg.seed))
+    )
+    exec_lock = _cpu_mesh_exec_lock(programs.mesh)
+    donate = (
+        cfg.donate_buffers and donation_supported() and exec_lock is None
+    )
+    fused = (
+        programs.fused_iteration_donated if donate
+        else programs.fused_iteration
+    )
+    if donate:
+        store = ParamStore(programs.copy_params(state.params))
+        publish = lambda p: store.publish(programs.copy_params(p))
+    else:
+        store = ParamStore(state.params)
+        publish = store.publish
+    sentinel = _make_sentinel(cfg, programs, publish, exec_lock)
+
+    # Per-iteration PRNG: fold the iteration index into one root key,
+    # so the stream is deterministic per (seed, iteration) and a
+    # resumed run continues where the checkpointed step left off
+    # instead of replaying rollouts it already trained on.
+    key_root = jax.random.PRNGKey(cfg.seed * 10_000 + 777)
+    r_reset, key_root = jax.random.split(key_root)
+    if exec_lock is None:
+        env_state, obs = programs.env_reset_device(r_reset)
+    else:
+        with exec_lock:
+            env_state, obs = programs.env_reset_device(r_reset)
+            jax.block_until_ready(obs)
+    env_box = [env_state, obs]
+    del env_state, obs  # env_box owns them (donated each iteration)
+
+    def fused_step(state, it):
+        k = jax.random.fold_in(key_root, it)
+        state, es, ob, metrics, ep = fused(
+            state, env_box[0], env_box[1], k
+        )
+        env_box[0], env_box[1] = es, ob
+        return state, metrics, [ep]
+
+    return _learner_loop(
+        cfg, state, None, None,
+        publish=publish,
+        check_health=lambda it: None,
+        extra_metrics=lambda: {"param_version": store.version},
+        log_interval=log_interval,
+        log_fn=log_fn,
+        summary_writer=summary_writer,
+        checkpointer=checkpointer,
+        checkpoint_interval=checkpoint_interval,
+        exec_lock=exec_lock,
+        sentinel=sentinel,
+        stop_event=stop_event,
+        coordinator=coordinator,
+        fused_step=fused_step,
+    )
 
 
 # ---- cross-process mode: actors over the socket transport (DCN leg) ----
@@ -2084,6 +2487,8 @@ def run_impala_distributed(
 
     from actor_critic_algs_on_tensorflow_tpu.data.pipeline import (
         AsyncParamPublisher,
+        DeviceRolloutSource,
+        InterleavedSource,
         LearnerPipeline,
     )
     from actor_critic_algs_on_tensorflow_tpu.distributed import (
@@ -2101,6 +2506,20 @@ def run_impala_distributed(
         spans_processes,
     )
 
+    if cfg.rollout_mode == "device":
+        raise ValueError(
+            "rollout_mode='device' is the in-process fused fast path "
+            "(run_impala / drop --actor-processes); to combine device "
+            "self-play with this wire fleet use rollout_mode='mixed'"
+        )
+    if cfg.rollout_mode == "mixed" and (
+        external_actors or server is not None
+    ):
+        raise ValueError(
+            "rollout_mode='mixed' is incompatible with the standby "
+            "takeover hooks (external_actors/server=): device env "
+            "state cannot be tailed across a failover"
+        )
     if shard is None and cfg.shard_count > 1:
         shard = sharding_lib.ShardPlan(cfg.shard_count)
     if shard is not None and shard.shard_count <= 1:
@@ -2384,6 +2803,23 @@ def run_impala_distributed(
         )
         server.set_inference_handler(serving.submit)
 
+    # Mixed mode: device-resident self-play as a second batch source.
+    # The collect program runs on the learner's own mesh (zero host
+    # transfer for its batches) and interleaves with the wire pipeline
+    # at the learner loop — one learner state, one publish path, one
+    # log stream for both.
+    device_source = None
+    if cfg.rollout_mode == "mixed":
+        device_source = DeviceRolloutSource(
+            collect=programs.collect_batch,
+            reset=programs.env_reset_device,
+            # Always a COPY (donation-safety: same reasoning as the
+            # serving tier's params above).
+            params=programs.copy_params(state.params),
+            seed=cfg.seed + 40_013,
+            exec_lock=exec_lock,
+        )
+
     leaves0 = jax.tree_util.tree_leaves(jax.device_get(state.params))
     for s in servers:
         s.publish(leaves0)
@@ -2537,6 +2973,11 @@ def run_impala_distributed(
             # (for any classic/standby peers) rides the publisher
             # thread behind it.
             serving.set_params(p)
+        if device_source is not None:
+            # Same zero-staleness swap for device self-play: the next
+            # collect_batch dispatch acts with the new weights before
+            # any wire peer's notify lands.
+            device_source.set_params(p)
         publisher.submit(p)
 
     sentinel = _make_sentinel(cfg, programs, publish, exec_lock)
@@ -2635,19 +3076,42 @@ def run_impala_distributed(
     # shard feeds the loop directly through the process-local wrap.
     ingest = None
     step_barrier = None
+
+    def make_wire_pipeline(q_k, batch_parts, *, transfer=None,
+                           wrap_batch=True, name="learner-pipeline"):
+        """ONE construction site for every wire-ingest pipeline this
+        runner builds (the per-shard stacks and the mixed-mode wire
+        leg), so the shared kwargs — decode caps, slot depth, part
+        specs, post-decode validation — cannot drift between
+        topologies."""
+        treedef, axes_leaves, shardings_leaves = ingest_plan
+
+        def poll(n):
+            check_health(0)
+            try:
+                return q_k.get_many(n, timeout=0.25)
+            except queue_lib.Empty:
+                return ()
+
+        return LearnerPipeline(
+            poll=poll,
+            batch_parts=batch_parts,
+            treedef=treedef,
+            axes_leaves=axes_leaves,
+            shardings_leaves=shardings_leaves,
+            n_slots=max(2, cfg.pipeline_slots),
+            exec_lock=exec_lock,
+            validate_coded=validate_coded,
+            max_decode_bytes=cfg.transport_max_frame_mb << 20,
+            part_specs=part_specs,
+            transfer=transfer,
+            wrap_batch=wrap_batch,
+            name=name,
+        )
+
     if shard is not None:
         treedef, axes_leaves, shardings_leaves = ingest_plan
         local_parts = shard.local_parts(cfg.batch_trajectories)
-
-        def make_poll(q_k):
-            def poll(n):
-                check_health(0)
-                try:
-                    return q_k.get_many(n, timeout=0.25)
-                except queue_lib.Empty:
-                    return ()
-
-            return poll
 
         pipes = []
         for j, sh in enumerate(shard.local_shards()):
@@ -2662,16 +3126,8 @@ def run_impala_distributed(
                 )
                 wrap = False
             pipes.append(
-                LearnerPipeline(
-                    poll=make_poll(queues[j]),
-                    batch_parts=local_parts,
-                    treedef=treedef,
-                    axes_leaves=axes_leaves,
-                    shardings_leaves=shardings_leaves,
-                    n_slots=max(2, cfg.pipeline_slots),
-                    validate_coded=validate_coded,
-                    max_decode_bytes=cfg.transport_max_frame_mb << 20,
-                    part_specs=part_specs,
+                make_wire_pipeline(
+                    queues[j], local_parts,
                     transfer=transfer,
                     wrap_batch=wrap,
                     name=f"learner-pipeline-{sh}",
@@ -2730,6 +3186,19 @@ def run_impala_distributed(
                 ),
                 armed=adopted,
             )
+
+    if device_source is not None:
+        # Mixed mode's ingest: the classic wire pipeline (built HERE —
+        # the loop builds none when handed a pre-built source)
+        # interleaved with device self-play on the deterministic
+        # mixed_device_per_wire schedule. Both sources' batches land in
+        # the same learner_step; ``device_*`` metrics ride the log
+        # stream next to ``pipeline_*``.
+        ingest = InterleavedSource(
+            make_wire_pipeline(queues[0], cfg.batch_trajectories),
+            device_source,
+            device_per_wire=cfg.mixed_device_per_wire,
+        )
 
     completed = False
     try:
@@ -2910,6 +3379,13 @@ def run_impala_standby(
         donation_supported,
     )
 
+    if cfg.rollout_mode != "host":
+        raise ValueError(
+            f"--standby / run_impala_standby requires rollout_mode="
+            f"'host': the warm standby tails the wire-ingest topology, "
+            f"and device-resident env state cannot be tailed across a "
+            f"failover (got rollout_mode={cfg.rollout_mode!r})"
+        )
     n_stacks = max(1, cfg.shard_count)
     if n_stacks > 1 and not cfg.standby_serve_early:
         raise ValueError(

@@ -19,6 +19,12 @@ or explicitly:
 
 ``--set key=value`` overrides any config dataclass field with type
 coercion from the field's declared type.
+
+IMPALA's device-resident fast path (Podracer/Anakin) rides the same
+surface: ``--preset impala-cartpole --set rollout_mode=device`` fuses
+env.step + act + V-trace into one jitted program (in-process, pure-JAX
+envs only); ``--set rollout_mode=mixed`` with ``--actor-processes``
+interleaves device self-play with the wire actor fleet.
 """
 
 from __future__ import annotations
@@ -1060,6 +1066,41 @@ def _run(args, algo, cfg, writer) -> int:
             run_impala,
             run_impala_distributed,
         )
+
+        # Device-resident fast path (rollout_mode="device"/"mixed"):
+        # flag-combination refusals up front, with the fix in the
+        # message — the config-level constraints (env_shim, recurrent,
+        # host envs, shards) are validated by make_impala itself.
+        rollout_mode = getattr(cfg, "rollout_mode", "host")
+        if rollout_mode != "host":
+            if args.standby:
+                raise SystemExit(
+                    f"--standby requires rollout_mode='host' (the warm "
+                    f"standby tails the wire-ingest topology; device "
+                    f"env state cannot be tailed across a failover) — "
+                    f"drop --set rollout_mode={rollout_mode}"
+                )
+            if args.shard is not None:
+                raise SystemExit(
+                    f"--shard requires rollout_mode='host': the fused "
+                    f"program already shards envs over the data mesh "
+                    f"inside one dispatch — drop --shard or --set "
+                    f"rollout_mode={rollout_mode}"
+                )
+            if rollout_mode == "device" and args.actor_processes:
+                raise SystemExit(
+                    "rollout_mode='device' is the in-process Anakin "
+                    "fast path (no actor fleet); drop "
+                    "--actor-processes, or use rollout_mode='mixed' "
+                    "to pair device self-play with wire actors"
+                )
+            if rollout_mode == "mixed" and not args.actor_processes:
+                raise SystemExit(
+                    "rollout_mode='mixed' interleaves device "
+                    "self-play with wire-attached actor processes; "
+                    "pass --actor-processes (or use "
+                    "rollout_mode='device' for pure device-resident)"
+                )
 
         # Sharded learner first: the per-host form must join the
         # jax.distributed runtime BEFORE anything touches the backend

@@ -62,10 +62,28 @@ from actor_critic_algs_on_tensorflow_tpu.utils.metrics import TimeSplit
 
 __all__ = [
     "AsyncParamPublisher",
+    "DeviceRolloutSource",
     "HostArena",
+    "InterleavedSource",
     "LearnerPipeline",
     "TimeSplit",
 ]
+
+# Batch-source interface (what the learner loop consumes, and what
+# anything that feeds it must implement):
+#
+#     got = source.get(stop=stop_event)      # None once stop fires
+#     batch, eps, handle = got
+#     state, metrics = learner_step(state, batch)
+#     source.mark_consumed(handle, metrics)  # token-gated slot reuse
+#     ...
+#     source.metrics(); source.close()
+#
+# ``LearnerPipeline`` (wire trajectories through the host arena),
+# ``distributed.sharding.ShardedIngest`` (N pipelines stitched into one
+# global batch), ``DeviceRolloutSource`` (device-resident self-play —
+# the batch never touches the host), and ``InterleavedSource`` (a
+# deterministic schedule over two sources) all speak it.
 
 
 class HostArena:
@@ -621,6 +639,142 @@ class LearnerPipeline:
     @property
     def alive(self) -> bool:
         return self._thread.is_alive()
+
+
+class DeviceRolloutSource:
+    """Device-resident self-play as a batch source (the mixed-mode leg
+    of the Podracer/Anakin fast path).
+
+    ``get()`` dispatches the jitted ``collect`` program — env.step +
+    act + segment assembly entirely on the learner's mesh — and hands
+    back a device-resident ``(batch, eps, None)``; the batch never
+    crosses the host. The env fleet's state threads through the source
+    (reset lazily on first use, so construction costs nothing);
+    ``set_params`` swaps the acting weights in process — the publish
+    path calls it alongside the wire broadcast, so device self-play
+    acts on new weights with zero staleness.
+
+    ``exec_lock`` is the CPU-mesh serialize rule (see
+    ``algos.impala.ImpalaActor``): when set, every dispatch runs to
+    completion under it; on real accelerators it is None and collect
+    dispatches overlap the learner's compute.
+    """
+
+    def __init__(
+        self,
+        *,
+        collect: Callable[..., Any],
+        reset: Callable[..., Any],
+        params: Any,
+        seed: int,
+        exec_lock: Optional[threading.Lock] = None,
+    ):
+        self._collect = collect
+        self._reset = reset
+        self._params = params
+        self._key = jax.random.PRNGKey(seed)
+        self._exec_lock = exec_lock
+        self._env: Optional[Tuple[Any, Any]] = None
+        self.split = TimeSplit(prefix="device_")
+        self.batches = 0
+
+    def set_params(self, params: Any) -> None:
+        # Reference swap is atomic under the GIL; params pytrees are
+        # immutable device arrays (the ParamStore argument).
+        self._params = params
+
+    def _dispatch(self, fn, *args):
+        if self._exec_lock is None:
+            return fn(*args)
+        with self._exec_lock:
+            out = fn(*args)
+            jax.block_until_ready(out)
+            return out
+
+    def get(
+        self,
+        timeout: float = 0.5,
+        stop: Optional[threading.Event] = None,
+        max_wait_s: Optional[float] = None,
+    ):
+        if stop is not None and stop.is_set():
+            return None
+        t0 = time.perf_counter()
+        if self._env is None:
+            self._key, k = jax.random.split(self._key)
+            self._env = tuple(self._dispatch(self._reset, k))
+        self._key, k = jax.random.split(self._key)
+        env_state, obs, batch, ep = self._dispatch(
+            self._collect, self._params, self._env[0], self._env[1], k
+        )
+        self._env = (env_state, obs)
+        self.split.add("collect_s", time.perf_counter() - t0)
+        self.batches += 1
+        return batch, [ep], None
+
+    def mark_consumed(self, handle, token) -> None:
+        pass  # device batches are fresh program outputs; no slot reuse
+
+    def metrics(self) -> dict:
+        m = self.split.window()
+        m["device_batches"] = self.batches
+        return m
+
+    def close(self) -> None:
+        self._env = None  # release the env fleet's device buffers
+
+
+class InterleavedSource:
+    """Deterministic round-robin over a wire batch source and a device
+    self-play source: ``device_per_wire`` device batches are served for
+    every ONE wire batch. The wire turn blocks on its pipeline exactly
+    like host mode's queue drain does (a configured wire fleet is
+    expected to feed), so both sources provably contribute — the
+    mixed-mode e2e pin counts on it."""
+
+    def __init__(self, wire, device, device_per_wire: int = 1):
+        self._wire = wire
+        self._device = device
+        self._period = max(1, device_per_wire) + 1
+        self._n_device = self._period - 1
+        self._i = 0
+        self.wire_batches = 0
+        self.device_batches = 0
+
+    def get(
+        self,
+        timeout: float = 0.5,
+        stop: Optional[threading.Event] = None,
+        max_wait_s: Optional[float] = None,
+    ):
+        use_device = (self._i % self._period) < self._n_device
+        self._i += 1
+        if use_device:
+            got = self._device.get(stop=stop)
+            if got is not None:
+                self.device_batches += 1
+            return got
+        got = self._wire.get(timeout=timeout, stop=stop,
+                             max_wait_s=max_wait_s)
+        if got is not None:
+            self.wire_batches += 1
+        return got
+
+    def mark_consumed(self, handle, token) -> None:
+        # Device handles are None (a no-op for the pipeline too), so
+        # one forward covers both sources.
+        self._wire.mark_consumed(handle, token)
+
+    def metrics(self) -> dict:
+        m = dict(self._wire.metrics())
+        m.update(self._device.metrics())
+        m["mixed_wire_batches"] = self.wire_batches
+        m["mixed_device_batches"] = self.device_batches
+        return m
+
+    def close(self) -> None:
+        self._wire.close()
+        self._device.close()
 
 
 class _PipelineClosed(Exception):

@@ -59,6 +59,16 @@ _REGISTRY = {
     "SyntheticPixelsSmall-v0": SyntheticPixelsSmall,
 }
 
+def registered_names():
+    """Sorted names of every registered pure-JAX env — the
+    device-residentable set: each one's canonical wrapped stack is
+    pinned jit+scan+shard_map-safe (tests/test_envs.py), so any of
+    them can compile into the fused Anakin program
+    (``ImpalaConfig.rollout_mode='device'``). Host-bridged ``gym:`` /
+    ``native:`` envs are deliberately absent."""
+    return sorted(_REGISTRY)
+
+
 # Host envs are stateful (the simulator lives host-side), so repeated
 # make() calls for the same (id, width) must share ONE instance — the
 # trainers build a local-width and a global-width env and expect them

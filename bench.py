@@ -33,6 +33,16 @@ exists to hide). Merged into the same JSON line under
 ``"impala_pipeline"``; off by default so the driver contract is
 unchanged.
 
+The BENCH_IMPALA flag also runs a device-resident third leg in its own
+subprocess: serial vs pipelined vs the fused Anakin program
+(``rollout_mode="device"`` — env.step + act + V-trace as ONE jitted
+dispatch, zero host transfer) on CartPole and SyntheticPixelsSmall,
+merged under ``"impala_device"`` with the honest ``cpu_limited`` flag
+discipline from BENCH_SHARD (on a host with fewer cores than the
+pipelined mode's actor threads + learner, the ratio partly measures
+the removal of thread timesharing, not just the removal of host
+transfer — recorded, not gamed).
+
 Optional param-sync wire leg (``BENCH_PARAMS=1``): a third subprocess
 replays a converging CartPole publish stream through a real
 LearnerServer/ActorClient pair and reports wire bytes per
@@ -211,6 +221,118 @@ def measure_impala() -> dict:
     return out
 
 
+def measure_impala_device() -> dict:
+    """Device-resident IMPALA leg: serial vs pipelined vs the fused
+    Anakin program (``rollout_mode="device"``) steps/sec per env, plus
+    the pipelined mode's stall share and the device mode's
+    dispatch-time share. Same measurement discipline as
+    ``measure_impala`` (median of post-compile log windows)."""
+    import statistics
+
+    from actor_critic_algs_on_tensorflow_tpu.algos.impala import (
+        ImpalaConfig,
+        run_impala,
+    )
+
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts"),
+    )
+    from shard_bench import _cpu_budget
+
+    iters = int(os.environ.get("BENCH_IMPALA_DEVICE_ITERS", 40))
+    num_actors = int(os.environ.get("BENCH_IMPALA_ACTORS", 4))
+    env_names = os.environ.get(
+        "BENCH_IMPALA_DEVICE_ENVS", "CartPole-v1,SyntheticPixelsSmall-v0"
+    ).split(",")
+    out = {}
+    for env_name in env_names:
+        # Pixel envs step ~40x the obs bytes of CartPole; keep the
+        # fleet smaller so all three modes finish in bench time.
+        pixels = "Pixels" in env_name or "Pong" in env_name
+        envs_per_actor = int(
+            os.environ.get(
+                "BENCH_IMPALA_DEVICE_EPA", 16 if pixels else 64
+            )
+        )
+        base = dict(
+            env=env_name,
+            num_actors=num_actors,
+            envs_per_actor=envs_per_actor,
+            rollout_length=32,
+            batch_trajectories=4,
+            queue_size=8,
+            lr_decay=False,
+        )
+        steps_per_batch = 4 * envs_per_actor * 32
+        leg = {}
+        for mode, kw in (
+            ("serial", dict(pipeline=False)),
+            ("pipelined", dict(pipeline=True)),
+            ("device", dict(rollout_mode="device")),
+        ):
+            cfg = ImpalaConfig(
+                **base, **kw, total_env_steps=iters * steps_per_batch
+            )
+            log_t = []
+            t0 = time.perf_counter()
+            _, history = run_impala(
+                cfg, log_interval=10,
+                log_fn=lambda s, m: log_t.append(time.perf_counter()),
+            )
+            # Window 0 pays XLA compilation: rates AND the share
+            # denominators use the post-compile windows only (wall
+            # between the first and last log), so the shares describe
+            # the steady-state hot loop, not the compile. With a
+            # single log window (tiny ITERS, e.g. the smoke test) the
+            # whole run is the window — compile included, matching the
+            # rate fallback above.
+            windows = history[1:] if len(history) > 1 else history
+            steady_wall = (
+                log_t[-1] - log_t[0] if len(log_t) > 1
+                else max(log_t[-1] - t0, 1e-9)
+            )
+            rates, stall_s, device_s = [], 0.0, 0.0
+            for _, m in windows:
+                rates.append(m["steps_per_sec"])
+                stall_s += m.get("pipeline_stall_s", 0.0)
+                device_s += m.get("device_step_s", 0.0)
+            leg[f"{mode}_steps_per_sec"] = round(
+                statistics.median(rates), 1
+            )
+            if mode == "pipelined":
+                leg["pipelined_stall_share"] = round(
+                    stall_s / max(steady_wall, 1e-9), 4
+                )
+            if mode == "device":
+                # Share of steady-state wall spent inside the fused
+                # dispatch+sync: ~1.0 means the host adds nothing to
+                # the hot loop (no transfer, no assembly, no queue).
+                leg["device_step_share"] = round(
+                    device_s / max(steady_wall, 1e-9), 4
+                )
+        leg["device_vs_pipelined"] = round(
+            leg["device_steps_per_sec"]
+            / max(leg["pipelined_steps_per_sec"], 1e-9),
+            4,
+        )
+        leg["device_vs_serial"] = round(
+            leg["device_steps_per_sec"]
+            / max(leg["serial_steps_per_sec"], 1e-9),
+            4,
+        )
+        leg["steps_per_batch"] = steps_per_batch
+        out[env_name.replace("-", "_").lower()] = leg
+    out["iters"] = iters
+    out["cpus"] = _cpu_budget()
+    # Fewer cores than the pipelined mode's concurrent workers (actor
+    # threads + learner + prefetch): the device-vs-pipelined ratio then
+    # partly measures the removal of thread timesharing, not only the
+    # removal of host transfer (BENCH_SHARD discipline).
+    out["cpu_limited"] = out["cpus"] < num_actors + 2
+    return out
+
+
 def measure_params() -> dict:
     """Param-sync wire codec leg (scripts/controlplane_bench.py owns
     the measurement helpers): per-fetch wire bytes over a converging
@@ -384,6 +506,14 @@ def main() -> int:
             return 1
         return 0
 
+    if len(sys.argv) > 1 and sys.argv[1] == "--measure-impala-device":
+        try:
+            print(json.dumps(measure_impala_device()))
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+            return 1
+        return 0
+
     if len(sys.argv) > 1 and sys.argv[1] == "--measure-params":
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         try:
@@ -541,6 +671,30 @@ def main() -> int:
             sys.stderr.write(
                 "[bench] impala pipeline leg failed\n"
                 + (child.stderr[-2000:] if "child" in dir() else "")
+            )
+    if os.environ.get("BENCH_IMPALA"):
+        # Third BENCH_IMPALA leg (ISSUE 11): serial vs pipelined vs
+        # the fused device-resident program, its own subprocess so a
+        # leg failure cannot cost the headline.
+        dvchild = None
+        try:
+            dvchild = subprocess.run(
+                [
+                    sys.executable, os.path.abspath(__file__),
+                    "--measure-impala-device",
+                ],
+                capture_output=True,
+                text=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                timeout=int(os.environ.get("BENCH_CHILD_TIMEOUT", 900)),
+            )
+            payload["impala_device"] = json.loads(
+                dvchild.stdout.strip().splitlines()[-1]
+            )
+        except Exception:
+            sys.stderr.write(
+                "[bench] impala device leg failed\n"
+                + (dvchild.stderr[-2000:] if dvchild is not None else "")
             )
     if os.environ.get("BENCH_PARAMS"):
         try:

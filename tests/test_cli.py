@@ -214,6 +214,54 @@ def test_election_knobs_coerce_via_set():
     assert cfg.election_probe_attempts == 3
 
 
+def test_rollout_mode_coerces_via_set():
+    """The device-resident fast path rides --set with the config's
+    string coercion (ISSUE 11 satellite)."""
+    args = cli.build_parser().parse_args(
+        ["--preset", "impala-cartpole", "--set", "rollout_mode=device",
+         "--set", "mixed_device_per_wire=3"]
+    )
+    _, cfg = cli.make_config(args)
+    assert cfg.rollout_mode == "device"
+    assert cfg.mixed_device_per_wire == 3
+    # Default stays the classic host-ingest topology.
+    _, cfg = cli.make_config(
+        cli.build_parser().parse_args(["--preset", "impala-cartpole"])
+    )
+    assert cfg.rollout_mode == "host"
+
+
+def test_rollout_mode_flag_refusals():
+    """rollout_mode='device'/'mixed' reject the wire-topology flags
+    with the fix in the message (ISSUE 11 satellite): --standby,
+    --shard, and the actor-process mismatches."""
+    def _cfg_for(extra):
+        args = cli.build_parser().parse_args(
+            ["--preset", "impala-cartpole",
+             "--set", "rollout_mode=device"] + extra
+        )
+        return args, cli.make_config(args)[1]
+
+    args, cfg = _cfg_for(
+        ["--standby", "127.0.0.1:7000", "--checkpoint-dir", "/tmp/nope"]
+    )
+    with pytest.raises(SystemExit, match="rollout_mode='host'"):
+        cli._run(args, "impala", cfg, None)
+    args, cfg = _cfg_for(["--actor-processes", "--shard", "2"])
+    with pytest.raises(SystemExit, match="already shards envs"):
+        cli._run(args, "impala", cfg, None)
+    args, cfg = _cfg_for(["--actor-processes"])
+    with pytest.raises(SystemExit, match="drop --actor-processes"):
+        cli._run(args, "impala", cfg, None)
+    # mixed without a wire fleet to interleave with.
+    args = cli.build_parser().parse_args(
+        ["--preset", "impala-cartpole", "--set", "rollout_mode=mixed"]
+    )
+    _, cfg = cli.make_config(args)
+    with pytest.raises(SystemExit, match="pass --actor-processes"):
+        cli._run(args, "impala", cfg, None)
+
+
 def test_coordinator_leader_follower_roundtrip_via_cli_specs():
     """make_coordinator builds a working leader/follower pair."""
     import threading

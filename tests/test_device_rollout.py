@@ -1,0 +1,355 @@
+"""Device-resident fast path (ISSUE 11): the fused Anakin program
+(env.step + act + segment assembly + V-trace learner step as ONE
+jitted shard_map dispatch), the rollout_mode config boundary, the
+mixed device+wire interleave, and the BENCH_IMPALA device leg."""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from actor_critic_algs_on_tensorflow_tpu.algos import impala
+
+
+def _cfg(**kw):
+    base = dict(
+        env="CartPole-v1",
+        num_actors=2,
+        envs_per_actor=4,
+        rollout_length=8,
+        batch_trajectories=2,
+        queue_size=4,
+        total_env_steps=2 * 4 * 8 * 5,  # 5 learner steps
+        rollout_mode="device",
+    )
+    base.update(kw)
+    return impala.ImpalaConfig(**base)
+
+
+# ---------------------------------------------------------------------
+# Config boundary: loud refusals with the fix in the message.
+# ---------------------------------------------------------------------
+
+def test_rollout_mode_validation():
+    with pytest.raises(ValueError, match="rollout_mode must be"):
+        impala.make_impala(_cfg(rollout_mode="bogus"))
+    with pytest.raises(ValueError, match="env_shim"):
+        impala.make_impala(_cfg(actor_mode="env_shim"))
+    with pytest.raises(ValueError, match="recurrent=False"):
+        impala.make_impala(_cfg(recurrent=True))
+    with pytest.raises(ValueError, match="host-bridged env"):
+        impala.make_impala(_cfg(env="gym:CartPole-v1"))
+    with pytest.raises(ValueError, match="host-bridged env"):
+        impala.make_impala(_cfg(env="native:cartpole"))
+    with pytest.raises(ValueError, match="time_shards=1"):
+        impala.make_impala(
+            _cfg(num_devices=8, time_shards=4, rollout_length=8)
+        )
+    with pytest.raises(ValueError, match="shard_count=1"):
+        impala.make_impala(_cfg(shard_count=2))
+    with pytest.raises(ValueError, match="mid_rollout_fetch"):
+        impala.make_impala(_cfg(mid_rollout_fetch=True))
+    with pytest.raises(ValueError, match="pipeline=True"):
+        impala.make_impala(
+            _cfg(rollout_mode="mixed", pipeline=False)
+        )
+    with pytest.raises(ValueError, match="mixed_device_per_wire"):
+        impala.make_impala(
+            _cfg(rollout_mode="mixed", mixed_device_per_wire=0)
+        )
+
+
+def test_runner_topology_refusals():
+    """Each runner rejects the modes it cannot serve, pointing at the
+    one that can."""
+    with pytest.raises(ValueError, match="run_impala_distributed"):
+        impala.run_impala(_cfg(rollout_mode="mixed"))
+    with pytest.raises(ValueError, match="inject_"):
+        impala.run_impala(_cfg(), inject_failure_at=1)
+    with pytest.raises(ValueError, match="rollout_mode='mixed'"):
+        impala.run_impala_distributed(_cfg(rollout_mode="device"))
+    with pytest.raises(ValueError, match="rollout_mode="):
+        impala.run_impala_standby(
+            _cfg(),
+            checkpointer=None,
+            primary_host="127.0.0.1",
+            primary_port=1,
+        )
+
+
+def test_host_mode_builds_no_device_programs():
+    programs = impala.make_impala(_cfg(rollout_mode="host"))
+    assert programs.fused_iteration is None
+    assert programs.collect_batch is None
+    assert programs.env_reset_device is None
+    # The V-trace probe exists in EVERY mode (it is the cross-mode
+    # bit-identity witness).
+    assert programs.vtrace_targets is not None
+
+
+# ---------------------------------------------------------------------
+# Numerics: the fused program IS the staged program.
+# ---------------------------------------------------------------------
+
+def test_fused_iteration_matches_staged_bitwise():
+    """ONE jitted collect+learn dispatch must produce bit-identical
+    params and metrics to collect_batch -> learner_step on the same
+    (state, env, key) — the fusion boundary moves no float."""
+    cfg = _cfg()
+    p = impala.make_impala(cfg)
+    state = p.init(jax.random.PRNGKey(0))
+    env_state, obs = p.env_reset_device(jax.random.PRNGKey(1))
+    key = jax.random.PRNGKey(2)
+
+    _, _, batch, _ = p.collect_batch(state.params, env_state, obs, key)
+    staged_state, staged_metrics = p.learner_step(state, batch)
+    fused_state, _, _, fused_metrics, _ = p.fused_iteration(
+        state, env_state, obs, key
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(jax.device_get(staged_state.params)),
+        jax.tree_util.tree_leaves(jax.device_get(fused_state.params)),
+    ):
+        np.testing.assert_array_equal(a, b)
+    for k in staged_metrics:
+        np.testing.assert_array_equal(
+            np.asarray(staged_metrics[k]), np.asarray(fused_metrics[k]),
+            err_msg=k,
+        )
+
+
+def test_vtrace_targets_bit_identical_across_modes():
+    """One trajectory stream, the host build's V-trace targets vs the
+    device build's: bit-identical (both compile the one shared
+    _vtrace_of code path)."""
+    cfg_dev = _cfg()
+    cfg_host = _cfg(rollout_mode="host")
+    p_dev = impala.make_impala(cfg_dev)
+    p_host = impala.make_impala(cfg_host)
+    state = p_dev.init(jax.random.PRNGKey(0))
+    env_state, obs = p_dev.env_reset_device(jax.random.PRNGKey(1))
+    _, _, batch, _ = p_dev.collect_batch(
+        state.params, env_state, obs, jax.random.PRNGKey(2)
+    )
+    vt_dev = p_dev.vtrace_targets(state.params, batch)
+    vt_host = p_host.vtrace_targets(state.params, batch)
+    for a, b, name in zip(vt_dev, vt_host, ("vs", "pg_advantages", "rhos")):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=name
+        )
+    # On-policy device batches: rho == 1 exactly.
+    np.testing.assert_allclose(np.asarray(vt_dev.rhos), 1.0, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------
+# The device run loop.
+# ---------------------------------------------------------------------
+
+def test_run_impala_device_end_to_end():
+    """The fused loop drains the step budget with zero actor threads,
+    publishes params, and surfaces device_* metrics in the log
+    stream."""
+    cfg = _cfg()
+    logs = []
+    state, history = impala.run_impala(
+        cfg, log_interval=1, log_fn=lambda s, m: logs.append((s, m))
+    )
+    assert int(state.step) == 5
+    assert len(history) == 5
+    final = history[-1][1]
+    assert final["param_version"] >= 1
+    assert np.isfinite(final["loss"])
+    assert "device_step_s" in final  # the device_* time split
+    assert "queue_gets" not in final  # no queue anywhere near the loop
+    assert not any(
+        t.name.startswith("impala-actor") and t.is_alive()
+        for t in threading.enumerate()
+    )
+
+
+def test_device_compile_count_guard():
+    """The fused program compiles exactly once per (config, shape)
+    across a multi-iteration run — recompile-per-step is the classic
+    silent 100x regression in the Anakin pattern."""
+    cfg = _cfg()
+    programs = impala.make_impala(cfg)
+    if not hasattr(programs.fused_iteration, "_cache_size"):
+        pytest.skip("jit cache-size introspection unavailable")
+    state, _ = impala.run_impala(
+        cfg, log_interval=1, log_fn=lambda s, m: None, programs=programs
+    )
+    assert int(state.step) == 5
+    # Exactly ONE trace across the run, whichever variant the backend
+    # selects (plain under the CPU-mesh exec lock; donated where
+    # donation is supported and the lock is off).
+    assert (
+        programs.fused_iteration._cache_size()
+        + programs.fused_iteration_donated._cache_size()
+    ) == 1
+    assert programs.env_reset_device._cache_size() == 1
+
+
+def test_device_mode_checkpoint_and_resume(tmp_path):
+    """Device runs share the wire modes' checkpoint machinery: a
+    resumed run trains only the remaining budget."""
+    from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
+        Checkpointer,
+    )
+
+    cfg = _cfg(total_env_steps=2 * 4 * 8 * 4)  # 4 learner steps
+    ck = Checkpointer(str(tmp_path))
+    state, _ = impala.run_impala(
+        cfg, log_interval=10, log_fn=lambda s, m: None,
+        checkpointer=ck, checkpoint_interval=3,
+    )
+    assert int(state.step) == 4
+    assert ck.latest_step() == 3 * (2 * 4 * 8)  # saved at iteration 3
+    restored = ck.restore(
+        jax.eval_shape(
+            impala.make_impala(cfg).init, jax.random.PRNGKey(cfg.seed)
+        ),
+    )
+    ck.close()
+    assert int(jax.device_get(restored.step)) == 3
+    state2, history2 = impala.run_impala(
+        cfg, log_interval=10, log_fn=lambda s, m: None,
+        initial_state=restored,
+    )
+    # Only the remaining 1 iteration of the budget is trained.
+    assert int(state2.step) == 4
+    assert len(history2) == 1
+
+
+# ---------------------------------------------------------------------
+# Mixed mode: device self-play + wire actors, one learner state.
+# ---------------------------------------------------------------------
+
+def test_interleaved_source_schedule_and_forwarding():
+    """Unit: the deterministic device_per_wire schedule and the
+    mark_consumed/metrics/close forwarding."""
+    from actor_critic_algs_on_tensorflow_tpu.data.pipeline import (
+        InterleavedSource,
+    )
+
+    class FakeSource:
+        def __init__(self, tag):
+            self.tag = tag
+            self.consumed = []
+            self.closed = False
+
+        def get(self, timeout=0.5, stop=None, max_wait_s=None):
+            return (self.tag, [], self.tag)
+
+        def mark_consumed(self, handle, token):
+            self.consumed.append((handle, token))
+
+        def metrics(self):
+            return {f"{self.tag}_m": 1}
+
+        def close(self):
+            self.closed = True
+
+    wire, device = FakeSource("wire"), FakeSource("device")
+    src = InterleavedSource(wire, device, device_per_wire=2)
+    order = [src.get()[0] for _ in range(6)]
+    assert order == ["device", "device", "wire", "device", "device", "wire"]
+    assert src.device_batches == 4 and src.wire_batches == 2
+    src.mark_consumed("h", "tok")
+    assert wire.consumed == [("h", "tok")] and device.consumed == []
+    m = src.metrics()
+    assert m["wire_m"] == 1 and m["device_m"] == 1
+    assert m["mixed_device_batches"] == 4
+    src.close()
+    assert wire.closed and device.closed
+
+
+def test_mixed_mode_end_to_end():
+    """One job: device-resident self-play interleaved with a
+    wire-attached classic actor process, both feeding the SAME learner
+    state through one publish/sentinel/log path (ISSUE 11 acceptance
+    pin)."""
+    cfg = _cfg(
+        rollout_mode="mixed",
+        mixed_device_per_wire=2,
+        num_actors=1,
+        total_env_steps=2 * 4 * 8 * 6,  # 6 learner steps
+        seed=3,
+    )
+    state, history = impala.run_impala_distributed(cfg, log_interval=1)
+    assert int(state.step) == 6
+    last = history[-1][1]
+    # Deterministic schedule: 4 device + 2 wire batches in 6 steps.
+    assert last["mixed_device_batches"] == 4
+    assert last["mixed_wire_batches"] == 2
+    assert last["transport_trajectories"] >= 2  # the wire leg really fed
+    assert last["param_version"] >= 2
+    assert np.isfinite(last["loss"])
+
+
+# ---------------------------------------------------------------------
+# BENCH_IMPALA device leg.
+# ---------------------------------------------------------------------
+
+def test_bench_impala_device_leg_smoke(monkeypatch):
+    """Tier-1 smoke of the measurement contract: tiny real runs of all
+    three modes, fields present and sane."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "bench",
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "bench.py",
+        ),
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    monkeypatch.setenv("BENCH_IMPALA_DEVICE_ITERS", "3")
+    monkeypatch.setenv("BENCH_IMPALA_DEVICE_ENVS", "CartPole-v1")
+    monkeypatch.setenv("BENCH_IMPALA_DEVICE_EPA", "8")
+    monkeypatch.setenv("BENCH_IMPALA_ACTORS", "2")
+    out = bench.measure_impala_device()
+    leg = out["cartpole_v1"]
+    for k in (
+        "serial_steps_per_sec",
+        "pipelined_steps_per_sec",
+        "device_steps_per_sec",
+        "device_vs_pipelined",
+        "pipelined_stall_share",
+        "device_step_share",
+    ):
+        assert k in leg, leg
+        assert leg[k] >= 0
+    assert leg["steps_per_batch"] == 4 * 8 * 32
+    assert isinstance(out["cpu_limited"], bool)
+
+
+# ---------------------------------------------------------------------
+# Learning parity (slow): the acceptance-criterion pin.
+# ---------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_device_mode_learns_cartpole():
+    """Fixed-seed device-resident CartPole reaches the SAME greedy-eval
+    bar the pipelined path is pinned to (test_impala_learns_cartpole:
+    >= 150 over 32 full-horizon envs) — learning parity within seed
+    noise."""
+    from helpers import greedy_cartpole_return
+
+    cfg = _cfg(
+        num_actors=4,
+        envs_per_actor=4,
+        rollout_length=16,
+        batch_trajectories=4,
+        total_env_steps=600_000,
+        lr=1e-3,
+        ent_coef=0.01,
+        seed=0,
+    )
+    state, _ = impala.run_impala(cfg, log_interval=50)
+    mean_ret, frac_done = greedy_cartpole_return(state.params)
+    assert frac_done == 1.0
+    assert mean_ret >= 150.0, mean_ret

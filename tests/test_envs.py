@@ -4,11 +4,14 @@ logic, wrapper semantics, scan-compatibility."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from actor_critic_algs_on_tensorflow_tpu import envs
 from actor_critic_algs_on_tensorflow_tpu.envs import (
     AutoReset,
+    Box,
     CartPole,
+    Discrete,
     EpisodeStats,
     FrameStack,
     PongTPU,
@@ -162,6 +165,59 @@ def test_vecenv_scan_rollout():
     state, (obs_seq, r_seq, d_seq) = run(state, jax.random.PRNGKey(7))
     assert obs_seq.shape == (32, 8, 4)
     assert float(r_seq.sum()) == 32 * 8  # reward 1 every step
+
+
+@pytest.mark.parametrize("name", envs.registered_names())
+def test_registered_env_anakin_stack(name):
+    """EVERY registered pure-JAX env's canonical stack must run under
+    jit + lax.scan (the Anakin pattern) — "this env is
+    device-residentable" is a pinned property of the registry, not
+    folklore (ISSUE 11: the fused IMPALA program compiles any of
+    them). Pins: the jitted scan runs, shapes/dtypes are stable, the
+    EpisodeStats info leaves the fused program ships are present, and
+    every reward is finite."""
+    n_envs, length = 4, 8
+    env, params = envs.make(name, num_envs=n_envs)
+    state, obs = env.reset(jax.random.PRNGKey(0), params)
+    assert obs.shape[0] == n_envs
+    space = env.action_space(params)
+
+    def sample_actions(key):
+        if isinstance(space, Discrete):
+            return jax.random.randint(key, (n_envs,), 0, space.n)
+        assert isinstance(space, Box)
+        return jax.random.uniform(
+            key, (n_envs,) + space.shape,
+            minval=space.low, maxval=space.high,
+        )
+
+    def _step(carry, key):
+        state, obs = carry
+        state, obs2, r, d, info = env.step(
+            key, state, sample_actions(key), params
+        )
+        assert obs2.shape == obs.shape and obs2.dtype == obs.dtype
+        ep = {
+            "episode_return": info["episode_return"],
+            "done_episode": info["done_episode"],
+        }
+        return (state, obs2), (r, d, ep)
+
+    @jax.jit
+    def run(state, obs, key):
+        return jax.lax.scan(
+            _step, (state, obs), jax.random.split(key, length)
+        )
+
+    (state, obs), (rews, dones, ep) = run(state, obs, jax.random.PRNGKey(7))
+    assert rews.shape == (length, n_envs)
+    assert bool(jnp.all(jnp.isfinite(rews)))
+    assert ep["episode_return"].shape == (length, n_envs)
+    # Same shapes again: the jitted program is reusable (no retrace
+    # needed for a second rollout — the fused loop's steady state).
+    run(state, obs, jax.random.PRNGKey(8))
+    if hasattr(run, "_cache_size"):
+        assert run._cache_size() == 1
 
 
 def test_autoreset_exposes_final_obs():
