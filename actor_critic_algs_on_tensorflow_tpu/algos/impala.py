@@ -62,6 +62,7 @@ from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
     shard_map,
 )
 from actor_critic_algs_on_tensorflow_tpu.utils import health as health_lib
+from actor_critic_algs_on_tensorflow_tpu.utils import metric_names
 
 TIME_AXIS = "time"
 
@@ -1411,7 +1412,7 @@ def _learner_loop(
             return None
         return tree
 
-    device_split = TimeSplit(prefix="device_")
+    device_split = TimeSplit(prefix=metric_names.DEVICE)
     pipe = ingest
     if pipe is None and cfg.pipeline and fused_step is None:
 

@@ -1,0 +1,53 @@
+"""Repo-native static analysis: machine-checked invariants.
+
+PRs 1-11 grew a distributed runtime whose correctness rests on
+hand-maintained invariants — unique ``KIND_*`` values, the
+donation-then-never-reuse buffer discipline, no ``settimeout`` on
+sockets shared between threads (the PR-5 notify race), bounded lock
+acquires on broadcast paths (the PR-10 deflake), and config-knob /
+metric / doc agreement. Each has been violated at least once and
+caught only by review or a flaky tier-1 run. This package turns that
+review folklore into checkers that run over the whole tree as a
+tier-1 gate (``tests/test_static_analysis.py``) and a pre-commit
+runner (``scripts/check.py``).
+
+Layout:
+
+  - ``core``          shared Finding type, checker registry, baseline
+                      (suppression) loading, file discovery, and the
+                      fixture-expectation scanner the analyzer tests use
+  - ``wire_protocol`` WIRE*: KIND_/CAP_/ROLE_ registry + hello arity
+  - ``jit_hazards``   JIT*: host nondeterminism in traced bodies,
+                      donated-buffer reuse, jit-in-a-loop recompiles
+  - ``lock_hygiene``  LOCK*: shared-socket settimeout, unbounded lock
+                      acquires on broadcast paths, deadline-less recv
+  - ``drift``         DRIFT*: config knob / CLI / README / metric-name
+                      registry agreement (utils.metric_names)
+  - ``bench_schema``  BENCH*: BENCH_*.json / MULTICHIP_*.json ledger
+                      schema (shared key set, numeric fields, flag types)
+  - ``markers``       MARK*: pytest markers used in tests/ must be
+                      declared in pytest.ini
+
+Importing this package registers every checker in ``core.CHECKERS``.
+"""
+
+from actor_critic_algs_on_tensorflow_tpu.analysis.core import (  # noqa: F401
+    CHECKERS,
+    Finding,
+    Suppression,
+    apply_baseline,
+    default_baseline_path,
+    load_baseline,
+    repo_files,
+    run_checkers,
+)
+
+# Importing the checker modules registers them (decorator side effect).
+from actor_critic_algs_on_tensorflow_tpu.analysis import (  # noqa: F401,E402
+    bench_schema,
+    drift,
+    jit_hazards,
+    lock_hygiene,
+    markers,
+    wire_protocol,
+)

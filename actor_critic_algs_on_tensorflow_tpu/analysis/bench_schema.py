@@ -1,0 +1,166 @@
+"""BENCH*: the bench-ledger JSON schema, checked like code.
+
+``BENCH_r*.json`` / ``MULTICHIP_r*.json`` are the per-round perf
+ledgers every PR appends to; cross-round comparisons silently rot
+when a round drops a key or retypes a field. Rules:
+
+  BENCH001  a ledger file is unparsable or missing its required
+            top-level keys (BENCH: ``n/cmd/rc/tail/parsed``;
+            MULTICHIP: ``n_devices/rc/ok/skipped/tail``)
+  BENCH002  a typed field is mistyped — ``parsed.metric``/``unit``
+            strings, ``parsed.value``/``vs_baseline`` numerics (and
+            not bool), ``n_devices``/``rc`` ints, ``ok``/``skipped``
+            bools
+  BENCH003  a ``cpu_limited`` flag anywhere in a ledger is not a
+            bool (the honesty flag must stay machine-readable)
+
+Findings anchor to line 1 of the JSON file (ledgers are generated,
+not hand-edited — the fix is in the generator).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import List, Sequence
+
+from actor_critic_algs_on_tensorflow_tpu.analysis.core import (
+    Finding,
+    checker,
+    rel,
+)
+
+BENCH_REQUIRED = ("n", "cmd", "rc", "tail", "parsed")
+PARSED_REQUIRED = ("metric", "value", "unit", "vs_baseline")
+MULTICHIP_REQUIRED = ("n_devices", "rc", "ok", "skipped", "tail")
+
+
+def _is_number(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _walk_cpu_limited(obj, path, out):
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            if k == "cpu_limited" and not isinstance(v, bool):
+                out.append((f"{path}.{k}".lstrip("."), v))
+            _walk_cpu_limited(v, f"{path}.{k}", out)
+    elif isinstance(obj, list):
+        for i, v in enumerate(obj):
+            _walk_cpu_limited(v, f"{path}[{i}]", out)
+
+
+def _check_typed(findings, path, where, obj, spec):
+    """``spec``: key -> ("num"|"int"|"bool"|"str")."""
+    for key, kind in spec.items():
+        if key not in obj:
+            continue
+        v = obj[key]
+        ok = {
+            "num": _is_number(v),
+            "int": isinstance(v, int) and not isinstance(v, bool),
+            "bool": isinstance(v, bool),
+            "str": isinstance(v, str),
+        }[kind]
+        if not ok:
+            findings.append(Finding(
+                "BENCH002", path, 1,
+                f"{where}{key} should be {kind}, got "
+                f"{type(v).__name__} ({v!r})",
+                hint="fix the generator (bench.py / scripts/*_bench"
+                     ".py) — ledger fields are compared across "
+                     "rounds",
+            ))
+
+
+@checker(
+    "bench-schema",
+    rules=("BENCH001", "BENCH002", "BENCH003"),
+    anchors=("BENCH_*.json", "MULTICHIP_*.json", "bench.py",
+             "scripts/*_bench.py"),
+)
+def check(root: Path, files: Sequence[Path]) -> List[Finding]:
+    """Bench-ledger schema: shared key set, numeric value fields,
+    cpu_limited flag typing."""
+    findings: List[Finding] = []
+    for p in files:
+        if p.suffix != ".json":
+            continue
+        is_bench = p.name.startswith("BENCH_")
+        is_multi = p.name.startswith("MULTICHIP_")
+        if not (is_bench or is_multi):
+            continue
+        path = rel(root, p)
+        try:
+            data = json.loads(p.read_text(encoding="utf-8"))
+        except (OSError, ValueError) as e:
+            findings.append(Finding(
+                "BENCH001", path, 1,
+                f"unparsable ledger: {e}",
+                hint="regenerate the round from bench.py",
+            ))
+            continue
+        if not isinstance(data, dict):
+            findings.append(Finding(
+                "BENCH001", path, 1,
+                f"ledger top level should be an object, got "
+                f"{type(data).__name__}",
+                hint="regenerate the round from bench.py",
+            ))
+            continue
+        required = BENCH_REQUIRED if is_bench else MULTICHIP_REQUIRED
+        missing = [k for k in required if k not in data]
+        if missing:
+            findings.append(Finding(
+                "BENCH001", path, 1,
+                f"ledger missing required key(s) {missing} — the "
+                f"shared cross-round key set broke",
+                hint="every round must carry the same top-level "
+                     "keys; fix the generator",
+            ))
+        if is_bench:
+            _check_typed(findings, path, "", data,
+                         {"n": "int", "cmd": "str", "rc": "int",
+                          "tail": "str"})
+            parsed = data.get("parsed")
+            if parsed is not None:
+                if not isinstance(parsed, dict):
+                    findings.append(Finding(
+                        "BENCH001", path, 1,
+                        f"parsed should be an object, got "
+                        f"{type(parsed).__name__}",
+                        hint="fix the generator",
+                    ))
+                else:
+                    pmissing = [
+                        k for k in PARSED_REQUIRED if k not in parsed
+                    ]
+                    if pmissing:
+                        findings.append(Finding(
+                            "BENCH001", path, 1,
+                            f"parsed missing required key(s) "
+                            f"{pmissing}",
+                            hint="parsed carries the headline "
+                                 "metric; every round needs "
+                                 f"{list(PARSED_REQUIRED)}",
+                        ))
+                    _check_typed(findings, path, "parsed.", parsed,
+                                 {"metric": "str", "value": "num",
+                                  "unit": "str", "vs_baseline": "num",
+                                  "median": "num", "spread": "num"})
+        else:
+            _check_typed(findings, path, "", data,
+                         {"n_devices": "int", "rc": "int",
+                          "ok": "bool", "skipped": "bool",
+                          "tail": "str"})
+        bad_flags: List = []
+        _walk_cpu_limited(data, "", bad_flags)
+        for where, v in bad_flags:
+            findings.append(Finding(
+                "BENCH003", path, 1,
+                f"cpu_limited at {where} should be bool, got "
+                f"{type(v).__name__} ({v!r})",
+                hint="the honesty flag gates cross-host comparisons; "
+                     "emit a real bool",
+            ))
+    return findings

@@ -1,0 +1,368 @@
+"""Shared core for the static-analysis pass.
+
+Everything here is dependency-free stdlib (no jax, no numpy): the
+checkers parse source with ``ast`` and never import the code under
+analysis, so ``scripts/check.py`` runs in well under a second even on
+hosts without an accelerator stack.
+
+Three pieces:
+
+  - ``Finding`` + the ``CHECKERS`` registry (populated by the
+    ``@checker`` decorator in each rule module);
+  - the baseline: ``analysis/baseline.toml`` suppresses findings that
+    are deliberate, each with a reason string, so the gate starts
+    green and STAYS strict — a suppression that stops matching
+    anything is itself reported (stale suppressions rot);
+  - fixture support: ``expected_findings`` reads ``# EXPECT: RULE``
+    comments out of the known-bad snippets under
+    ``tests/analysis_fixtures/`` so the analyzer tests assert exact
+    rule ids and line anchors.
+
+The repo runs Python 3.10 (no ``tomllib``), so the baseline uses a
+deliberately tiny TOML subset: ``[[suppress]]`` tables of
+``key = "string"`` pairs plus ``#`` comments. That subset is all a
+suppression needs and keeps the file readable by real TOML parsers.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import re
+from pathlib import Path
+from typing import Callable, Dict, Iterable, List, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation, anchored to ``file:line``."""
+
+    rule: str      # e.g. "WIRE001"
+    file: str      # repo-root-relative posix path
+    line: int      # 1-indexed
+    message: str   # what is wrong
+    hint: str = ""  # one-line fix hint
+
+    def format(self) -> str:
+        out = f"{self.file}:{self.line} [{self.rule}] {self.message}"
+        if self.hint:
+            out += f"\n    fix: {self.hint}"
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class Checker:
+    """A registered rule module: ``run(root, files) -> findings``.
+
+    ``anchors`` are repo-relative glob patterns naming the inputs the
+    checker reads; ``scripts/check.py --changed`` skips a checker when
+    no changed path matches any anchor. Checkers always analyze their
+    FULL input set (cross-file invariants need the whole picture) —
+    the scoping only decides whether they run at all.
+    """
+
+    name: str
+    rules: Tuple[str, ...]
+    doc: str
+    run: Callable[[Path, Sequence[Path]], List[Finding]]
+    anchors: Tuple[str, ...]
+
+    def relevant_to(self, changed: Iterable[str]) -> bool:
+        return any(
+            fnmatch.fnmatch(path, pat)
+            for path in changed
+            for pat in self.anchors
+        )
+
+
+CHECKERS: Dict[str, Checker] = {}
+
+
+def checker(name: str, rules: Sequence[str], anchors: Sequence[str]):
+    """Register a checker function under ``name``."""
+
+    def deco(fn):
+        CHECKERS[name] = Checker(
+            name=name,
+            rules=tuple(rules),
+            doc=(fn.__doc__ or "").strip().splitlines()[0],
+            run=fn,
+            anchors=tuple(anchors),
+        )
+        return fn
+
+    return deco
+
+
+# Paths never analyzed: generated, vendored, or deliberately-bad code.
+EXCLUDE_PARTS = ("__pycache__", ".git", "analysis_fixtures", "native")
+
+
+def rel(root: Path, path: Path) -> str:
+    try:
+        return path.resolve().relative_to(root.resolve()).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+def repo_files(root: Path) -> List[Path]:
+    """Every analyzable file in the tree: ``*.py`` plus the bench
+    ledgers and pytest.ini the schema/marker checkers read."""
+    out = []
+    for pat in ("**/*.py", "BENCH_*.json", "MULTICHIP_*.json", "pytest.ini"):
+        for p in sorted(root.glob(pat)):
+            if any(part in EXCLUDE_PARTS for part in p.parts):
+                continue
+            out.append(p)
+    return out
+
+
+def parse_file(path: Path) -> ast.Module:
+    return ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+
+
+def run_checkers(
+    root: Path,
+    files: Sequence[Path] | None = None,
+    names: Sequence[str] | None = None,
+) -> List[Finding]:
+    """Run the named checkers (default: all) over ``files`` (default:
+    the whole tree) and return the combined findings, sorted."""
+    if files is None:
+        files = repo_files(root)
+    findings: List[Finding] = []
+    for name, chk in CHECKERS.items():
+        if names is not None and name not in names:
+            continue
+        findings.extend(chk.run(root, files))
+    return sorted(
+        findings, key=lambda f: (f.file, f.line, f.rule, f.message)
+    )
+
+
+# --- baseline (suppressions) -----------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Suppression:
+    """One deliberate exemption. Matches findings by rule + file (and
+    an optional message substring, so one entry never silently eats a
+    NEW violation of the same rule in the same file). ``line`` is
+    deliberately not part of the key — lines drift with every edit."""
+
+    rule: str
+    file: str
+    reason: str
+    contains: str = ""
+
+    def matches(self, f: Finding) -> bool:
+        return (
+            f.rule == self.rule
+            and fnmatch.fnmatch(f.file, self.file)
+            and (self.contains in f.message if self.contains else True)
+        )
+
+
+def default_baseline_path(root: Path) -> Path:
+    return (
+        root
+        / "actor_critic_algs_on_tensorflow_tpu"
+        / "analysis"
+        / "baseline.toml"
+    )
+
+
+# Values cannot contain double quotes; a trailing # comment after the
+# closing quote is allowed (and '#' INSIDE the quotes is part of the
+# value — the regex anchors on the last-before-comment quote).
+_TOML_KV = re.compile(
+    r'^\s*([A-Za-z_][A-Za-z0-9_]*)\s*=\s*"([^"]*)"\s*(?:#.*)?$'
+)
+
+
+def load_baseline(path: Path) -> List[Suppression]:
+    """Parse the ``[[suppress]]`` tables of the baseline file (tiny
+    TOML subset: string values only, ``#`` comments)."""
+    if not path.exists():
+        return []
+    sups: List[Suppression] = []
+    current: Dict[str, str] | None = None
+
+    def flush():
+        nonlocal current
+        if current is None:
+            return
+        missing = {"rule", "file", "reason"} - set(current)
+        if missing:
+            raise ValueError(
+                f"{path}: suppression {current} missing {sorted(missing)}"
+            )
+        if not current["reason"].strip():
+            raise ValueError(
+                f"{path}: suppression for {current['rule']} in "
+                f"{current['file']} has an empty reason — every "
+                f"exemption must be justified"
+            )
+        sups.append(
+            Suppression(
+                rule=current["rule"],
+                file=current["file"],
+                reason=current["reason"],
+                contains=current.get("contains", ""),
+            )
+        )
+        current = None
+
+    for lineno, raw in enumerate(path.read_text().splitlines(), 1):
+        line = raw.split("#", 1)[0].rstrip() if '"' not in raw else raw
+        stripped = line.strip()
+        if not stripped or stripped.startswith("#"):
+            continue
+        if stripped == "[[suppress]]":
+            flush()
+            current = {}
+            continue
+        m = _TOML_KV.match(stripped)
+        if m and current is not None:
+            current[m.group(1)] = m.group(2)
+            continue
+        raise ValueError(
+            f"{path}:{lineno}: unparsable baseline line {raw!r} "
+            f"(expected [[suppress]] tables of key = \"value\" pairs)"
+        )
+    flush()
+    return sups
+
+
+def apply_baseline(
+    findings: Sequence[Finding], sups: Sequence[Suppression]
+) -> Tuple[List[Finding], List[Tuple[Finding, Suppression]], List[Suppression]]:
+    """Split findings into (unsuppressed, suppressed-with-entry,
+    stale-suppressions-that-matched-nothing)."""
+    used = set()
+    kept: List[Finding] = []
+    quiet: List[Tuple[Finding, Suppression]] = []
+    for f in findings:
+        hit = next((s for s in sups if s.matches(f)), None)
+        if hit is None:
+            kept.append(f)
+        else:
+            used.add(id(hit))
+            quiet.append((f, hit))
+    stale = [s for s in sups if id(s) not in used]
+    return kept, quiet, stale
+
+
+# --- small AST helpers shared by the checkers ------------------------
+
+def const_int(node: ast.AST) -> int | None:
+    """Evaluate a compile-time integer expression (plain literals plus
+    the ``1 << 62`` / ``(1 << 48) - 1`` shapes the wire constants use)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    if isinstance(node, ast.BinOp):
+        left, right = const_int(node.left), const_int(node.right)
+        if left is None or right is None:
+            return None
+        if isinstance(node.op, ast.LShift):
+            return left << right
+        if isinstance(node.op, ast.Add):
+            return left + right
+        if isinstance(node.op, ast.Sub):
+            return left - right
+        if isinstance(node.op, ast.BitOr):
+            return left | right
+        if isinstance(node.op, ast.Mult):
+            return left * right
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        inner = const_int(node.operand)
+        return -inner if inner is not None else None
+    return None
+
+
+def fold_str(node: ast.AST, consts: Dict[str, str]) -> str | None:
+    """Fold a string expression to its value, resolving names through
+    ``consts`` (e.g. the ``metric_names`` constant map) and rendering
+    f-string interpolations as ``*`` wildcards. None when the
+    expression is not statically a string."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    if isinstance(node, ast.Attribute):
+        return consts.get(node.attr)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = fold_str(node.left, consts)
+        right = fold_str(node.right, consts)
+        if left is not None and right is not None:
+            return left + right
+        return None
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            elif isinstance(v, ast.FormattedValue):
+                folded = fold_str(v.value, consts)
+                parts.append(folded if folded is not None else "*")
+            else:
+                return None
+        return "".join(parts)
+    return None
+
+
+def func_name(node: ast.AST) -> str:
+    """Terminal name of a call target: ``a.b.c()`` -> ``c``."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def dotted_name(node: ast.AST) -> str:
+    """Best-effort dotted rendering: ``a.b.c`` -> ``"a.b.c"``."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted_name(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    return ""
+
+
+def enclosing_functions(tree: ast.Module):
+    """Yield ``(funcdef, qualname)`` for every function in the module,
+    with nested functions qualified ``outer.inner``."""
+
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                qual = f"{prefix}{child.name}"
+                yield child, qual
+                yield from walk(child, qual + ".")
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, f"{prefix}{child.name}.")
+            else:
+                yield from walk(child, prefix)
+
+    yield from walk(tree, "")
+
+
+# --- fixture expectations --------------------------------------------
+
+_EXPECT = re.compile(r"#\s*EXPECT:\s*([A-Z]+[0-9]+(?:\s*,\s*[A-Z]+[0-9]+)*)")
+
+
+def expected_findings(path: Path) -> set[Tuple[str, int]]:
+    """``(rule, line)`` pairs declared by ``# EXPECT: RULE[,RULE]``
+    comments in a fixture file. Every declared pair must fire and no
+    undeclared finding may — the analyzer tests assert set equality."""
+    out: set[Tuple[str, int]] = set()
+    for lineno, line in enumerate(path.read_text().splitlines(), 1):
+        m = _EXPECT.search(line)
+        if m:
+            for rule in m.group(1).split(","):
+                out.add((rule.strip(), lineno))
+    return out

@@ -1,0 +1,371 @@
+"""JIT*: tracing/donation discipline over the jitted hot paths.
+
+Scope: ``algos/``, ``ops/``, ``parallel/``, ``data/`` — the dirs whose
+functions end up inside ``jax.jit``/``shard_map``/``lax.scan``
+programs. Rules:
+
+  JIT001  host nondeterminism inside a traced function body —
+          ``time.time()``-family clocks, ``np.random.*``,
+          ``random.*`` draws, or ``.item()`` device syncs. Traced
+          once at compile time, these bake a single host value into
+          the program (or force a sync per call) instead of doing
+          what the author meant.
+  JIT002  reuse of an argument AFTER it was passed to a
+          ``*_donated`` program (``donate_argnums`` recycles the
+          buffer in place — the old value is garbage the moment the
+          call dispatches). The donation-then-never-reuse discipline,
+          made static.
+  JIT003  constructing a jit/pmap program inside a loop body — every
+          iteration re-wraps (and on Python-scalar closure capture,
+          re-traces) the function; the compile-count test's bug
+          class, caught before it costs a recompile storm.
+
+Traced scope detection is name-based within one module: decorated
+functions, functions passed to ``jit``/``pmap``/``shard_map``/
+``lax.scan``/``checkpoint``, lambdas passed to the same, and any
+function nested inside a traced one. Host-side loops (the learner
+loop's ``time.perf_counter`` bookkeeping) are outside every traced
+body and never match.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import List, Sequence
+
+from actor_critic_algs_on_tensorflow_tpu.analysis.core import (
+    Finding,
+    checker,
+    dotted_name,
+    func_name,
+    parse_file,
+    rel,
+)
+
+_SCOPE_DIRS = ("algos", "ops", "parallel", "data")
+
+# Call targets that trace their function argument.
+_TRACERS = {"jit", "pmap", "scan", "shard_map", "checkpoint", "remat",
+            "vmap", "grad", "value_and_grad", "fori_loop", "while_loop",
+            "cond", "switch"}
+# Tracers whose FIRST argument is the traced callable.
+_WRAPPERS = {"jit", "pmap", "shard_map", "checkpoint", "remat", "vmap",
+             "grad", "value_and_grad"}
+
+_CLOCKS = {"time.time", "time.perf_counter", "time.monotonic",
+           "time.process_time", "datetime.datetime.now"}
+
+
+def _in_scope(root: Path, path: Path) -> bool:
+    return any(part in _SCOPE_DIRS for part in path.parts)
+
+
+def _is_tracer_call(node: ast.Call) -> str:
+    """'' or the tracer name when ``node`` wraps/traces a callable."""
+    name = func_name(node.func)
+    if name in _TRACERS:
+        return name
+    # functools.partial(jax.jit, ...) — the partial's first arg is
+    # the tracer.
+    if name == "partial" and node.args:
+        inner = func_name(node.args[0])
+        if inner in _TRACERS:
+            return inner
+    return ""
+
+
+def _traced_callable_args(node: ast.Call):
+    """AST nodes of callables traced by this call (names + lambdas)."""
+    name = _is_tracer_call(node)
+    if not name:
+        return
+    args = node.args
+    if func_name(node.func) == "partial":
+        args = args[1:]
+    if name in _WRAPPERS:
+        cands = args[:1]
+    elif name == "scan":
+        cands = args[:1]
+    elif name in ("fori_loop", "while_loop"):
+        cands = args[:3]
+    elif name in ("cond", "switch"):
+        cands = args[1:]
+    else:
+        cands = args[:1]
+    for a in cands:
+        if isinstance(a, (ast.Name, ast.Lambda)):
+            yield a
+
+
+class _TracedScopes(ast.NodeVisitor):
+    """Collect function/lambda nodes that execute under a trace."""
+
+    def __init__(self, tree: ast.Module):
+        self.defs: dict[str, list[ast.AST]] = {}
+        self.traced: set[ast.AST] = set()
+        self._tree = tree
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs.setdefault(node.name, []).append(node)
+
+    def collect(self) -> set:
+        for node in ast.walk(self._tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    if func_name(target) in _WRAPPERS or (
+                        isinstance(dec, ast.Call) and _is_tracer_call(dec)
+                    ):
+                        self.traced.add(node)
+            elif isinstance(node, ast.Call):
+                for cal in _traced_callable_args(node):
+                    if isinstance(cal, ast.Lambda):
+                        self.traced.add(cal)
+                    else:
+                        for d in self.defs.get(cal.id, ()):
+                            self.traced.add(d)
+        # Close over nesting: anything defined inside a traced
+        # function is traced too.
+        grew = True
+        while grew:
+            grew = False
+            for t in list(self.traced):
+                for inner in ast.walk(t):
+                    if inner is t:
+                        continue
+                    if isinstance(
+                        inner,
+                        (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+                    ) and inner not in self.traced:
+                        self.traced.add(inner)
+                        grew = True
+        return self.traced
+
+
+def _own_statements(fn: ast.AST):
+    """Walk a traced function's nodes WITHOUT descending into nested
+    function/lambda bodies (those are traced scopes of their own)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _check_host_nondeterminism(path, tree, traced, findings):
+    for fn in traced:
+        for node in _own_statements(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = dotted_name(node.func)
+            leaf = func_name(node.func)
+            if dotted in _CLOCKS:
+                findings.append(Finding(
+                    "JIT001", path, node.lineno,
+                    f"host clock {dotted}() inside a traced function "
+                    f"body is baked in at trace time",
+                    hint="time on the host around the dispatch, or "
+                         "thread the value in as an argument",
+                ))
+            elif dotted.startswith(("np.random.", "numpy.random.",
+                                    "random.")):
+                findings.append(Finding(
+                    "JIT001", path, node.lineno,
+                    f"host RNG {dotted}() inside a traced function "
+                    f"body draws once at trace time",
+                    hint="use jax.random with an explicit key "
+                         "threaded through the program",
+                ))
+            elif leaf == "item" and not node.args and isinstance(
+                node.func, ast.Attribute
+            ):
+                findings.append(Finding(
+                    "JIT001", path, node.lineno,
+                    ".item() inside a traced function body forces a "
+                    "host sync (and fails under jit)",
+                    hint="keep the value on device; fetch scalars "
+                         "host-side after the dispatch",
+                ))
+
+
+def _assigned_names(stmt: ast.AST) -> set:
+    """Names (re)bound by a statement — ends the donated-reuse
+    tracking for those names."""
+    out = set()
+    targets = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign, ast.For)):
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.With):
+        targets = [
+            item.optional_vars
+            for item in stmt.items
+            if item.optional_vars is not None
+        ]
+    for t in targets:
+        for node in ast.walk(t):
+            if isinstance(node, ast.Name):
+                out.add(node.id)
+    return out
+
+
+def _donated_call(stmt: ast.AST, aliases: set) -> ast.Call | None:
+    # Only SIMPLE statements are donated-call sites at this level; a
+    # compound statement (for/while/if) containing one is handled by
+    # the recursion into its body — treating it as the call site here
+    # would flag later sibling reads of names the loop rebinds.
+    if not isinstance(
+        stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign, ast.Expr,
+               ast.Return)
+    ):
+        return None
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.Call):
+            name = func_name(node.func)
+            if "donated" in name or name in aliases:
+                return node
+    return None
+
+
+def _donated_aliases(fn: ast.AST) -> set:
+    """Local names bound to a ``*_donated`` program without calling
+    it — ``step = programs.learner_step_donated`` and the conditional
+    ``step = programs.x_donated if donate else programs.x`` shape.
+    Calls through these aliases are donated-call sites too."""
+    out = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not isinstance(tgt, ast.Name):
+            continue
+        value = node.value
+        cands = [value]
+        if isinstance(value, ast.IfExp):
+            cands = [value.body, value.orelse]
+        if any(
+            isinstance(c, (ast.Name, ast.Attribute))
+            and "donated" in func_name(c)
+            for c in cands
+        ):
+            out.add(tgt.id)
+    return out
+
+
+def _check_donated_reuse(path, tree, findings):
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        _scan_block(path, fn.body, findings, _donated_aliases(fn))
+
+
+def _scan_block(path, body, findings, aliases):
+    """Within one straight-line block: after a statement that feeds
+    Name args into a ``*_donated`` call, any later LOAD of those names
+    (before reassignment) reads a recycled buffer."""
+    for i, stmt in enumerate(body):
+        call = _donated_call(stmt, aliases)
+        if call is not None:
+            donated = {
+                a.id for a in call.args if isinstance(a, ast.Name)
+            }
+            # `state = step_donated(state, batch)` immediately
+            # rebinds some of them — those are safe by construction.
+            donated -= _assigned_names(stmt)
+            if donated:
+                for later in body[i + 1:]:
+                    for node in ast.walk(later):
+                        if (
+                            isinstance(node, ast.Name)
+                            and node.id in donated
+                            and isinstance(node.ctx, ast.Load)
+                        ):
+                            findings.append(Finding(
+                                "JIT002", path, node.lineno,
+                                f"'{node.id}' is read after being "
+                                f"donated to "
+                                f"{func_name(call.func)}() — its "
+                                f"buffer was recycled in place",
+                                hint="copy before donating, or stop "
+                                     "reusing the donated value "
+                                     "(donate-then-never-reuse)",
+                            ))
+                            donated.discard(node.id)
+                    donated -= _assigned_names(later)
+                    if not donated:
+                        break
+        # Recurse into nested blocks (bodies of if/for/while/with...)
+        # but NOT nested function defs — ast.walk in the caller visits
+        # those as functions of their own.
+        if isinstance(
+            stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue
+        for attr in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, attr, None)
+            if isinstance(sub, list) and sub and isinstance(
+                sub[0], ast.stmt
+            ):
+                _scan_block(path, sub, findings, aliases)
+        for handler in getattr(stmt, "handlers", ()):
+            _scan_block(path, handler.body, findings, aliases)
+
+
+def _check_jit_in_loop(path, tree, findings):
+    loops = [
+        n for n in ast.walk(tree) if isinstance(n, (ast.For, ast.While))
+    ]
+    for loop in loops:
+        for node in ast.walk(loop):
+            if node is loop:
+                continue
+            # Nested function defs inside the loop body are factories
+            # called per iteration only if the loop calls them — out
+            # of static reach; the direct wrap is the honest signal.
+            if isinstance(node, ast.Call) and func_name(node.func) in (
+                "jit", "pmap"
+            ):
+                findings.append(Finding(
+                    "JIT003", path, node.lineno,
+                    f"{func_name(node.func)}() constructed inside a "
+                    f"loop body — a fresh program (and a retrace on "
+                    f"any captured Python scalar) every iteration",
+                    hint="hoist the jit/pmap wrap out of the loop; "
+                         "pass per-iteration scalars as traced "
+                         "arguments",
+                ))
+
+
+@checker(
+    "jit",
+    rules=("JIT001", "JIT002", "JIT003"),
+    anchors=(
+        "actor_critic_algs_on_tensorflow_tpu/algos/*.py",
+        "actor_critic_algs_on_tensorflow_tpu/ops/*.py",
+        "actor_critic_algs_on_tensorflow_tpu/parallel/*.py",
+        "actor_critic_algs_on_tensorflow_tpu/data/*.py",
+    ),
+)
+def check(root: Path, files: Sequence[Path]) -> List[Finding]:
+    """Tracing-hazard lint: host nondeterminism in traced bodies,
+    donated-buffer reuse, jit-in-a-loop recompiles."""
+    findings: List[Finding] = []
+    for p in files:
+        if p.suffix != ".py" or not _in_scope(root, p):
+            continue
+        try:
+            tree = parse_file(p)
+        except SyntaxError:
+            continue
+        path = rel(root, p)
+        traced = _TracedScopes(tree).collect()
+        _check_host_nondeterminism(path, tree, traced, findings)
+        _check_donated_reuse(path, tree, findings)
+        _check_jit_in_loop(path, tree, findings)
+    return findings

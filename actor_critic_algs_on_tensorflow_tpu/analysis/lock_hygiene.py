@@ -1,0 +1,207 @@
+"""LOCK*: socket-timeout and lock-acquire hygiene on shared paths.
+
+Two shipped bugs define this checker. The PR-5 notify race: mutating
+``settimeout`` on a socket whose recv loop runs on ANOTHER thread
+flips the fd's blocking state under the reader and tears down healthy
+connections. The PR-10 deflake: an unbounded ``send_lock.acquire()``
+on a broadcast path lets one wedged peer stall a publish for every
+peer behind it. Rules (scope: ``distributed/``):
+
+  LOCK001  ``settimeout`` on a registry connection socket (an
+           attribute chain through ``.sock``) — those sockets are
+           served by a per-connection thread, so timeout mutation
+           from any other thread races the reader
+  LOCK002  ``send_lock.acquire()`` without a timeout (or a blocking
+           ``with send_lock:``) inside a broadcast/notify/handoff/
+           publish-path function — one wedged peer stalls the fleet
+  LOCK003  a recv loop with no deadline source in its function — no
+           ``settimeout``, no ``select.select`` gate, no deadline
+           variable — blocks its thread forever on a wedged peer
+
+Structural exceptions live in the module-level ``ALLOWLIST`` below,
+each with a justification string; tree-specific one-offs go in
+``analysis/baseline.toml``. The allowlist is keyed by
+``(path-suffix, function qualname)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import List, Sequence
+
+from actor_critic_algs_on_tensorflow_tpu.analysis.core import (
+    Finding,
+    checker,
+    dotted_name,
+    enclosing_functions,
+    parse_file,
+    rel,
+)
+
+# (file path suffix, function qualname) -> justification. Entries are
+# load-bearing documentation: each names WHY the pattern is safe where
+# the rule's failure mode does not apply.
+ALLOWLIST = {
+    ("distributed/transport.py", "_recv_exact_into"): (
+        "LOCK003: lowest-level fill helper; it never owns the socket "
+        "— every caller configures the deadline (idle settimeout or "
+        "a select gate) before handing the socket in"
+    ),
+    ("distributed/transport.py", "LearnerServer._broadcast_close"): (
+        "LOCK001: shutdown-only goodbye send; the serve thread "
+        "interprets a timeout during the _closing drain as the "
+        "close artifact (see _serve_conn) and the socket is "
+        "force-closed moments later anyway"
+    ),
+}
+
+_BROADCAST_PAT = ("broadcast", "notify", "handoff", "publish")
+_RECV_NAMES = {"recv", "recv_into", "recv_msg"}
+
+
+def _allowed(path: str, qual: str, rule: str) -> bool:
+    for (suffix, fn), reason in ALLOWLIST.items():
+        if path.endswith(suffix) and qual == fn and rule in reason:
+            return True
+    return False
+
+
+def _in_scope(path: Path) -> bool:
+    return "distributed" in path.parts
+
+
+def _fn_has_deadline_source(fn: ast.AST) -> bool:
+    """True when the function configures some deadline for its reads:
+    a settimeout call, a select gate, or a deadline variable that is
+    actually COMPARED against (a deadline nobody tests bounds
+    nothing — e.g. one kept only for logging)."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name.endswith("settimeout") or name.endswith("select"):
+                return True
+        if isinstance(node, ast.Compare) and any(
+            isinstance(sub, ast.Name) and "deadline" in sub.id
+            for sub in ast.walk(node)
+        ):
+            return True
+    return False
+
+
+@checker(
+    "lock",
+    rules=("LOCK001", "LOCK002", "LOCK003"),
+    anchors=("actor_critic_algs_on_tensorflow_tpu/distributed/*.py",),
+)
+def check(root: Path, files: Sequence[Path]) -> List[Finding]:
+    """Lock/timeout hygiene: shared-socket settimeout, unbounded
+    broadcast-path acquires, deadline-less recv loops."""
+    findings: List[Finding] = []
+    for p in files:
+        if p.suffix != ".py" or not _in_scope(p):
+            continue
+        try:
+            tree = parse_file(p)
+        except SyntaxError:
+            continue
+        path = rel(root, p)
+        for fn, qual in enclosing_functions(tree):
+            _check_function(path, fn, qual, findings)
+    return findings
+
+
+def _check_function(path, fn, qual, findings):
+    is_broadcast_path = any(
+        pat in fn.name.lower() for pat in _BROADCAST_PAT
+    )
+    # Nested defs are visited as their own qualnames; don't double-walk.
+    own_nodes = _own_nodes(fn)
+
+    for node in own_nodes:
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            # LOCK001: settimeout through a `.sock` attribute chain —
+            # a registry conn served by its own thread.
+            if name.endswith(".sock.settimeout") and not _allowed(
+                path, qual, "LOCK001"
+            ):
+                findings.append(Finding(
+                    "LOCK001", path, node.lineno,
+                    f"settimeout on a shared connection socket "
+                    f"({name.rsplit('.', 1)[0]}) from {qual}() — "
+                    f"races the serve thread's recv (the PR-5 "
+                    f"notify-race class)",
+                    hint="never mutate a served socket's timeout; "
+                         "use a select gate or bound the lock wait "
+                         "instead (see _broadcast_notify)",
+                ))
+            # LOCK002: unbounded send_lock.acquire() on a broadcast
+            # path. Bounded means an explicit timeout: the keyword, or
+            # the second positional of acquire(blocking, timeout) —
+            # acquire() and acquire(True) both block forever.
+            if (
+                is_broadcast_path
+                and name.endswith("send_lock.acquire")
+                and not any(kw.arg == "timeout" for kw in node.keywords)
+                and len(node.args) < 2
+                and not _allowed(path, qual, "LOCK002")
+            ):
+                findings.append(Finding(
+                    "LOCK002", path, node.lineno,
+                    f"unbounded send_lock.acquire() in broadcast-path "
+                    f"{qual}() — one wedged peer stalls every peer "
+                    f"behind it (the PR-10 deflake class)",
+                    hint="acquire(timeout=...) and skip the peer; a "
+                         "missed notify is recovered by its next "
+                         "ack/fetch",
+                ))
+        # LOCK002 (with-form): `with c.send_lock:` blocks unboundedly.
+        if is_broadcast_path and isinstance(node, ast.With):
+            for item in node.items:
+                expr = item.context_expr
+                if dotted_name(expr).endswith("send_lock") and not _allowed(
+                    path, qual, "LOCK002"
+                ):
+                    findings.append(Finding(
+                        "LOCK002", path, node.lineno,
+                        f"blocking 'with send_lock' in broadcast-path "
+                        f"{qual}() — one wedged peer stalls every "
+                        f"peer behind it",
+                        hint="acquire(timeout=...) and skip the peer",
+                    ))
+        # LOCK003: recv loop with no deadline source in the function.
+        if isinstance(node, ast.While):
+            has_recv = any(
+                isinstance(sub, ast.Call)
+                and dotted_name(sub.func).rsplit(".", 1)[-1] in _RECV_NAMES
+                for sub in ast.walk(node)
+            )
+            if (
+                has_recv
+                and not _fn_has_deadline_source(fn)
+                and not _allowed(path, qual, "LOCK003")
+            ):
+                findings.append(Finding(
+                    "LOCK003", path, node.lineno,
+                    f"recv loop in {qual}() has no deadline source "
+                    f"(no settimeout, no select gate, no deadline "
+                    f"variable) — a wedged peer pins this thread "
+                    f"forever",
+                    hint="configure an idle deadline on the socket "
+                         "or gate the read behind select with a "
+                         "timeout",
+                ))
+
+
+def _own_nodes(fn):
+    """Nodes of ``fn`` excluding nested function/lambda bodies."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))

@@ -1,0 +1,103 @@
+"""MARK*: pytest marker hygiene.
+
+Tier-1 deselects with ``-m 'not slow'``; a marker used in ``tests/``
+but never declared in ``pytest.ini`` is a typo pytest silently treats
+as an always-on test (or, with ``--strict-markers`` someday, a hard
+error). Rules:
+
+  MARK001  ``pytest.mark.<name>`` used in tests/ but not declared in
+           pytest.ini (builtin markers exempt)
+  MARK002  a marker declared in pytest.ini that no test uses (the
+           declaration list rotted)
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import List, Sequence
+
+from actor_critic_algs_on_tensorflow_tpu.analysis.core import (
+    Finding,
+    checker,
+    parse_file,
+    rel,
+)
+
+# Markers pytest ships; never require declaration.
+BUILTIN_MARKERS = {
+    "parametrize", "skip", "skipif", "xfail", "usefixtures",
+    "filterwarnings", "tryfirst", "trylast",
+}
+
+_DECL = re.compile(r"^\s+([A-Za-z_][A-Za-z0-9_]*)\s*(?::|$)")
+
+
+def declared_markers(ini: Path):
+    """Marker names (with lines) from pytest.ini's ``markers =``."""
+    out = {}
+    in_markers = False
+    for lineno, line in enumerate(
+        ini.read_text(encoding="utf-8").splitlines(), 1
+    ):
+        stripped = line.strip()
+        if stripped.startswith("markers"):
+            in_markers = True
+            continue
+        if in_markers:
+            if line[:1] not in (" ", "\t") and stripped:
+                in_markers = False
+                continue
+            m = _DECL.match(line)
+            if m:
+                out[m.group(1)] = lineno
+    return out
+
+
+@checker(
+    "markers",
+    rules=("MARK001", "MARK002"),
+    anchors=("pytest.ini", "tests/*.py"),
+)
+def check(root: Path, files: Sequence[Path]) -> List[Finding]:
+    """pytest markers used in tests/ must be declared in pytest.ini
+    (and declared markers must be used)."""
+    ini = next((p for p in files if p.name == "pytest.ini"), None)
+    if ini is None:
+        return []
+    findings: List[Finding] = []
+    declared = declared_markers(ini)
+    used = {}
+    for p in files:
+        if p.suffix != ".py" or "tests" not in p.parts:
+            continue
+        try:
+            tree = parse_file(p)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Attribute)
+                and node.value.attr == "mark"
+            ):
+                used.setdefault(node.attr, (rel(root, p), node.lineno))
+    for name, (path, line) in sorted(used.items()):
+        if name not in declared and name not in BUILTIN_MARKERS:
+            findings.append(Finding(
+                "MARK001", path, line,
+                f"pytest marker '{name}' is not declared in "
+                f"pytest.ini",
+                hint="add it under [pytest] markers (with a one-line "
+                     "description) or fix the typo",
+            ))
+    for name, line in sorted(declared.items()):
+        if name not in used:
+            findings.append(Finding(
+                "MARK002", rel(root, ini), line,
+                f"marker '{name}' is declared in pytest.ini but no "
+                f"test uses it",
+                hint="delete the stale declaration",
+            ))
+    return findings

@@ -1,0 +1,251 @@
+"""WIRE*: the actor/learner wire-protocol registry invariants.
+
+The transport's frame kinds (``KIND_*``), capability bits (``CAP_*``)
+and hello role values (``ROLE_*``) are hand-maintained integers in
+``distributed/transport.py``; IMPALA and SEED RL both note the wire
+contract is the part of these systems that silently rots. Rules:
+
+  WIRE001  duplicate ``KIND_*`` value — two frame kinds share a wire
+           byte, so one side's frames parse as the other's
+  WIRE002  a ``KIND_*``/``CAP_*``/``ROLE_*`` constant with no handler
+           or consumer anywhere in scope (dead protocol surface, or a
+           handler someone forgot to write)
+  WIRE003  ``CAP_*`` bits overlap / are not single bits, or ``ROLE_*``
+           values collide — capability masks and role fields stop
+           being disjoint
+  WIRE004  a hello identity literal longer than the server's parsed
+           arity — trailing fields are silently dropped on the wire
+
+The checker anchors on a file named ``transport.py`` in the analyzed
+set (the fixture trees mirror that layout) and resolves consumers
+across every OTHER analyzed python file, so a kind handled only by
+``serving.py`` or ``controlplane.py`` still counts as consumed.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import List, Sequence
+
+from actor_critic_algs_on_tensorflow_tpu.analysis.core import (
+    Finding,
+    checker,
+    const_int,
+    parse_file,
+    rel,
+)
+
+_PREFIXES = ("KIND_", "CAP_", "ROLE_")
+
+
+def _registry_consts(tree: ast.Module):
+    """Module-level ``KIND_*``/``CAP_*``/``ROLE_*`` integer assigns:
+    ``{name: (value, line)}``."""
+    out = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not isinstance(tgt, ast.Name):
+            continue
+        if not tgt.id.startswith(_PREFIXES):
+            continue
+        value = const_int(node.value)
+        if value is not None:
+            out[tgt.id] = (value, node.lineno)
+    return out
+
+
+def _name_refs(tree: ast.Module, names: set) -> set:
+    """Which of ``names`` are referenced (Name loads) in the module —
+    excluding their own defining assignment."""
+    refs = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and node.id in names:
+            if isinstance(node.ctx, ast.Load):
+                refs.add(node.id)
+    return refs
+
+
+def _hello_parse_arity(tree: ast.Module) -> int:
+    """Max N over ``ident.size >= N`` compares — the number of hello
+    fields the server-side parse actually reads. Anchored on the
+    ``ident`` name (the KIND_HELLO handler's binding for the identity
+    array, a protocol-level convention) so unrelated ``.size``
+    guards elsewhere in transport.py cannot inflate the arity and
+    silence the rule."""
+    arity = 0
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Compare) or len(node.ops) != 1:
+            continue
+        if not isinstance(node.ops[0], ast.GtE):
+            continue
+        left = node.left
+        if (
+            isinstance(left, ast.Attribute)
+            and left.attr == "size"
+            and isinstance(left.value, ast.Name)
+            and left.value.id == "ident"
+            and isinstance(node.comparators[0], ast.Constant)
+            and isinstance(node.comparators[0].value, int)
+        ):
+            arity = max(arity, node.comparators[0].value)
+    return arity
+
+
+def _hello_literals(tree: ast.Module):
+    """``hello=(...)`` / ``hello=[...]`` keyword literals: (len, line).
+    Non-literal hello values (a forwarded variable) are not arity
+    sites — the literal that built them is."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        for kw in node.keywords:
+            if kw.arg == "hello" and isinstance(
+                kw.value, (ast.Tuple, ast.List)
+            ):
+                yield len(kw.value.elts), kw.value.lineno
+
+
+@checker(
+    "wire",
+    rules=("WIRE001", "WIRE002", "WIRE003", "WIRE004"),
+    anchors=(
+        "actor_critic_algs_on_tensorflow_tpu/distributed/*.py",
+        "actor_critic_algs_on_tensorflow_tpu/algos/impala.py",
+        "scripts/*.py",
+    ),
+)
+def check(root: Path, files: Sequence[Path]) -> List[Finding]:
+    """Wire-protocol registry: unique kinds, disjoint caps, consumed
+    constants, hello arity agreement."""
+    transport = next(
+        (p for p in files if p.name == "transport.py"), None
+    )
+    if transport is None:
+        return []
+    findings: List[Finding] = []
+    tpath = rel(root, transport)
+    ttree = parse_file(transport)
+    consts = _registry_consts(ttree)
+    names = set(consts)
+
+    # WIRE001: duplicate KIND values.
+    by_value = {}
+    for name, (value, line) in sorted(
+        consts.items(), key=lambda kv: kv[1][1]
+    ):
+        if not name.startswith("KIND_"):
+            continue
+        if value in by_value:
+            findings.append(Finding(
+                "WIRE001", tpath, line,
+                f"{name} = {value} collides with {by_value[value]} "
+                f"(frame kinds must be unique on the wire)",
+                hint="pick the next unused kind value and document it",
+            ))
+        else:
+            by_value[value] = name
+
+    # WIRE003: CAP bits must be single, disjoint bits; ROLE values
+    # must be unique.
+    cap_mask = 0
+    for name, (value, line) in sorted(
+        consts.items(), key=lambda kv: kv[1][1]
+    ):
+        if name.startswith("CAP_"):
+            if value <= 0 or value & (value - 1):
+                findings.append(Finding(
+                    "WIRE003", tpath, line,
+                    f"{name} = {value} is not a single capability bit",
+                    hint="capabilities are a bitmask; use the next "
+                         "unused power of two",
+                ))
+            elif value & cap_mask:
+                findings.append(Finding(
+                    "WIRE003", tpath, line,
+                    f"{name} = {value} overlaps an earlier CAP_ bit",
+                    hint="use the next unused power of two",
+                ))
+            cap_mask |= value
+    role_values = {}
+    for name, (value, line) in sorted(
+        consts.items(), key=lambda kv: kv[1][1]
+    ):
+        if name.startswith("ROLE_"):
+            if value in role_values:
+                findings.append(Finding(
+                    "WIRE003", tpath, line,
+                    f"{name} = {value} collides with "
+                    f"{role_values[value]} (hello role values must be "
+                    f"distinct)",
+                    hint="pick the next unused role value",
+                ))
+            else:
+                role_values[value] = name
+
+    # WIRE002: every constant must be referenced somewhere beyond its
+    # definition — in transport.py itself or any other analyzed file.
+    # A doc-only consumer (the name in a comment/docstring) counts:
+    # several kinds are parsed generically and only routed by value.
+    py_files = [p for p in files if p.suffix == ".py"]
+    referenced = _name_refs(ttree, names)
+
+    def whole_word(name: str, text: str) -> int:
+        # Word-boundary matches only: KIND_BARRIER must not count as
+        # consumed because KIND_BARRIER_OK appears.
+        return len(re.findall(rf"\b{re.escape(name)}\b", text))
+
+    for p in py_files:
+        if p == transport:
+            continue
+        missing = names - referenced
+        if not missing:
+            break
+        try:
+            text = p.read_text(encoding="utf-8")
+        except (OSError, UnicodeDecodeError):
+            continue
+        for name in list(missing):
+            if whole_word(name, text):
+                referenced.add(name)
+    # Doc mentions inside transport.py itself (comments narrating a
+    # kind's consumer) also count — re-scan the raw text.
+    ttext = transport.read_text(encoding="utf-8")
+    for name in names - referenced:
+        # The defining line mentions the name once; any OTHER mention
+        # (comment table, docstring) is a documented consumer.
+        if whole_word(name, ttext) > 1:
+            referenced.add(name)
+    for name in sorted(names - referenced):
+        value, line = consts[name]
+        findings.append(Finding(
+            "WIRE002", tpath, line,
+            f"{name} = {value} has no handler or documented consumer "
+            f"in the analyzed tree",
+            hint="wire a handler (server dispatch or client "
+                 "_await_reply) or delete the dead kind",
+        ))
+
+    # WIRE004: hello literals across the tree vs the parsed arity.
+    arity = _hello_parse_arity(ttree)
+    if arity:
+        for p in py_files:
+            try:
+                tree = ttree if p == transport else parse_file(p)
+            except SyntaxError:
+                continue
+            for length, line in _hello_literals(tree):
+                if length > arity or length < 1:
+                    findings.append(Finding(
+                        "WIRE004", rel(root, p), line,
+                        f"hello literal has {length} fields but the "
+                        f"server parses at most {arity} "
+                        f"([actor_id, generation, role, caps, epoch])",
+                        hint="extend the KIND_HELLO parse in "
+                             "transport.py before shipping new hello "
+                             "fields — trailing fields are dropped",
+                    ))
+    return findings

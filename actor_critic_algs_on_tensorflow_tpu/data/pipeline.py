@@ -58,6 +58,7 @@ from actor_critic_algs_on_tensorflow_tpu.distributed.codec import (
     CodecError,
     CodedTrajectory,
 )
+from actor_critic_algs_on_tensorflow_tpu.utils import metric_names
 from actor_critic_algs_on_tensorflow_tpu.utils.metrics import TimeSplit
 
 __all__ = [
@@ -675,7 +676,7 @@ class DeviceRolloutSource:
         self._key = jax.random.PRNGKey(seed)
         self._exec_lock = exec_lock
         self._env: Optional[Tuple[Any, Any]] = None
-        self.split = TimeSplit(prefix="device_")
+        self.split = TimeSplit(prefix=metric_names.DEVICE)
         self.batches = 0
 
     def set_params(self, params: Any) -> None:

@@ -872,6 +872,14 @@ class PreemptionLeader:
     def _read_loop(self, f: _Follower) -> None:
         try:
             while not self._halt.is_set():
+                # Gate the blocking read so the halt flag is honored
+                # and a silent follower never pins this thread beyond
+                # the poll interval; a wedged-MID-frame follower is
+                # detected by the barrier waiter's own deadline
+                # (shard_barrier_timeout_s -> ShardDesync).
+                readable, _, _ = select.select([f.sock], [], [], 0.5)
+                if not readable:
+                    continue
                 kind, tag, arrays = recv_msg(f.sock)
                 with self._cond:
                     if kind == KIND_STEP_REPORT and arrays:
@@ -891,7 +899,9 @@ class PreemptionLeader:
                         f.barrier_arrived = True
                         self._cond.notify_all()
                     # Anything else: ignore (liveness is implicit).
-        except (ConnectionError, OSError) as e:
+        except (ConnectionError, OSError, ValueError) as e:
+            # ValueError: close() closed f.sock between the halt check
+            # and the select (a closed socket's fileno is -1).
             with self._cond:
                 if not f.dead:
                     f.dead = True

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import random
+import select
 import socket
 import struct as struct_lib
 import threading
@@ -644,6 +645,12 @@ class ChaosProxy:
               upstream: bool) -> None:
         try:
             while not link.closed:
+                # Gate the read so ``link.closed`` is honored within
+                # the poll interval instead of only when bytes arrive
+                # — a silent peer no longer pins the pump thread.
+                readable, _, _ = select.select([src], [], [], 0.5)
+                if not readable:
+                    continue
                 data = src.recv(65536)
                 if not data:
                     break
@@ -674,7 +681,9 @@ class ChaosProxy:
                         return
                     link.truncate_after -= len(data)
                 dst.sendall(data)
-        except OSError:
+        except (OSError, ValueError):
+            # ValueError: a link.close() between the loop's closed
+            # check and the select handed a -1 fd to select().
             pass
         finally:
             # Crude full-close on either side ending: fine for a fault

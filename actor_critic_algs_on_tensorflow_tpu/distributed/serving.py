@@ -53,6 +53,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from actor_critic_algs_on_tensorflow_tpu.distributed import codec
+from actor_critic_algs_on_tensorflow_tpu.utils import metric_names
 
 # Request leaf layout (after the obs leaves): reward, done,
 # episode_return, done_episode — all [B_env] float32, produced by the
@@ -527,7 +528,7 @@ class InferenceServer:
                 "serve_param_swaps": self._param_swaps,
                 "serve_lanes": len(self._lanes),
             }
-        m.update(self._act_lat.summary("serve_act_"))
+        m.update(self._act_lat.summary(metric_names.SERVE_ACT))
         return m
 
     def close(self) -> None:

@@ -1,0 +1,129 @@
+"""Single source of truth for metric-key prefixes and names.
+
+Every subsystem that emits into the trainer's log stream owns a key
+family — ``transport_*`` (LearnerServer counters), ``pipeline_*``
+(ingest TimeSplit + pipeline counters), ``serve_*`` (central
+inference), ``device_*`` (the fused Anakin path), ``shard*`` (sharded
+learner) — and the families grew by hand across PRs 5-11. This module
+declares the prefixes (imported by the emitters, so a typo'd prefix
+is an ImportError, not a silent new family) and the registry of every
+statically-reachable key in each family. ``analysis/drift.py``
+cross-checks the registry against the tree: a key emitted but not
+declared, declared but never emitted, or colliding with a config-knob
+name is a finding.
+
+Dynamic key segments (runtime-formatted shard indices) use ``*``:
+``shard*_conns`` covers ``shard0_conns``..``shardN_conns``. The
+registry is the union of statically-reachable keys — where one
+module binds several TimeSplit prefixes to one attribute name, the
+checker (and therefore this registry) takes the cartesian closure.
+
+Pure stdlib, no imports: safe to import from scripts/check.py and
+bench subprocesses without dragging in jax.
+"""
+
+from __future__ import annotations
+
+# --- family prefixes (import these; never inline the strings) --------
+TRANSPORT = "transport_"
+PIPELINE = "pipeline_"
+SERVE = "serve_"
+DEVICE = "device_"
+SHARD = "shard"          # shard{N}_* dynamic keys + shard_* statics
+SERVE_ACT = SERVE + "act_"   # LatencyStats.summary prefix (serving tier)
+
+FAMILY_PREFIXES = (TRANSPORT, PIPELINE, SERVE, DEVICE, SHARD)
+
+# --- registry: family key -> one-line provenance ---------------------
+# ``*`` covers runtime-formatted segments (shard indices). Keep keys
+# grouped by emitter; analysis/drift.py fails the gate on any key
+# used-but-undeclared (DRIFT002) or declared-but-unused (DRIFT003).
+METRIC_NAMES: dict = {
+    # -- transport_*: LearnerServer.metrics() (distributed/transport.py)
+    TRANSPORT + "actors_connected": "live registry connections",
+    TRANSPORT + "accepts": "lifetime accepted connections",
+    TRANSPORT + "disconnects": "lost peers (incl. idle recycles)",
+    TRANSPORT + "graceful_closes": "KIND_CLOSE goodbyes received",
+    TRANSPORT + "idle_recycled": "connections recycled for silence",
+    TRANSPORT + "frames_in": "frames ingested (all kinds)",
+    TRANSPORT + "mb_in": "payload megabytes ingested",
+    TRANSPORT + "trajectories": "trajectory frames ingested",
+    TRANSPORT + "rejected": "trajectories rejected by the validator",
+    TRANSPORT + "traj_frames": "plain trajectory frames",
+    TRANSPORT + "traj_coded_frames": "coded trajectory frames",
+    TRANSPORT + "traj_mb_in": "trajectory payload MB (all frames)",
+    TRANSPORT + "traj_coded_mb_in": "coded trajectory payload MB",
+    TRANSPORT + "obs_reqs": "serving-tier observation requests in",
+    TRANSPORT + "obs_mb_in": "observation request payload MB",
+    TRANSPORT + "act_resps": "serving-tier action replies out",
+    TRANSPORT + "param_staleness_mean": "mean publishes-behind at fetch",
+    TRANSPORT + "pings": "heartbeat probes received",
+    TRANSPORT + "hellos": "identity announcements received",
+    TRANSPORT + "checksum_failures": "payload CRC mismatches",
+    TRANSPORT + "handoffs_sent": "KIND_HANDOFF frames to standbys",
+    TRANSPORT + "mb_out": "megabytes sent (all frames)",
+    TRANSPORT + "param_sends": "param fetches served",
+    TRANSPORT + "param_delta_sends": "param fetches served as deltas",
+    TRANSPORT + "param_mb_out": "param payload megabytes out",
+    TRANSPORT + "notifies_sent": "publish notifies delivered",
+    # -- pipeline_*: ingest TimeSplit + LearnerPipeline counters
+    # (data/pipeline.py, algos/impala.py, distributed/sharding.py)
+    PIPELINE + "queue_wait_s": "waiting on the trajectory queue",
+    PIPELINE + "assemble_s": "batch assembly into arena slots",
+    PIPELINE + "transfer_s": "host->device transfer",
+    PIPELINE + "compute_s": "learner-step compute (serial loop)",
+    PIPELINE + "stall_s": "learner blocked on an empty pipeline",
+    PIPELINE + "slot_wait_s": "waiting on a free arena slot",
+    PIPELINE + "decode_s": "coded-frame decode into slots",
+    PIPELINE + "collect_s": "device self-play batch collection",
+    PIPELINE + "barrier_wait_s": "sharded stitch/barrier wait",
+    PIPELINE + "overlap_frac": "ingest hidden behind compute (0-1)",
+    PIPELINE + "batches": "batches staged",
+    PIPELINE + "depth": "ready-queue depth",
+    PIPELINE + "coded_parts": "coded trajectory parts decoded",
+    PIPELINE + "decode_errors": "undecodable coded trajectories",
+    PIPELINE + "decode_rejects": "post-decode validator rejects",
+    PIPELINE + "shard_batches_min": "min per-shard staged batches",
+    # -- serve_*: InferenceServer.metrics() (distributed/serving.py)
+    # + the serving bench ledger columns (scripts/serve_bench.py)
+    SERVE + "requests": "observation requests submitted",
+    SERVE + "dup_replays": "idempotent replays of cached replies",
+    SERVE + "seq_resets": "per-actor sequence-lane resets",
+    SERVE + "rejected": "malformed/out-of-window requests",
+    SERVE + "batches": "act() dispatches",
+    SERVE + "batch_mean": "mean requests per act() dispatch",
+    SERVE + "segments": "server-side rollout segments completed",
+    SERVE + "reply_failures": "replies to already-gone connections",
+    SERVE + "param_swaps": "in-process serving weight swaps",
+    SERVE + "lanes": "live per-actor lanes",
+    SERVE_ACT + "count": "act latency samples",
+    SERVE_ACT + "mean_ms": "act latency mean",
+    SERVE_ACT + "p50_ms": "act latency p50",
+    SERVE_ACT + "p99_ms": "act latency p99",
+    SERVE_ACT + "max_ms": "act latency max",
+    SERVE + "p50_ms": "serve bench ledger: per-fleet p50 column",
+    SERVE + "p99_ms": "serve bench ledger: per-fleet p99 column",
+    # -- device_*: fused Anakin path TimeSplit (algos/impala.py,
+    # data/pipeline.py DeviceBatchSource) + bench.py device leg
+    DEVICE + "step_s": "fused-iteration dispatch wall time",
+    DEVICE + "collect_s": "device self-play collection",
+    DEVICE + "batches": "device-collected batches",
+    DEVICE + "queue_wait_s": "device source: staging wait",
+    DEVICE + "assemble_s": "device source: assembly",
+    DEVICE + "transfer_s": "device source: transfer",
+    DEVICE + "stall_s": "device source: learner stall",
+    DEVICE + "slot_wait_s": "device source: slot wait",
+    DEVICE + "decode_s": "device source: decode",
+    DEVICE + "steps_per_sec": "bench device leg: env-steps/sec",
+    DEVICE + "step_share": "bench device leg: step_s share of wall",
+    DEVICE + "vs_pipelined": "bench device leg: speedup vs pipelined",
+    DEVICE + "vs_serial": "bench device leg: speedup vs serial",
+    # -- shard*: sharded-learner log attribution (algos/impala.py)
+    # + the shard bench ledger (scripts/shard_bench.py)
+    SHARD + "_count": "topology echo: shard count (log attribution)",
+    SHARD + "_id": "topology echo: this host's shard id",
+    SHARD + "*_conns": "per-shard live actor connections",
+    SHARD + "*_foreign_peers": "per-shard peers outside the slice",
+    SHARD + "*_trajectories": "per-shard trajectories ingested",
+    SHARD + "s": "shard bench ledger: shard counts column",
+}

@@ -15,6 +15,8 @@ from typing import Dict, Mapping
 import jax
 import numpy as np
 
+from actor_critic_algs_on_tensorflow_tpu.utils import metric_names
+
 Metrics = Dict[str, jax.Array]
 
 
@@ -30,7 +32,7 @@ class TimeSplit:
     log stream and TensorBoard.
     """
 
-    def __init__(self, prefix: str = "pipeline_"):
+    def __init__(self, prefix: str = metric_names.PIPELINE):
         self._prefix = prefix
         self._lock = threading.Lock()
         self._acc: Dict[str, float] = {}

@@ -169,6 +169,7 @@ def measure_impala() -> dict:
         ImpalaConfig,
         run_impala,
     )
+    from actor_critic_algs_on_tensorflow_tpu.utils import metric_names
 
     iters = int(os.environ.get("BENCH_IMPALA_ITERS", 60))
     base = dict(
@@ -201,10 +202,12 @@ def measure_impala() -> dict:
         windows = history[1:] if len(history) > 1 else history
         for _, m in windows:
             hist_rates.append(m["steps_per_sec"])
-            ingest_s += m.get("pipeline_assemble_s", 0.0) + m.get(
-                "pipeline_transfer_s", 0.0
-            ) + m.get("pipeline_queue_wait_s", 0.0)
-            stall_s += m.get("pipeline_stall_s", 0.0)
+            ingest_s += (
+                m.get(metric_names.PIPELINE + "assemble_s", 0.0)
+                + m.get(metric_names.PIPELINE + "transfer_s", 0.0)
+                + m.get(metric_names.PIPELINE + "queue_wait_s", 0.0)
+            )
+            stall_s += m.get(metric_names.PIPELINE + "stall_s", 0.0)
         out[mode] = {
             "steps_per_sec": round(statistics.median(hist_rates), 1),
             # Share of wall time spent assembling/transferring/waiting
@@ -233,6 +236,7 @@ def measure_impala_device() -> dict:
         ImpalaConfig,
         run_impala,
     )
+    from actor_critic_algs_on_tensorflow_tpu.utils import metric_names
 
     sys.path.insert(
         0,
@@ -295,8 +299,8 @@ def measure_impala_device() -> dict:
             rates, stall_s, device_s = [], 0.0, 0.0
             for _, m in windows:
                 rates.append(m["steps_per_sec"])
-                stall_s += m.get("pipeline_stall_s", 0.0)
-                device_s += m.get("device_step_s", 0.0)
+                stall_s += m.get(metric_names.PIPELINE + "stall_s", 0.0)
+                device_s += m.get(metric_names.DEVICE + "step_s", 0.0)
             leg[f"{mode}_steps_per_sec"] = round(
                 statistics.median(rates), 1
             )

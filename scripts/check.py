@@ -1,0 +1,159 @@
+#!/usr/bin/env python3
+"""Repo-native static analysis runner.
+
+    python scripts/check.py                 # full tree, all checkers
+    python scripts/check.py --changed       # git-diff-scoped (<5 s) —
+                                            # the pre-commit path
+    python scripts/check.py --checker wire --checker lock
+    python scripts/check.py --list          # rule catalogue
+    python scripts/check.py --no-baseline   # ignore suppressions
+
+Exit status: 0 when every finding is baseline-suppressed (each with a
+reason) and no suppression is stale; 1 otherwise. Findings print as
+``file:line [RULE] message`` plus a one-line fix hint.
+
+``--changed`` selects checkers whose anchor files intersect the
+working-tree diff (vs HEAD, plus untracked files); a selected checker
+still analyzes its FULL input set — cross-file invariants (kind
+consumers, metric registries) need the whole picture, and the full
+pass is sub-second anyway. See ARCHITECTURE.md "Static analysis".
+
+Stdlib-only: runs without jax/numpy installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+import types
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT))
+
+# The top-level package __init__ imports the whole framework (jax and
+# all); the analysis subpackage is deliberately stdlib-only. Register
+# a synthetic parent so `actor_critic_algs_on_tensorflow_tpu.analysis`
+# imports through the parent's __path__ without executing the heavy
+# __init__ — the checker pass must run in <1 s on accelerator-less
+# hosts.
+_PKG = "actor_critic_algs_on_tensorflow_tpu"
+if _PKG not in sys.modules:
+    _pkg = types.ModuleType(_PKG)
+    _pkg.__path__ = [str(ROOT / _PKG)]
+    sys.modules[_PKG] = _pkg
+
+from actor_critic_algs_on_tensorflow_tpu import analysis  # noqa: E402
+
+
+def changed_paths(root: Path) -> list[str]:
+    """Repo-relative changed (vs HEAD) + untracked paths."""
+    out: list[str] = []
+    for cmd in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            res = subprocess.run(
+                cmd, cwd=root, capture_output=True, text=True,
+                timeout=30, check=True,
+            )
+        except (OSError, subprocess.SubprocessError) as e:
+            print(f"[check] --changed: {' '.join(cmd)} failed ({e}); "
+                  f"falling back to the full run", file=sys.stderr)
+            return []
+        out.extend(line for line in res.stdout.splitlines() if line)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="check.py", description="repo static-analysis gate"
+    )
+    ap.add_argument("--changed", action="store_true",
+                    help="run only checkers whose anchor files appear "
+                         "in the git diff vs HEAD (pre-commit mode)")
+    ap.add_argument("--checker", action="append", default=None,
+                    metavar="NAME", help="run only this checker "
+                    "(repeatable; see --list)")
+    ap.add_argument("--list", action="store_true",
+                    help="print the checker/rule catalogue and exit")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report suppressed findings too")
+    ap.add_argument("--quiet", action="store_true",
+                    help="summary line only")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name, chk in analysis.CHECKERS.items():
+            print(f"{name:13s} {', '.join(chk.rules)}")
+            print(f"{'':13s} {chk.doc}")
+            sups = [
+                s for s in analysis.load_baseline(
+                    analysis.default_baseline_path(ROOT)
+                )
+                if s.rule in chk.rules
+            ]
+            for s in sups:
+                print(f"{'':13s} suppressed: {s.rule} in {s.file} "
+                      f"— {s.reason}")
+        return 0
+
+    names = args.checker
+    if names is not None:
+        unknown = [n for n in names if n not in analysis.CHECKERS]
+        if unknown:
+            ap.error(
+                f"unknown checker(s) {unknown}; available: "
+                f"{sorted(analysis.CHECKERS)}"
+            )
+    if args.changed:
+        changed = changed_paths(ROOT)
+        if changed:
+            relevant = [
+                n for n, c in analysis.CHECKERS.items()
+                if c.relevant_to(changed)
+            ]
+            if names is not None:
+                relevant = [n for n in relevant if n in names]
+            if not relevant:
+                if not args.quiet:
+                    print("[check] no checker anchors in the diff; "
+                          "nothing to do")
+                return 0
+            names = relevant
+        # An empty diff (or git failure) falls through to a full run:
+        # cheap, and never silently skips the gate.
+
+    findings = analysis.run_checkers(ROOT, names=names)
+    if args.no_baseline:
+        kept, quiet, stale = findings, [], []
+    else:
+        sups = analysis.load_baseline(
+            analysis.default_baseline_path(ROOT)
+        )
+        if names is not None:
+            active_rules = {
+                r for n in names for r in analysis.CHECKERS[n].rules
+            }
+            sups = [s for s in sups if s.rule in active_rules]
+        kept, quiet, stale = analysis.apply_baseline(findings, sups)
+
+    if not args.quiet:
+        for f in kept:
+            print(f.format())
+        for s in stale:
+            print(f"[stale suppression] {s.rule} in {s.file} matched "
+                  f"nothing — delete it from analysis/baseline.toml "
+                  f"(reason was: {s.reason})")
+    ran = names if names is not None else list(analysis.CHECKERS)
+    print(
+        f"[check] {len(ran)} checker(s), {len(kept)} finding(s), "
+        f"{len(quiet)} suppressed, {len(stale)} stale suppression(s)"
+    )
+    return 1 if kept or stale else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

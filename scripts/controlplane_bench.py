@@ -53,6 +53,7 @@ from actor_critic_algs_on_tensorflow_tpu.distributed.controlplane import (
     Redirector,
 )
 from actor_critic_algs_on_tensorflow_tpu.utils import health
+from actor_critic_algs_on_tensorflow_tpu.utils import metric_names
 from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import Checkpointer
 
 
@@ -512,11 +513,15 @@ def _wire_fetch_bytes(versions, *, param_delta, param_bf16=False):
         last = None
         for leaves in versions:
             server.publish(leaves, notify=False)
-            before = server.metrics()["transport_param_mb_out"]
+            before = server.metrics()[
+                metric_names.TRANSPORT + "param_mb_out"
+            ]
             t0 = time.perf_counter()
             _, last = client.fetch_params()
             times.append(time.perf_counter() - t0)
-            after = server.metrics()["transport_param_mb_out"]
+            after = server.metrics()[
+                metric_names.TRANSPORT + "param_mb_out"
+            ]
             per_fetch.append((after - before) * 1e6)
         client.close()
         return per_fetch, times, last

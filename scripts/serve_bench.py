@@ -196,6 +196,7 @@ def serve_leg(
     from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
         LearnerServer,
     )
+    from actor_critic_algs_on_tensorflow_tpu.utils import metric_names
     from actor_critic_algs_on_tensorflow_tpu.utils.metrics import (
         LatencyStats,
     )
@@ -219,8 +220,9 @@ def serve_leg(
         "actions_per_sec": [],
         "act_p50_ms": [],
         "act_p99_ms": [],
-        "serve_p50_ms": [],   # server-side submit->reply (GIL-immune)
-        "serve_p99_ms": [],
+        # Server-side submit->reply percentiles (GIL-immune).
+        metric_names.SERVE + "p50_ms": [],
+        metric_names.SERVE + "p99_ms": [],
         "segments": [],
         "batch_mean": [],
     }
@@ -293,10 +295,14 @@ def serve_leg(
         out["actions_per_sec"].append(round(aps, 1))
         out["act_p50_ms"].append(summary["p50_ms"])
         out["act_p99_ms"].append(summary["p99_ms"])
-        out["serve_p50_ms"].append(sm["serve_act_p50_ms"])
-        out["serve_p99_ms"].append(sm["serve_act_p99_ms"])
+        out[metric_names.SERVE + "p50_ms"].append(
+            sm[metric_names.SERVE_ACT + "p50_ms"]
+        )
+        out[metric_names.SERVE + "p99_ms"].append(
+            sm[metric_names.SERVE_ACT + "p99_ms"]
+        )
         out["segments"].append(segments[0])
-        out["batch_mean"].append(sm["serve_batch_mean"])
+        out["batch_mean"].append(sm[metric_names.SERVE + "batch_mean"])
         print(
             f"SERVE fleet={n} actions/sec={aps:.0f} "
             f"act p50={summary['p50_ms']:.2f}ms "

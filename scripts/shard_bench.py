@@ -72,6 +72,7 @@ def shard_leg(
     aggregate env-steps/sec (median over post-compile log windows),
     the learner step rate, and the barrier/join-wait share of wall
     time."""
+    from actor_critic_algs_on_tensorflow_tpu.utils import metric_names
     from actor_critic_algs_on_tensorflow_tpu.algos.impala import (
         ImpalaConfig,
         run_impala_distributed,
@@ -103,9 +104,13 @@ def shard_leg(
     windows = history[1:] if len(history) > 1 else history
     rates = [m["steps_per_sec"] for _, m in windows]
     barrier_s = sum(
-        m.get("pipeline_barrier_wait_s", 0.0) for _, m in history
+        m.get(metric_names.PIPELINE + "barrier_wait_s", 0.0)
+        for _, m in history
     )
-    stall_s = sum(m.get("pipeline_stall_s", 0.0) for _, m in history)
+    stall_s = sum(
+        m.get(metric_names.PIPELINE + "stall_s", 0.0)
+        for _, m in history
+    )
     agg = statistics.median(rates)
     return {
         "shards": shards,

@@ -30,6 +30,7 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from actor_critic_algs_on_tensorflow_tpu.algos import impala
+from actor_critic_algs_on_tensorflow_tpu.utils import metric_names
 from actor_critic_algs_on_tensorflow_tpu.distributed import codec
 from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
     ActorClient,
@@ -177,9 +178,11 @@ def wire_leg(
             raise errors[0]
         frames = n_actors * pushes_per_actor
         out[label] = {
-            "wire_mb_in": round(m["transport_traj_mb_in"], 3),
+            "wire_mb_in": round(
+                m[metric_names.TRANSPORT + "traj_mb_in"], 3
+            ),
             "wire_mb_per_sec": round(
-                m["transport_traj_mb_in"] / wall, 2
+                m[metric_names.TRANSPORT + "traj_mb_in"] / wall, 2
             ),
             "goodput_mb_per_sec": round(raw_frame_mb * frames / wall, 2),
             "frames_per_sec": round(frames / wall, 1),
@@ -219,13 +222,16 @@ def e2e_leg(
         )
         wall = time.perf_counter() - t0
         stall = sum(
-            m.get("pipeline_stall_s", 0.0) for _, m in history
+            m.get(metric_names.PIPELINE + "stall_s", 0.0)
+            for _, m in history
         )
         last = history[-1][1]
         out[label] = {
             "steps_per_sec": round(last["steps_per_sec"], 1),
             "stall_share": round(stall / max(wall, 1e-9), 4),
-            "wire_mb_in": round(last["transport_traj_mb_in"], 3),
+            "wire_mb_in": round(
+                last[metric_names.TRANSPORT + "traj_mb_in"], 3
+            ),
             "codec_ratio": last.get("traj_codec_ratio", 1.0),
         }
     return out

@@ -1,0 +1,10 @@
+# Drift checker fixture: a miniature ImpalaConfig. ``lr`` is
+# coercible + documented (quiet); the other two each violate one rule.
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class ImpalaConfig:
+    lr: float = 6e-4
+    sched: dict = dataclasses.field(default_factory=dict)  # EXPECT: DRIFT001,DRIFT005
+    undocumented_knob: int = 3  # EXPECT: DRIFT005

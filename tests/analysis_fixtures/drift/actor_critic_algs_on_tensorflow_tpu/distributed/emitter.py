@@ -1,0 +1,7 @@
+# Drift checker fixture emitter: one declared key (quiet), one typo'd
+# undeclared key.
+def metrics(self):
+    return {
+        "transport_frames_in": self._frames_in,
+        "transport_frames_ni": self._typo,  # EXPECT: DRIFT002
+    }

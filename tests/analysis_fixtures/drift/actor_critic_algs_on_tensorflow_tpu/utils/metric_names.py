@@ -1,0 +1,9 @@
+# Drift checker fixture registry.
+TRANSPORT = "transport_"
+
+METRIC_NAMES: dict = {
+    TRANSPORT + "frames_in": "emitted by emitter.py (quiet)",
+    "pipeline_ghost_s": "never emitted anywhere",  # EXPECT: DRIFT003
+    TRANSPORT + "frames_in": "duplicate declaration",  # EXPECT: DRIFT004
+    "lr": "collides with the ImpalaConfig knob",  # EXPECT: DRIFT003,DRIFT004
+}

@@ -1,0 +1,66 @@
+# Jit/tracing-hazard checker fixture: one violation per JIT rule next
+# to known-good counterparts. Never imported — AST-only analysis.
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def traced_clock(x):
+    t = time.time()  # EXPECT: JIT001
+    return x + t
+
+
+@jax.jit
+def traced_rng(x):
+    noise = np.random.normal()  # EXPECT: JIT001
+    return x + noise
+
+
+def scanned_body(carry, x):
+    bad = carry.item()  # EXPECT: JIT001
+    return carry + x, bad
+
+
+def build(xs):
+    return jax.lax.scan(scanned_body, 0.0, xs)
+
+
+def host_loop(step, state, batches):
+    # Host-side bookkeeping: clocks/RNG OUTSIDE traced bodies are
+    # fine, as is .item() on a host value.
+    t0 = time.time()
+    rng = np.random.normal()
+    for batch in batches:
+        state, metrics = step(state, batch)
+    return state, time.time() - t0, rng
+
+
+def donated_loop(step_donated, state, batches):
+    for batch in batches:
+        state, metrics = step_donated(state, batch)
+        stale = batch.mean()  # EXPECT: JIT002
+    return state, metrics
+
+
+def donated_ok(step_donated, state, batches):
+    for batch in batches:
+        # Reassigning the donated name before any read is the
+        # documented discipline — no finding.
+        state, metrics = step_donated(state, batch)
+        batch = None
+    return state, metrics
+
+
+def rejit_per_iteration(fn, items):
+    out = []
+    for scale in items:
+        prog = jax.jit(lambda x: x * scale)  # EXPECT: JIT003
+        out.append(prog(scale))
+    return out
+
+
+def jit_once(fn, items):
+    prog = jax.jit(fn)
+    return [prog(x) for x in items]

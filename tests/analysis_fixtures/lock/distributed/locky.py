@@ -1,0 +1,81 @@
+# Lock/timeout-hygiene checker fixture: one violation per LOCK rule
+# next to known-good counterparts. Never imported — AST-only.
+import select
+
+
+class Server:
+    def broadcast_notify(self, frame):
+        for c in self.conns:
+            c.sock.settimeout(0.1)  # EXPECT: LOCK001
+            if not c.send_lock.acquire():  # EXPECT: LOCK002
+                continue
+            try:
+                c.sock.send(frame)
+            finally:
+                c.send_lock.release()
+
+    def broadcast_blocking(self, frame):
+        for c in self.conns:
+            with c.send_lock:  # EXPECT: LOCK002
+                c.sock.send(frame)
+
+    def broadcast_positional(self, frame):
+        for c in self.conns:
+            # acquire(True) blocks forever too; only a timeout (kw or
+            # second positional) bounds it.
+            if c.send_lock.acquire(True):  # EXPECT: LOCK002
+                c.sock.send(frame)
+                c.send_lock.release()
+            if c.send_lock.acquire(True, 0.01):  # bounded: quiet
+                c.send_lock.release()
+
+    def broadcast_bounded(self, frame):
+        # The shipped discipline: bounded lock wait, writability gate,
+        # no timeout mutation — no findings.
+        for c in self.conns:
+            if not c.send_lock.acquire(timeout=0.002):
+                continue
+            try:
+                _, writable, _ = select.select([], [c.sock], [], 0)
+                if writable:
+                    c.sock.send(frame)
+            finally:
+                c.send_lock.release()
+
+    def _send(self, c, frame):
+        # Per-peer request path (not a broadcast): a blocking
+        # send_lock is the design — no finding.
+        with c.send_lock:
+            c.sock.send(frame)
+
+    def pump_forever(self, sock):
+        while True:  # EXPECT: LOCK003
+            data = sock.recv(65536)
+            if not data:
+                break
+
+    def pump_with_deadline(self, sock):
+        sock.settimeout(5.0)
+        while True:
+            data = sock.recv(65536)
+            if not data:
+                break
+
+    def pump_with_decorative_deadline(self, sock, clock):
+        # A 'deadline' nobody compares against bounds nothing.
+        log_deadline = clock() + 60
+        self.log(log_deadline)
+        while True:  # EXPECT: LOCK003
+            sock.recv(65536)
+
+    def pump_with_checked_deadline(self, sock, clock):
+        deadline = clock() + 60
+        while clock() < deadline:
+            sock.recv(65536)
+
+    def pump_with_select(self, sock, halt):
+        while not halt.is_set():
+            readable, _, _ = select.select([sock], [], [], 0.5)
+            if not readable:
+                continue
+            sock.recv(65536)

@@ -1,0 +1,18 @@
+# Marker-hygiene fixture (deliberately NOT named test_*.py so pytest
+# never collects it). ``slow`` is declared; ``sloww`` is the typo.
+import pytest
+
+
+@pytest.mark.slow
+def case_declared():
+    pass
+
+
+@pytest.mark.sloww  # EXPECT: MARK001
+def case_typo():
+    pass
+
+
+@pytest.mark.parametrize("x", [1])
+def case_builtin(x):
+    pass

@@ -1,0 +1,51 @@
+# Wire-protocol checker fixture: a miniature transport module with
+# one violation per WIRE rule next to known-good counterparts.
+# ``# EXPECT: RULE`` comments are read by tests/test_static_analysis.py
+# — every expected (rule, line) must fire, and nothing else may.
+# NOTE: constant names are chosen so unconsumed ones appear exactly
+# once (their definition) — a second textual mention would count as a
+# documented consumer.
+
+KIND_TRAJ = 1
+KIND_ACK = 2
+KIND_PARAMS = 2  # EXPECT: WIRE001
+KIND_UNWIRED = 4  # EXPECT: WIRE002
+
+CAP_CODED = 1
+CAP_SHIM = 2
+CAP_THREE = 3  # EXPECT: WIRE003
+CAP_CLASH = 2  # EXPECT: WIRE003
+
+ROLE_ACTOR = 0
+ROLE_STANDBY = 0  # EXPECT: WIRE003
+
+
+def serve(sock, ident):
+    # Consumes the good kinds/caps/roles (so WIRE002 stays quiet for
+    # them) and parses a 4-field hello.
+    kind = KIND_TRAJ
+    if kind in (KIND_TRAJ, KIND_ACK, KIND_PARAMS):
+        pass
+    caps = CAP_CODED | CAP_SHIM | CAP_THREE | CAP_CLASH
+    role = ROLE_ACTOR or ROLE_STANDBY
+    if ident.size >= 1:
+        pass
+    if ident.size >= 4:
+        pass
+    return caps, role
+
+
+class Client:
+    def __init__(self, connect, hello=None):
+        self._sock = connect(hello=hello)
+
+
+def good_hello(connect):
+    return Client(connect, hello=(1, 2, 3, 4))
+
+
+def bad_hello(connect):
+    return Client(
+        connect,
+        hello=(1, 2, 3, 4, 5),  # EXPECT: WIRE004
+    )

@@ -83,6 +83,20 @@ def _assert_leaves_equal(got, want):
         np.testing.assert_array_equal(a, b)
 
 
+def _poll_metric(server, key, want, deadline_s=5.0):
+    """Counter updates run on the serve thread AFTER its sendmsg
+    returns, so a client can observe its reply a scheduler beat
+    before the accounting lands — poll briefly instead of racing it
+    (the PR-10 transport_mb_out deflake pattern)."""
+    deadline = time.monotonic() + deadline_s
+    while server.metrics()[key] != want:
+        assert time.monotonic() < deadline, (
+            f"{key} never reached {want} "
+            f"(last {server.metrics()[key]})"
+        )
+        time.sleep(0.01)
+
+
 # ---------------------------------------------------------------------
 # Codec units: lossless by test, not just by construction.
 # ---------------------------------------------------------------------
@@ -223,9 +237,8 @@ def test_ring_miss_falls_back_to_full_frame():
         version, got = client.fetch_params()
         assert version == 5
         _assert_leaves_equal(got, cur)
-        m = server.metrics()
-        assert m["transport_param_delta_sends"] == 0
-        assert m["transport_param_sends"] == 2
+        _poll_metric(server, "transport_param_sends", 2)
+        assert server.metrics()["transport_param_delta_sends"] == 0
         # ...and the NEXT fetch after a publish is a delta again (the
         # full frame re-seeded the client's held base).
         cur = _perturb(cur, rng)
@@ -233,7 +246,7 @@ def test_ring_miss_falls_back_to_full_frame():
         version, got = client.fetch_params()
         assert version == 6
         _assert_leaves_equal(got, cur)
-        assert server.metrics()["transport_param_delta_sends"] == 1
+        _poll_metric(server, "transport_param_delta_sends", 1)
         client.close()
     finally:
         server.close()
@@ -259,7 +272,7 @@ def test_reconnect_mid_delta_stream_falls_back_to_full_frame():
             server.publish(cur, notify=False)
             version, got = client.fetch_params()  # delta
             _assert_leaves_equal(got, cur)
-            assert server.metrics()["transport_param_delta_sends"] == 1
+            _poll_metric(server, "transport_param_delta_sends", 1)
 
             # Kill the live link mid-stream; same server, new conn.
             proxy.redirect("127.0.0.1", server.port)
@@ -270,7 +283,10 @@ def test_reconnect_mid_delta_stream_falls_back_to_full_frame():
             _assert_leaves_equal(got, cur)
             assert client.reconnects >= 1
             # The post-reconnect fetch was NOT served as a delta: the
-            # fresh connection held nothing.
+            # fresh connection held nothing. Wait for that fetch's
+            # accounting to land (param_sends counts it) so the
+            # delta-counter read below is not vacuously early.
+            _poll_metric(server, "transport_param_sends", 3)
             assert server.metrics()["transport_param_delta_sends"] == 1
             client.close()
         finally:
