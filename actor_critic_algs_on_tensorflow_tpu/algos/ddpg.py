@@ -67,6 +67,17 @@ class DDPGConfig:
     # In-graph all-finite guard over the update losses + new params
     # (``health_finite`` metric; read by the run loops' sentinel).
     numerics_guards: bool = True
+    # Distributed prioritized replay tier (run_offpolicy_distributed /
+    # --replay-servers): the PER exponents (Schaul et al. 2016 /
+    # Ape-X) — priority = (|TD| + per_eps) ** per_alpha and the
+    # importance weights (N*p/total)^-per_beta are both computed
+    # SERVER-side (the weights ship with each sampled batch); per_beta
+    # is a FIXED exponent, not an annealed schedule — and whether
+    # actors byte-plane-code their transition pushes.
+    per_alpha: float = 0.6
+    per_beta: float = 0.4
+    per_eps: float = 1e-6
+    replay_codec: bool = True
     seed: int = 0
     num_devices: int = 0
 
@@ -150,9 +161,14 @@ def make_ddpg(cfg: DDPGConfig) -> offpolicy.OffPolicyFns:
             key=k_state,
         )
 
-    def one_update(replay, carry, key):
+    def update_batch(raw_batch, weights, carry, key):
+        """Sampling-free update core (see ``TrainerParts.update_batch``):
+        one gradient step on an already-sampled raw batch, optional
+        per-sample importance ``weights`` on the TD loss, per-sample
+        ``|TD|`` returned for the replay tier's priority feedback.
+        ``key`` is unused — DDPG's update math is rng-free."""
+        del key
         params, opt_state = carry
-        raw_batch = s.buf.sample(replay, key, cfg.batch_size)
         batch = onorm.norm_batch(params.obs_rms, raw_batch)
 
         def critic_loss_fn(cp):
@@ -164,9 +180,10 @@ def make_ddpg(cfg: DDPGConfig) -> offpolicy.OffPolicyFns:
             )
             y = batch.reward + cfg.gamma * (1.0 - batch.terminated) * q_next
             q = critic.apply(cp, batch.obs, batch.action)
-            return jnp.mean((q - jax.lax.stop_gradient(y)) ** 2), q
+            err = q - jax.lax.stop_gradient(y)
+            return offpolicy.weighted_sq_loss(err, weights), (q, err)
 
-        (q_loss, q), q_grads = jax.value_and_grad(
+        (q_loss, (q, err)), q_grads = jax.value_and_grad(
             critic_loss_fn, has_aux=True
         )(params.critic)
 
@@ -198,7 +215,19 @@ def make_ddpg(cfg: DDPGConfig) -> offpolicy.OffPolicyFns:
             obs_rms=onorm.fold(params.obs_rms, raw_batch.obs),
         )
         m = {"q_loss": q_loss, "actor_loss": a_loss, "q_mean": jnp.mean(q)}
-        return (new_params, {"actor": a_opt, "critic": c_opt}), m
+        return (
+            (new_params, {"actor": a_opt, "critic": c_opt}),
+            m,
+            jnp.abs(err),
+        )
+
+    def one_update(replay, carry, key):
+        # Fused-path shape: uniform sample from the HBM ring with the
+        # per-update key, then the shared core (weights=None keeps the
+        # math bit-identical to the pre-factor loss).
+        raw_batch = s.buf.sample(replay, key, cfg.batch_size)
+        carry, m, _ = update_batch(raw_batch, None, carry, key)
+        return carry, m
 
     def local_iteration(state: offpolicy.OffPolicyState):
         dev = jax.lax.axis_index(DATA_AXIS)
@@ -249,5 +278,7 @@ def make_ddpg(cfg: DDPGConfig) -> offpolicy.OffPolicyFns:
         noise_reset=ou_reset_where,
         acting_slice=lambda params: (params.actor, params.obs_rms),
         act_with=act_with,
+        update_batch=update_batch,
+        update_key_fn=lambda k: k,  # rng-free update; key ignored
     )
     return offpolicy.build_fns(s, init, local_iteration, parts=parts)

@@ -87,6 +87,20 @@ class TrainerParts(NamedTuple):
     math the fused path scans; ``act_fn(params, obs, noise, key, step)``
     the same acting; ``init_params(key, obs_example)`` builds
     (params, opt_state) without touching an environment.
+
+    ``update_batch(batch, weights, (params, opt_state), key)`` is the
+    sampling-free core ``one_update`` delegates to: it consumes an
+    ALREADY-SAMPLED raw ``Transition`` batch (wherever it came from —
+    the HBM ring, or a wire-sourced prioritized draw from the
+    distributed replay tier), applies optional per-sample importance
+    weights to the TD loss (``None`` = uniform, bit-identical to the
+    pre-factor math), and returns ``((params, opt_state), metrics,
+    td_abs)`` where ``td_abs`` is the per-sample absolute TD error the
+    replay tier feeds back as priorities. ``update_key_fn(key)`` maps
+    one per-update base key to whatever rng structure ``update_batch``
+    expects (trainers differ: DDPG none, TD3 a smoothing key, SAC a
+    stacked pair), so loops driving ``update_batch`` directly stay
+    algorithm-neutral.
     """
 
     cfg: Any
@@ -98,6 +112,8 @@ class TrainerParts(NamedTuple):
     noise_reset: Callable | None  # (noise, done) -> noise
     acting_slice: Callable      # params -> the subtree acting reads
     act_with: Callable          # (acting_slice, obs, noise, key, step)
+    update_batch: Callable | None = None
+    update_key_fn: Callable | None = None  # base key -> update_batch key
 
 
 class OffPolicyFns(NamedTuple):
@@ -296,6 +312,16 @@ def assemble_state(
         step=jnp.zeros((), jnp.int32),
     )
     return put_sharded(state, s.mesh)
+
+
+def weighted_sq_loss(err: jax.Array, weights) -> jax.Array:
+    """Mean squared TD loss with optional per-sample importance
+    weights (the PER correction). ``weights=None`` compiles to the
+    plain ``mean(err**2)`` — no multiply in the graph, so the uniform
+    path stays bit-identical to the pre-replay-tier math."""
+    if weights is None:
+        return jnp.mean(err ** 2)
+    return jnp.mean(weights * err ** 2)
 
 
 def gated_updates(

@@ -1,0 +1,765 @@
+"""Algorithm-neutral distributed off-policy runner (the Ape-X shape).
+
+``run_offpolicy_distributed`` wires the prioritized replay tier
+(``distributed/replay.py``) end-to-end for any trainer that exposes
+``TrainerParts.update_batch`` (DDPG/TD3/SAC):
+
+  - N replay-server PROCESSES, each one shard of the prioritized ring
+    (actor->shard assignment from ``ShardPlan``'s contiguous slices);
+  - M env-stepper actor PROCESSES: jitted act+env.step on the host
+    CPU, transitions pushed to their shard over the coded trajectory
+    wire path, acting params fetched from the learner's param plane
+    (KIND_GET_PARAMS + publish notifies — the PR-5 machinery as-is);
+  - the learner (this process): round-robin prioritized draws across
+    shards, one ``update_batch`` per draw with importance weights,
+    absolute-TD priorities flowed back over ``KIND_PRIO_UPDATE``, and
+    acting-slice publishes after each update burst.
+
+Update pacing: the learner targets the SAME updates-per-transition
+ratio as the single-process fused iteration
+(``updates_per_iter / (num_envs * steps_per_iter)``), so a distributed
+run at a fixed env-step budget performs a comparable number of
+gradient steps — the learning-parity contract the acceptance test
+pins. Acting and learning are otherwise unsynchronized (Ape-X).
+
+Fault semantics: replay-server and actor processes are monitored and
+respawned in place (same port — the fleet's endpoint lists are
+immutable); a replay-server restart costs refill time while draws
+fail over to the surviving shards, never the learner.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from actor_critic_algs_on_tensorflow_tpu.algos import offpolicy
+from actor_critic_algs_on_tensorflow_tpu.utils.metric_names import (
+    REPLAY,
+    REPLAY_SAMPLE,
+)
+
+_ALGOS = ("ddpg", "td3", "sac")
+
+
+def _maker(algo: str):
+    if algo == "ddpg":
+        from actor_critic_algs_on_tensorflow_tpu.algos.ddpg import make_ddpg
+
+        return make_ddpg
+    if algo == "td3":
+        from actor_critic_algs_on_tensorflow_tpu.algos.td3 import make_td3
+
+        return make_td3
+    if algo == "sac":
+        from actor_critic_algs_on_tensorflow_tpu.algos.sac import make_sac
+
+        return make_sac
+    raise ValueError(f"unknown off-policy algo {algo!r} (want {_ALGOS})")
+
+
+def algo_of_config(cfg) -> str:
+    """DDPGConfig -> 'ddpg' etc. — the spawn-safe trainer identity
+    (configs pickle across process boundaries; closures do not)."""
+    name = type(cfg).__name__.lower()
+    for algo in _ALGOS:
+        if name.startswith(algo):
+            return algo
+    raise ValueError(
+        f"config {type(cfg).__name__} is not an off-policy trainer "
+        f"config ({_ALGOS})"
+    )
+
+
+def _validate_cfg(cfg, n_replay_shards: int, n_actors: int) -> None:
+    if str(cfg.env).startswith(("gym:", "native:")):
+        raise ValueError(
+            f"run_offpolicy_distributed steps pure-JAX envs in the "
+            f"actor processes; host-resident env {cfg.env!r} is not "
+            f"supported (use the single-process --host-loop paths)"
+        )
+    if n_replay_shards < 1 or n_actors < 1:
+        raise ValueError(
+            f"need >= 1 replay shard and >= 1 actor, got "
+            f"{n_replay_shards}/{n_actors}"
+        )
+    if n_actors % n_replay_shards:
+        raise ValueError(
+            f"n_actors={n_actors} not divisible by "
+            f"n_replay_shards={n_replay_shards} (actor->shard "
+            f"assignment uses ShardPlan's contiguous equal slices)"
+        )
+
+
+def _offpolicy_actor_main(
+    algo: str,
+    cfg,
+    actor_id: int,
+    learner_host: str,
+    learner_port: int,
+    replay_endpoints: List[Tuple[str, int]],
+    seed: int,
+    generation: int = 0,
+    max_env_steps: int = 0,
+    throttle_steps_per_s: float = 0.0,
+) -> None:
+    """Entry point of one spawned env-stepper actor PROCESS.
+
+    The off-policy analog of the IMPALA actor main: a jitted
+    act+env.step scan on the host CPU, ``cfg.steps_per_iter`` steps
+    per push, transitions flattened to ``[T*B, ...]`` rows and shipped
+    to this actor's replay shard (coded when ``cfg.replay_codec``),
+    acting params re-fetched on publish notifies. ``replay_endpoints``
+    is PRIORITY-ordered with the actor's OWN shard at the head — if
+    that shard dies, pushes fail over to a sibling (any shard's data
+    is good data) and re-home head-first once it returns.
+
+    ``max_env_steps`` (> 0) caps this actor's share of the global
+    env-step budget: at the cap it PARKS (keeps the param-plane link
+    so KIND_CLOSE still reaches it; exiting would trip the runner's
+    respawn) instead of free-running past the budget — the fixed-budget
+    comparability contract of the acceptance test."""
+    jax.config.update("jax_platforms", "cpu")
+    from actor_critic_algs_on_tensorflow_tpu.distributed import (
+        codec as codec_lib,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.distributed.resilience import (
+        ResilientActorClient,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+        CAP_REPLAY,
+        CAP_TRAJ_CODED,
+        ROLE_ACTOR,
+        LearnerShutdown,
+    )
+
+    acfg = dataclasses.replace(cfg, num_devices=1)
+    parts = _maker(algo)(acfg).parts
+    s = parts.setup
+    env, env_params = s.genv, s.env_params
+
+    @jax.jit
+    def collect(acting_params, env_state, obs, noise, key, step):
+        def _step(c, k):
+            env_state, obs, noise = c
+            k_act, k_env = jax.random.split(k)
+            a, noise = parts.act_with(acting_params, obs, noise, k_act, step)
+            env_state, next_obs, reward, done, info = env.step(
+                k_env, env_state, a, env_params
+            )
+            if parts.noise_reset is not None:
+                noise = parts.noise_reset(noise, done)
+            tr = offpolicy.Transition(
+                obs=obs,
+                action=a,
+                reward=reward,
+                # AutoReset returns the post-reset obs at boundaries;
+                # the true successor is final_obs (same contract as
+                # act_then_store).
+                next_obs=info["final_obs"],
+                terminated=info["terminated"],
+            )
+            ep = (info["episode_return"], info["done_episode"])
+            return (env_state, next_obs, noise), (tr, ep)
+
+        keys = jax.random.split(key, cfg.steps_per_iter)
+        (env_state, obs, noise), (traj, ep) = jax.lax.scan(
+            _step, (env_state, obs, noise), keys
+        )
+        return env_state, obs, noise, traj, ep
+
+    # Acting-slice treedef, derived without touching the network: the
+    # learner publishes exactly acting_slice(params)'s leaves.
+    obs_spec = jax.eval_shape(
+        lambda k: env.reset(k, env_params)[1], jax.random.PRNGKey(0)
+    )
+    obs_example = jnp.zeros((1,) + obs_spec.shape[1:], obs_spec.dtype)
+    params_spec = jax.eval_shape(
+        lambda k: parts.init_params(k, obs_example)[0],
+        jax.random.PRNGKey(0),
+    )
+    acting_def = jax.tree_util.tree_structure(
+        parts.acting_slice(params_spec)
+    )
+
+    caps = CAP_REPLAY | (CAP_TRAJ_CODED if cfg.replay_codec else 0)
+    hello = (actor_id, generation, ROLE_ACTOR, caps)
+    pclient = ResilientActorClient(
+        learner_host, learner_port, hello=hello
+    )
+    rclient = ResilientActorClient(
+        replay_endpoints[0][0],
+        replay_endpoints[0][1],
+        hello=hello,
+        endpoints=replay_endpoints,
+    )
+    encoder = (
+        codec_lib.TrajEncoder(obs_delta=False) if cfg.replay_codec else None
+    )
+    try:
+        version, leaves = pclient.fetch_params()
+        while version == 0:  # learner has not published yet
+            time.sleep(0.05)
+            version, leaves = pclient.fetch_params()
+        acting = jax.tree_util.tree_unflatten(acting_def, leaves)
+
+        def refetch():
+            nonlocal version, acting
+            fetched, fresh = pclient.fetch_params()
+            if fetched > 0:
+                version = fetched
+                acting = jax.tree_util.tree_unflatten(acting_def, fresh)
+
+        key = jax.random.PRNGKey(seed)
+        key, k = jax.random.split(key)
+        env_state, obs = env.reset(k, env_params)
+        noise = parts.noise_init(cfg.num_envs)
+        steps_per_push = cfg.num_envs * cfg.steps_per_iter
+        it = 0
+        t_start = time.monotonic()
+        while True:
+            if throttle_steps_per_s > 0:
+                # Actor pacing (chaos drills / rate experiments): a
+                # pure-JAX toy env outruns any wall-clock schedule, so
+                # cap the push rate instead of letting the fleet
+                # exhaust its budget in one burst.
+                ahead = (
+                    it * steps_per_push / throttle_steps_per_s
+                    - (time.monotonic() - t_start)
+                )
+                if ahead > 0:
+                    time.sleep(min(ahead, 0.5))
+            if max_env_steps and it * steps_per_push >= max_env_steps:
+                # Budget share done: park (LearnerShutdown from the
+                # notify drain is the exit signal). wait_params_notify,
+                # not poll_notified: the park loop makes no other call
+                # that would reconnect a dropped link, and a parked
+                # actor that can't hear KIND_CLOSE only exits via the
+                # teardown SIGTERM.
+                pclient.wait_params_notify(0.2)
+                continue
+            key, k = jax.random.split(key)
+            env_state, obs, noise, traj, ep = collect(
+                acting, env_state, obs, noise, k, jnp.int32(it)
+            )
+            # [T, B, ...] -> [T*B, ...] transition rows (insertion
+            # order inside one push is irrelevant to replay).
+            rows = [
+                np.asarray(x).reshape((-1,) + np.shape(x)[2:])
+                for x in jax.tree_util.tree_leaves(traj)
+            ]
+            ep_ret, ep_done = (np.asarray(x) for x in ep)
+            finished = ep_ret[ep_done > 0.5].astype(np.float32)
+            # Fetch-before-push: a notify that landed during the
+            # rollout is in the buffer now (same discipline as the
+            # IMPALA actor main).
+            notified = pclient.poll_notified()
+            if notified > 0 and notified != version:
+                refetch()
+            rclient.push_trajectory(rows, [finished], encoder=encoder)
+            it += 1
+            if it % 10 == 0:
+                # Drift back onto the actor's OWN shard if a past
+                # fault parked this link on a fallback sibling.
+                rclient.rehome()
+    except LearnerShutdown:
+        print(
+            f"[replay-actor {actor_id}] learner closed the stream; "
+            f"exiting ({pclient.stats()} / {rclient.stats()})",
+            flush=True,
+        )
+    except (ConnectionError, OSError) as e:
+        print(
+            f"[replay-actor {actor_id}] transport failed after "
+            f"retries: {type(e).__name__}: {e}",
+            flush=True,
+        )
+    finally:
+        for c in (pclient, rclient):
+            try:
+                c.close()
+            except Exception:
+                pass
+
+
+def paced_update_target(
+    total_env_steps: int, warmup_env_steps: int, update_ratio: float
+) -> int:
+    """Updates the paced learner owes by the end of the run. Zero when
+    the budget can never clear warmup — the update gate requires
+    ``inserted >= warmup_env_steps``, so a sub-warmup run that owed
+    updates could only ever exit through the stall guard."""
+    if total_env_steps < warmup_env_steps:
+        return 0
+    return int(total_env_steps * update_ratio)
+
+
+def _build_wire_update(parts, accel):
+    """jit(shard_map) of one ``update_batch`` step over a 1-device
+    mesh on the accelerator (the update math pmean's over the data
+    axis, so it needs the mesh ctx — same shape as the host-async
+    loop's update program)."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from actor_critic_algs_on_tensorflow_tpu.algos.common import (
+        guard_metrics,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import (
+        DATA_AXIS,
+        shard_map,
+    )
+
+    cfg = parts.cfg
+
+    def body(params, opt_state, batch, weights, key):
+        (params, opt_state), m, td = parts.update_batch(
+            batch, weights, (params, opt_state), key
+        )
+        m = dict(m)
+        m.update(
+            guard_metrics(
+                getattr(cfg, "numerics_guards", False), (m, params)
+            )
+        )
+        return params, opt_state, m, td
+
+    mesh = Mesh(np.asarray([accel]), (DATA_AXIS,))
+    return jax.jit(
+        shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(), P(), P(), P(), P()),
+            out_specs=(P(), P(), P(), P()),
+            check_vma=False,
+        )
+    )
+
+
+class ReplayRunHandles(NamedTuple):
+    """Live process/endpoint view handed to ``on_start`` (chaos tests
+    SIGKILL through it; dicts are mutated in place as the runner
+    respawns, so the caller always sees the CURRENT processes)."""
+
+    replay_procs: Dict[int, Any]
+    replay_ports: Dict[int, int]
+    actor_procs: Dict[int, Any]
+    server: Any
+    group: Any
+
+
+class OffPolicyDistributedResult(NamedTuple):
+    params: Any
+    opt_state: Any
+    updates: int
+    env_steps: int
+
+
+def run_offpolicy_distributed(
+    fns: offpolicy.OffPolicyFns,
+    *,
+    total_env_steps: int,
+    seed: int = 0,
+    n_replay_shards: int = 2,
+    n_actors: int = 2,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    log_interval: int = 20,
+    log_fn=None,
+    summary_writer=None,
+    stop_event=None,
+    on_start=None,
+    max_replay_restarts: int = 20,
+    max_actor_restarts: int = 5,
+    sample_retry_s: float = 2.0,
+    actor_throttle_steps_per_s: float = 0.0,
+    stall_timeout_s: float = 60.0,
+) -> Tuple[OffPolicyDistributedResult, list]:
+    """Train off-policy through the distributed replay tier.
+
+    Returns ``(result, history)`` — ``result.params`` is the FULL
+    host-side params pytree (actor + critics + targets), directly
+    evaluable by the greedy-eval harnesses.
+    """
+    import multiprocessing as mp
+
+    from actor_critic_algs_on_tensorflow_tpu.algos.common import emit_log
+    from actor_critic_algs_on_tensorflow_tpu.distributed.replay import (
+        ReplayClientGroup,
+        replay_server_main,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.distributed.sharding import (
+        ShardPlan,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+        LearnerServer,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.utils.metrics import (
+        LatencyStats,
+    )
+
+    parts = fns.parts
+    if parts is None or parts.update_batch is None:
+        raise ValueError(
+            "run_offpolicy_distributed needs TrainerParts.update_batch "
+            "(a trainer factored for wire-sourced batches)"
+        )
+    cfg = parts.cfg
+    algo = algo_of_config(cfg)
+    _validate_cfg(cfg, n_replay_shards, n_actors)
+    plan = ShardPlan(n_replay_shards)
+    ctx = mp.get_context("spawn")
+    log = lambda msg: print(f"[offpolicy-dist] {msg}", flush=True)
+
+    # -- replay-server tier -------------------------------------------
+    replay_procs: Dict[int, Any] = {}
+    replay_ports: Dict[int, int] = {}
+    replay_restarts = [0] * n_replay_shards
+
+    def spawn_replay(k: int, bind_port: int = 0):
+        parent = None
+        child = None
+        if bind_port == 0:
+            parent, child = ctx.Pipe()
+        p = ctx.Process(
+            target=replay_server_main,
+            args=(k, child),
+            kwargs=dict(
+                host="127.0.0.1",
+                port=bind_port,
+                capacity=cfg.replay_capacity,
+                alpha=cfg.per_alpha,
+                eps=cfg.per_eps,
+                seed=seed + 7919 * (k + 1),
+            ),
+            daemon=True,
+            name=f"replay-server-{k}",
+        )
+        p.start()
+        if child is not None:
+            child.close()
+        if parent is not None:
+            if not parent.poll(120.0):
+                p.terminate()
+                raise RuntimeError(
+                    f"replay server {k} never reported its port"
+                )
+            replay_ports[k] = int(parent.recv())
+            parent.close()
+        return p
+
+    for k in range(n_replay_shards):
+        replay_procs[k] = spawn_replay(k)
+    shard_endpoints = [
+        ("127.0.0.1", replay_ports[k]) for k in range(n_replay_shards)
+    ]
+
+    # -- learner param plane ------------------------------------------
+    def _discard(traj, ep, peer):
+        # Actors push transitions to the replay tier, never here; a
+        # frame landing on the param plane is a mis-wired fleet.
+        return False
+
+    server = LearnerServer(_discard, host=host, port=port, log=log)
+    accel = jax.devices()[0]
+    key = jax.random.PRNGKey(seed)
+    k_params, k_updates = jax.random.split(key)
+
+    s = parts.setup
+    obs_spec = jax.eval_shape(
+        lambda k: s.genv.reset(k, s.env_params)[1], jax.random.PRNGKey(0)
+    )
+    obs_example = jnp.zeros((1,) + obs_spec.shape[1:], obs_spec.dtype)
+    with jax.default_device(accel):
+        params, opt_state = jax.jit(parts.init_params)(
+            k_params, obs_example
+        )
+
+    def publish():
+        leaves = [
+            np.asarray(x)
+            for x in jax.tree_util.tree_leaves(
+                jax.device_get(parts.acting_slice(params))
+            )
+        ]
+        server.publish(leaves, notify=True)
+
+    publish()  # version 1: actors block on version 0 until this
+
+    # Wire-batch expectations: the flattened Transition layout every
+    # sample reply must match (a stale-config fleet's frames are
+    # rejected, not crashed on).
+    example_tr = offpolicy.Transition(
+        obs=jnp.zeros(obs_spec.shape[1:], obs_spec.dtype),
+        action=jnp.zeros((s.action_dim,)),
+        reward=jnp.zeros(()),
+        next_obs=jnp.zeros(obs_spec.shape[1:], obs_spec.dtype),
+        terminated=jnp.zeros(()),
+    )
+    tr_leaves, tr_def = jax.tree_util.tree_flatten(example_tr)
+    leaf_specs = [
+        (tuple(x.shape), np.dtype(x.dtype)) for x in tr_leaves
+    ]
+
+    def batch_ok(leaves: List[np.ndarray]) -> bool:
+        if len(leaves) != len(leaf_specs):
+            return False
+        for a, (shape, dtype) in zip(leaves, leaf_specs):
+            if (
+                a.ndim != len(shape) + 1
+                or a.shape[0] != cfg.batch_size
+                or tuple(a.shape[1:]) != shape
+                or a.dtype != dtype
+            ):
+                return False
+        return True
+
+    # -- actor fleet ---------------------------------------------------
+    learner_host = "127.0.0.1" if host in ("0.0.0.0", "") else host
+    actor_procs: Dict[int, Any] = {}
+    actor_restarts = [0] * n_actors
+
+    def actor_endpoints(i: int) -> List[Tuple[str, int]]:
+        own = plan.shard_of_actor(n_actors, i)
+        return [
+            shard_endpoints[(own + j) % n_replay_shards]
+            for j in range(n_replay_shards)
+        ]
+
+    # Per-actor budget shares: actors park at their share instead of
+    # free-running past the global budget between learner-side meter
+    # refreshes (the meter only advances on sample replies).
+    per_actor_steps = -(-total_env_steps // n_actors)  # ceil
+
+    def spawn_actor(i: int, generation: int):
+        p = ctx.Process(
+            target=_offpolicy_actor_main,
+            args=(
+                algo, cfg, i, learner_host, server.port,
+                actor_endpoints(i), seed + 100 + i, generation,
+                per_actor_steps, actor_throttle_steps_per_s,
+            ),
+            daemon=True,
+            name=f"replay-actor-{i}",
+        )
+        p.start()
+        return p
+
+    for i in range(n_actors):
+        actor_procs[i] = spawn_actor(i, 0)
+
+    group = ReplayClientGroup(
+        shard_endpoints, client_id=10_000, retry_s=sample_retry_s
+    )
+    if on_start is not None:
+        on_start(ReplayRunHandles(
+            replay_procs, replay_ports, actor_procs, server, group,
+        ))
+
+    update = _build_wire_update(parts, accel)
+    sample_lat = LatencyStats()
+    # Learning-parity pacing: the single-process fused iteration does
+    # updates_per_iter updates per (num_envs * steps_per_iter)
+    # transitions; match that updates-per-transition rate against the
+    # GLOBAL ingest meter so a fixed env-step budget buys a comparable
+    # number of gradient steps however many actors feed it.
+    update_ratio = cfg.updates_per_iter / float(
+        max(1, cfg.num_envs * cfg.steps_per_iter)
+    )
+    updates_done = 0
+    server_restarts = 0
+    actor_respawns = 0
+    batch_rejects = 0
+    history: list = []
+    m_host: Dict[str, float] = {}
+    ep_returns_sum, ep_count = 0.0, 0
+    t_last_log = time.perf_counter()
+    inserted_last_log = 0
+    it = 0
+
+    def check_procs():
+        nonlocal server_restarts, actor_respawns
+        for k in range(n_replay_shards):
+            p = replay_procs[k]
+            if p.is_alive():
+                continue
+            replay_restarts[k] += 1
+            server_restarts += 1
+            if replay_restarts[k] > max_replay_restarts:
+                raise RuntimeError(
+                    f"replay server {k} died {replay_restarts[k]} "
+                    f"times; giving up"
+                )
+            log(
+                f"replay server {k} died (exit {p.exitcode}); "
+                f"respawning on port {replay_ports[k]}"
+            )
+            # Same port (the fleet's endpoint lists are immutable);
+            # the respawn needs no port report, so it never blocks
+            # the learner loop.
+            replay_procs[k] = spawn_replay(k, bind_port=replay_ports[k])
+        for i in range(n_actors):
+            p = actor_procs[i]
+            if p.is_alive():
+                continue
+            actor_restarts[i] += 1
+            actor_respawns += 1
+            if actor_restarts[i] > max_actor_restarts:
+                raise RuntimeError(
+                    f"actor {i} died {actor_restarts[i]} times; giving up"
+                )
+            log(f"actor {i} died (exit {p.exitcode}); respawning")
+            actor_procs[i] = spawn_actor(i, actor_restarts[i])
+
+    # The run is done when the ingest budget is met AND the learner
+    # has caught up to its paced update target. A shard SIGKILL can
+    # leave the budget meter permanently short: transitions the dead
+    # shard ingested after the learner's last draw died with its ring
+    # unseen, so the cumulative meter stalls a bounded window below
+    # the budget while every actor has already parked at its share.
+    # The stall guard breaks the loop once NEITHER the meter nor the
+    # update count has moved for ``stall_timeout_s`` — armed only
+    # after the first ingest so actor compile time can't trip it.
+    target_total = paced_update_target(
+        total_env_steps, cfg.warmup_env_steps, update_ratio
+    )
+    last_progress_t = None
+    progress_mark = (-1, -1)
+    try:
+        while True:
+            if stop_event is not None and stop_event.is_set():
+                log("stop event set; shutting down")
+                break
+            inserted = group.inserted_total()
+            if inserted >= total_env_steps and (
+                updates_done >= target_total
+            ):
+                break
+            did_work = False
+            for _ in range(max(1, cfg.updates_per_iter)):
+                # Gate BEFORE drawing: a warming-up or paced-out
+                # learner must not make a shard serve (and ship) a
+                # batch it will discard — the idle path refreshes its
+                # meters with the zero-row status probe instead.
+                target_updates = int(
+                    min(inserted, total_env_steps) * update_ratio
+                )
+                if (
+                    inserted < cfg.warmup_env_steps
+                    or updates_done >= target_updates
+                ):
+                    break
+                t0 = time.perf_counter()
+                batch = group.sample(cfg.batch_size, cfg.per_beta)
+                sample_lat.add_s(time.perf_counter() - t0)
+                inserted = group.inserted_total()
+                if batch is None:
+                    break
+                if not batch_ok(batch.leaves):
+                    batch_rejects += 1
+                    continue
+                b = jax.tree_util.tree_unflatten(
+                    tr_def,
+                    [jax.device_put(x, accel) for x in batch.leaves],
+                )
+                w = jax.device_put(batch.weights, accel)
+                ukey = parts.update_key_fn(
+                    jax.random.fold_in(k_updates, updates_done)
+                )
+                params, opt_state, m_dev, td = update(
+                    params, opt_state, b, w, ukey
+                )
+                group.update_priorities(
+                    batch.shard_idx,
+                    batch.ids,
+                    batch.indices,
+                    np.asarray(td),
+                )
+                m_host = {k: float(v) for k, v in m_dev.items()}
+                updates_done += 1
+                did_work = True
+            if did_work:
+                publish()
+            else:
+                group.poll_meters()
+                time.sleep(0.02)
+            inserted = group.inserted_total()
+            if inserted > 0:
+                now = time.perf_counter()
+                mark = (inserted, updates_done)
+                if mark != progress_mark or last_progress_t is None:
+                    progress_mark, last_progress_t = mark, now
+                elif now - last_progress_t > stall_timeout_s:
+                    log(
+                        f"no ingest or update progress for "
+                        f"{stall_timeout_s:.0f}s at env_steps="
+                        f"{inserted}/{total_env_steps}, updates="
+                        f"{updates_done}/{target_total}; stopping "
+                        f"(transitions lost with a killed shard "
+                        f"leave the meter short by a bounded window)"
+                    )
+                    break
+            check_procs()
+            it += 1
+            if it % max(1, log_interval) == 0:
+                rs, rc = group.drain_episode_stats()
+                ep_returns_sum += rs
+                ep_count += rc
+                now = time.perf_counter()
+                rate = (inserted - inserted_last_log) / max(
+                    now - t_last_log, 1e-9
+                )
+                t_last_log, inserted_last_log = now, inserted
+                m = dict(m_host)
+                m.update(group.stats())
+                m.update(sample_lat.summary(REPLAY_SAMPLE))
+                m.update(server.metrics())
+                m[REPLAY + "updates"] = updates_done
+                m[REPLAY + "server_restarts"] = server_restarts
+                m[REPLAY + "actor_respawns"] = actor_respawns
+                m[REPLAY + "batch_rejects"] = batch_rejects
+                m[REPLAY + "shards"] = n_replay_shards
+                m["episodes"] = ep_count
+                m["avg_return"] = (
+                    ep_returns_sum / ep_count if ep_count else 0.0
+                )
+                ep_returns_sum, ep_count = 0.0, 0
+                m["steps_per_sec"] = rate
+                emit_log(inserted, m, history, summary_writer, log_fn)
+    finally:
+        # Orderly teardown: the param plane's KIND_CLOSE tells actors
+        # to exit; replay servers have no work of their own to finish.
+        try:
+            server.close()
+        except Exception:
+            pass
+        deadline = time.monotonic() + 10.0
+        for p in actor_procs.values():
+            p.join(timeout=max(0.1, deadline - time.monotonic()))
+        for p in actor_procs.values():
+            if p.is_alive():
+                p.terminate()
+        for p in replay_procs.values():
+            if p.is_alive():
+                p.terminate()
+        for p in list(actor_procs.values()) + list(
+            replay_procs.values()
+        ):
+            p.join(timeout=5.0)
+        group.close()
+
+    result = OffPolicyDistributedResult(
+        params=jax.device_get(params),
+        opt_state=jax.device_get(opt_state),
+        updates=updates_done,
+        env_steps=group.inserted_total(),
+    )
+    log(
+        f"done: env_steps={result.env_steps} updates={result.updates} "
+        f"(draws={group.draws}, failovers={group.sample_failovers})"
+    )
+    return result, history

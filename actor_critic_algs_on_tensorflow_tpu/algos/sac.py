@@ -63,6 +63,11 @@ class SACConfig:
     # In-graph all-finite guard over the update losses + new params
     # (``health_finite`` metric; read by the run loops' sentinel).
     numerics_guards: bool = True
+    # Distributed prioritized replay tier knobs (see DDPGConfig).
+    per_alpha: float = 0.6
+    per_beta: float = 0.4
+    per_eps: float = 1e-6
+    replay_codec: bool = True
     seed: int = 0
     num_devices: int = 0
 
@@ -149,10 +154,14 @@ def make_sac(cfg: SACConfig) -> offpolicy.OffPolicyFns:
             key=k_state,
         )
 
-    def one_update(replay, carry, key):
+    def update_batch(raw_batch, weights, carry, key):
+        """Sampling-free update core (see ``TrainerParts.update_batch``):
+        ``key`` is a stacked ``[2, ...]`` pair — row 0 the next-action
+        key, row 1 the policy key (``update_key_fn`` builds it);
+        ``weights`` apply to both twin TD losses; per-sample ``|TD|``
+        is the max over the twins."""
         params, opt_state = carry
-        k_batch, k_next, k_pi = jax.random.split(key, 3)
-        raw_batch = s.buf.sample(replay, k_batch, cfg.batch_size)
+        k_next, k_pi = key[0], key[1]
         batch = onorm.norm_batch(params.obs_rms, raw_batch)
         alpha = jnp.exp(params.log_alpha)
 
@@ -170,12 +179,15 @@ def make_sac(cfg: SACConfig) -> offpolicy.OffPolicyFns:
             y = batch.reward + cfg.gamma * (1.0 - batch.terminated) * v_next
             y = jax.lax.stop_gradient(y)
             q1, q2 = critic.apply(cp, batch.obs, batch.action)
-            return (
-                jnp.mean((q1 - y) ** 2) + jnp.mean((q2 - y) ** 2),
+            loss = offpolicy.weighted_sq_loss(
+                q1 - y, weights
+            ) + offpolicy.weighted_sq_loss(q2 - y, weights)
+            return loss, (
                 0.5 * (jnp.mean(q1) + jnp.mean(q2)),
+                jnp.maximum(jnp.abs(q1 - y), jnp.abs(q2 - y)),
             )
 
-        (q_loss, q_mean), q_grads = jax.value_and_grad(
+        (q_loss, (q_mean, td_abs)), q_grads = jax.value_and_grad(
             critic_loss_fn, has_aux=True
         )(params.critic)
 
@@ -231,7 +243,17 @@ def make_sac(cfg: SACConfig) -> offpolicy.OffPolicyFns:
             "q_mean": q_mean,
         }
         new_opt = {"actor": a_opt, "critic": c_opt, "alpha": al_opt}
-        return (new_params, new_opt), m
+        return (new_params, new_opt), m, td_abs
+
+    def one_update(replay, carry, key):
+        # Fused-path shape: the per-update key splits three ways
+        # exactly as before the factor (sample, next-action, policy).
+        k_batch, k_next, k_pi = jax.random.split(key, 3)
+        raw_batch = s.buf.sample(replay, k_batch, cfg.batch_size)
+        carry, m, _ = update_batch(
+            raw_batch, None, carry, jnp.stack([k_next, k_pi])
+        )
+        return carry, m
 
     def local_iteration(state: offpolicy.OffPolicyState):
         dev = jax.lax.axis_index(DATA_AXIS)
@@ -279,5 +301,7 @@ def make_sac(cfg: SACConfig) -> offpolicy.OffPolicyFns:
         noise_reset=None,
         acting_slice=lambda params: (params.actor, params.obs_rms),
         act_with=act_with,
+        update_batch=update_batch,
+        update_key_fn=lambda k: jax.random.split(k, 2),  # (next, pi)
     )
     return offpolicy.build_fns(s, init, local_iteration, parts=parts)

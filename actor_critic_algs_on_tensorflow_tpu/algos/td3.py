@@ -63,6 +63,11 @@ class TD3Config:
     # In-graph all-finite guard over the update losses + new params
     # (``health_finite`` metric; read by the run loops' sentinel).
     numerics_guards: bool = True
+    # Distributed prioritized replay tier knobs (see DDPGConfig).
+    per_alpha: float = 0.6
+    per_beta: float = 0.4
+    per_eps: float = 1e-6
+    replay_codec: bool = True
     seed: int = 0
     num_devices: int = 0
 
@@ -152,11 +157,14 @@ def make_td3(cfg: TD3Config) -> offpolicy.OffPolicyFns:
             key=k_state,
         )
 
-    def one_update(replay, carry, key):
+    def update_batch(raw_batch, weights, carry, key):
+        """Sampling-free update core (see ``TrainerParts.update_batch``):
+        ``key`` is the target-policy smoothing key; ``weights`` apply
+        to both twin TD losses; per-sample ``|TD|`` is the max over
+        the twins (the sharper Ape-X/PER signal)."""
         params, opt_state = carry
         upd_idx = opt_state["updates_done"]
-        k_batch, k_smooth = jax.random.split(key)
-        raw_batch = s.buf.sample(replay, k_batch, cfg.batch_size)
+        k_smooth = key
         batch = onorm.norm_batch(params.obs_rms, raw_batch)
 
         def critic_loss_fn(cp):
@@ -179,10 +187,12 @@ def make_td3(cfg: TD3Config) -> offpolicy.OffPolicyFns:
             y = batch.reward + cfg.gamma * (1.0 - batch.terminated) * q_next
             y = jax.lax.stop_gradient(y)
             q1, q2 = critic.apply(cp, batch.obs, batch.action)
-            loss = jnp.mean((q1 - y) ** 2) + jnp.mean((q2 - y) ** 2)
-            return loss, q1
+            loss = offpolicy.weighted_sq_loss(
+                q1 - y, weights
+            ) + offpolicy.weighted_sq_loss(q2 - y, weights)
+            return loss, (q1, jnp.maximum(jnp.abs(q1 - y), jnp.abs(q2 - y)))
 
-        (q_loss, q1), q_grads = jax.value_and_grad(
+        (q_loss, (q1, td_abs)), q_grads = jax.value_and_grad(
             critic_loss_fn, has_aux=True
         )(params.critic)
         q_grads = jax.lax.pmean(q_grads, DATA_AXIS)
@@ -252,7 +262,15 @@ def make_td3(cfg: TD3Config) -> offpolicy.OffPolicyFns:
             "critic": c_opt,
             "updates_done": upd_idx + 1,
         }
-        return (new_params, new_opt), m
+        return (new_params, new_opt), m, td_abs
+
+    def one_update(replay, carry, key):
+        # Fused-path shape: the per-update key splits into the sample
+        # key and the smoothing key exactly as before the factor.
+        k_batch, k_smooth = jax.random.split(key)
+        raw_batch = s.buf.sample(replay, k_batch, cfg.batch_size)
+        carry, m, _ = update_batch(raw_batch, None, carry, k_smooth)
+        return carry, m
 
     def local_iteration(state: offpolicy.OffPolicyState):
         dev = jax.lax.axis_index(DATA_AXIS)
@@ -305,5 +323,7 @@ def make_td3(cfg: TD3Config) -> offpolicy.OffPolicyFns:
         noise_reset=None,
         acting_slice=lambda params: (params.actor, params.obs_rms),
         act_with=act_with,
+        update_batch=update_batch,
+        update_key_fn=lambda k: k,  # the smoothing key
     )
     return offpolicy.build_fns(s, init, local_iteration, parts=parts)

@@ -33,6 +33,14 @@ from actor_critic_algs_on_tensorflow_tpu.analysis.core import (
 BENCH_REQUIRED = ("n", "cmd", "rc", "tail", "parsed")
 PARSED_REQUIRED = ("metric", "value", "unit", "vs_baseline")
 MULTICHIP_REQUIRED = ("n_devices", "rc", "ok", "skipped", "tail")
+# The BENCH_REPLAY leg (bench.py --measure-replay ->
+# payload["replay"]): optional per round, but a round that carries it
+# must keep the shared key set so cross-round replay comparisons
+# never silently drop a column.
+REPLAY_REQUIRED = (
+    "ingest_tps", "sample_p50_ms", "sample_p99_ms",
+    "e2e_steps_per_sec", "vs_single_process", "cpu_limited",
+)
 
 
 def _is_number(v) -> bool:
@@ -148,6 +156,35 @@ def check(root: Path, files: Sequence[Path]) -> List[Finding]:
                                  {"metric": "str", "value": "num",
                                   "unit": "str", "vs_baseline": "num",
                                   "median": "num", "spread": "num"})
+            replay = data.get("replay")
+            if replay is not None:
+                if not isinstance(replay, dict):
+                    findings.append(Finding(
+                        "BENCH001", path, 1,
+                        f"replay should be an object, got "
+                        f"{type(replay).__name__}",
+                        hint="fix the generator "
+                             "(scripts/replay_bench.py)",
+                    ))
+                else:
+                    rmissing = [
+                        k for k in REPLAY_REQUIRED if k not in replay
+                    ]
+                    if rmissing:
+                        findings.append(Finding(
+                            "BENCH001", path, 1,
+                            f"replay missing required key(s) "
+                            f"{rmissing}",
+                            hint="the BENCH_REPLAY leg's shared key "
+                                 f"set is {list(REPLAY_REQUIRED)}; "
+                                 "fix scripts/replay_bench.py",
+                        ))
+                    _check_typed(findings, path, "replay.", replay,
+                                 {"ingest_tps": "num",
+                                  "sample_p50_ms": "num",
+                                  "sample_p99_ms": "num",
+                                  "e2e_steps_per_sec": "num",
+                                  "vs_single_process": "num"})
         else:
             _check_typed(findings, path, "", data,
                          {"n_devices": "int", "rc": "int",

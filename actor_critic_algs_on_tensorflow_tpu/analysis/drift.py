@@ -45,7 +45,8 @@ from actor_critic_algs_on_tensorflow_tpu.analysis.core import (
 # shard keys: shard0_*/shard*_* dynamic, shard_* statics, and the
 # bare "shards" count — but NOT a lone "shard" (a common kwarg name).
 _FAMILY_RE = re.compile(
-    r"^(transport_|pipeline_|serve_|device_|shard[0-9*]|shard_|shards$)"
+    r"^(transport_|pipeline_|serve_|device_|replay_"
+    r"|shard[0-9*]|shard_|shards$)"
     r"[A-Za-z0-9_*]*$"
 )
 # TimeSplit's default prefix. utils/metrics.py defaults to

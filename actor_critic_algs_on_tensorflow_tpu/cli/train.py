@@ -414,6 +414,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="impala: run actors as separate processes "
                         "streaming over the TCP transport (the "
                         "multi-host topology) instead of threads")
+    p.add_argument("--replay-servers", type=int, default=0, metavar="N",
+                   help="off-policy trainers (ddpg/td3/sac): run the "
+                        "distributed Ape-X topology — N prioritized "
+                        "replay-server processes, env-stepper actor "
+                        "processes pushing transitions over the coded "
+                        "trajectory wire path, and this process as the "
+                        "learner (prioritized draws + KIND_PRIO_UPDATE "
+                        "feedback + param publishes). Pure-JAX envs "
+                        "only. PER knobs are config fields: --set "
+                        "per_alpha= per_beta= per_eps= replay_codec=")
+    p.add_argument("--replay-actors", type=int, default=None, metavar="M",
+                   help="with --replay-servers: env-stepper actor "
+                        "process count, default 2 (must divide evenly "
+                        "across the replay shards; each actor runs "
+                        "num_envs envs)")
     p.add_argument("--standby", default=None, metavar="HOST:PORT",
                    help="impala: run as a WARM-STANDBY learner for the "
                         "primary at HOST:PORT — compile up front, tail "
@@ -1014,12 +1029,51 @@ def _run(args, algo, cfg, writer) -> int:
     if args.render_dir and not args.eval:
         raise SystemExit("--render-dir requires --eval")
     if args.learner_bind and not (
-        algo == "impala" and (args.actor_processes or args.standby)
+        (algo == "impala" and (args.actor_processes or args.standby))
+        or args.replay_servers
     ):
         raise SystemExit(
             "--learner-bind requires impala with --actor-processes "
-            "or --standby"
+            "or --standby, or an off-policy run with --replay-servers"
         )
+    if args.replay_servers:
+        if args.replay_actors is None:
+            args.replay_actors = 2
+        if algo not in ("ddpg", "td3", "sac"):
+            raise SystemExit(
+                "--replay-servers is off-policy-only (ddpg/td3/sac); "
+                "the IMPALA stream has no replay buffer"
+            )
+        if args.actor_processes:
+            raise SystemExit(
+                "--actor-processes is the IMPALA wire fleet; "
+                "--replay-servers spawns its own env-stepper actors "
+                "(--replay-actors)"
+            )
+        if args.host_loop == "async":
+            raise SystemExit(
+                "--replay-servers runs its own learner loop; drop "
+                "--host-loop async"
+            )
+        if args.checkpoint_dir and not args.eval:
+            raise SystemExit(
+                "--replay-servers does not support checkpointing yet "
+                "(the replay rings live in the server processes); "
+                "drop --checkpoint-dir"
+            )
+        if args.replay_servers < 1 or args.replay_actors < 1:
+            raise SystemExit(
+                "--replay-servers/--replay-actors must be >= 1"
+            )
+        if args.replay_actors % args.replay_servers:
+            raise SystemExit(
+                f"--replay-actors {args.replay_actors} must divide "
+                f"evenly across --replay-servers "
+                f"{args.replay_servers} (ShardPlan's contiguous "
+                f"actor->shard slices)"
+            )
+    elif args.replay_actors is not None:
+        raise SystemExit("--replay-actors requires --replay-servers")
     if (args.standby or args.coordinate_preemption) and algo != "impala":
         raise SystemExit(
             "--standby / --coordinate-preemption are impala-only "
@@ -1226,6 +1280,45 @@ def _run(args, algo, cfg, writer) -> int:
         from actor_critic_algs_on_tensorflow_tpu.algos.sac import make_sac
 
         fns = make_sac(cfg)
+
+    if args.replay_servers:
+        from actor_critic_algs_on_tensorflow_tpu.algos.offpolicy_distributed import (  # noqa: E501
+            run_offpolicy_distributed,
+        )
+
+        shutdown = None
+        if args.preempt_save:
+            from actor_critic_algs_on_tensorflow_tpu.utils.health import (
+                ShutdownSignal,
+            )
+
+            shutdown = ShutdownSignal().install()
+        host, port = parse_bind(args.learner_bind)
+        try:
+            result, history = run_offpolicy_distributed(
+                fns,
+                total_env_steps=cfg.total_env_steps,
+                seed=cfg.seed,
+                n_replay_shards=args.replay_servers,
+                n_actors=args.replay_actors,
+                host=host,
+                port=port,
+                log_interval=args.log_interval,
+                summary_writer=writer,
+                stop_event=(
+                    shutdown.event if shutdown is not None else None
+                ),
+            )
+        finally:
+            if shutdown is not None:
+                shutdown.uninstall()
+        final = history[-1][1] if history else {}
+        print(
+            f"[train] done: env_steps={result.env_steps} "
+            f"updates={result.updates} "
+            f"avg_return={final.get('avg_return', float('nan')):.2f}"
+        )
+        return 0
 
     use_async = False
     if algo in ("ddpg", "td3", "sac"):

@@ -1,0 +1,859 @@
+"""Ape-X-style sharded prioritized replay tier on the existing wire
+planes (Horgan et al. 2018).
+
+PRs 1-12 built transport, codecs, resilience, sharding and quorum for
+exactly one workload: on-policy IMPALA. This module is the first
+non-IMPALA consumer of those planes — a replay-server tier that
+decouples the off-policy family (DDPG/TD3/SAC) the same way Ape-X
+decouples acting from learning:
+
+  env-stepper actors --(KIND_TRAJ/KIND_TRAJ_CODED transitions)-->
+      replay servers (host ring + sum-tree priority index)
+          --(KIND_SAMPLE_REQ/KIND_SAMPLE_BATCH prioritized batches)-->
+      learner --(KIND_PRIO_UPDATE absolute TD errors)--> replay servers
+      learner --(param plane: KIND_GET_PARAMS/PARAMS_NOTIFY)--> actors
+
+Everything below the replay logic is REUSED, not rebuilt: transitions
+ride the PR-6 coded trajectory path (byte-plane codec, per-leaf CRC,
+hello/capability negotiation, validator quarantine), the sample RPC is
+seq-tagged like the serving tier's lanes (a desynced reply fails the
+connection, the resilient client reconnects and re-draws), and the
+actor->shard assignment reuses ``ShardPlan``'s contiguous slices.
+
+The tier is sharded N ways: each replay server owns an independent
+ring + sum tree fed by its slice of the actor fleet; the learner
+round-robins draws across shards and routes each batch's priority
+update back to the shard that served it. A shard restart costs refill
+time, not a crash — the learner's per-shard clients fail fast and the
+draw rotation simply skips a dead shard until it returns.
+
+Priority discipline (bit-auditable; pinned by unit test):
+
+  - new rows enter at the maximum priority seen so far (1.0 initially),
+  - the learner sends ABSOLUTE TD errors; the server owns the exponent:
+    ``p = (|td| + eps) ** alpha`` becomes the sum-tree leaf,
+  - sampling is stratified over the total mass (one uniform draw per
+    segment), and importance weights are
+    ``w_i = (N * p_i / total) ** -beta / max_j w_j``,
+  - every row carries a monotonically-increasing id; a priority update
+    for a row the ring has since overwritten is dropped as stale
+    instead of re-prioritizing an unrelated transition.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from actor_critic_algs_on_tensorflow_tpu.distributed import codec
+from actor_critic_algs_on_tensorflow_tpu.utils.metric_names import REPLAY
+
+__all__ = [
+    "SumTree",
+    "PrioritizedReplayShard",
+    "ReplayShardService",
+    "ReplayClientGroup",
+    "SampledBatch",
+    "replay_server_main",
+]
+
+
+class SumTree:
+    """Flat-array sum tree over ``capacity`` leaves (pow2-padded).
+
+    ``tree[1]`` is the root (total mass); leaves live at
+    ``[leaf_base, leaf_base + capacity)``. All operations are
+    vectorized numpy — ``find`` descends all queries level-by-level in
+    lockstep, ``update`` recomputes each touched parent from BOTH its
+    children (duplicate-index safe). float64 throughout so prefix sums
+    stay exact enough for the bit-audit tests.
+    """
+
+    def __init__(self, capacity: int):
+        if capacity <= 0:
+            raise ValueError(f"capacity must be positive, got {capacity}")
+        self.capacity = capacity
+        n = 1
+        while n < capacity:
+            n <<= 1
+        self.leaf_base = n
+        self._tree = np.zeros(2 * n, np.float64)
+
+    def update(self, indices: np.ndarray, priorities: np.ndarray) -> None:
+        """Set leaf priorities and re-sum the touched ancestor paths."""
+        idx = np.asarray(indices, np.int64).reshape(-1)
+        pri = np.asarray(priorities, np.float64).reshape(-1)
+        if idx.size != pri.size:
+            raise ValueError(
+                f"{idx.size} indices vs {pri.size} priorities"
+            )
+        if idx.size == 0:
+            return
+        if idx.min(initial=0) < 0 or idx.max(initial=0) >= self.capacity:
+            raise ValueError(
+                f"leaf index outside [0, {self.capacity})"
+            )
+        if not np.isfinite(pri).all() or pri.min(initial=0.0) < 0.0:
+            raise ValueError("priorities must be finite and >= 0")
+        t = self._tree
+        t[self.leaf_base + idx] = pri
+        # Recompute parents bottom-up FROM THEIR CHILDREN: with
+        # duplicate leaf indices in one call, a delta-propagation would
+        # double-apply — child sums cannot.
+        parents = np.unique((self.leaf_base + idx) >> 1)
+        while parents.size and parents[0] >= 1:
+            t[parents] = t[2 * parents] + t[2 * parents + 1]
+            if parents[0] == 1:
+                break
+            parents = np.unique(parents >> 1)
+
+    def get(self, indices: np.ndarray) -> np.ndarray:
+        idx = np.asarray(indices, np.int64).reshape(-1)
+        return self._tree[self.leaf_base + idx].copy()
+
+    def total(self) -> float:
+        return float(self._tree[1])
+
+    def find(self, values: np.ndarray) -> np.ndarray:
+        """Prefix-sum descent: for each ``v`` return the leaf index
+        ``i`` with ``sum(p[:i]) <= v < sum(p[:i+1])`` (ties resolve
+        left; values clipped into ``[0, total)``)."""
+        v = np.asarray(values, np.float64).reshape(-1).copy()
+        total = self._tree[1]
+        # Clip away fp edge cases (v == total would walk off the end).
+        np.clip(v, 0.0, np.nextafter(total, 0.0), out=v)
+        idx = np.ones(v.size, np.int64)
+        t = self._tree
+        while idx[0] < self.leaf_base:
+            left = 2 * idx
+            left_sum = t[left]
+            go_right = v >= left_sum
+            v -= np.where(go_right, left_sum, 0.0)
+            idx = np.where(go_right, left + 1, left)
+        out = idx - self.leaf_base
+        # The pow2 padding leaves have zero mass, but fp clipping can
+        # still land a query on the last nonzero leaf's right sibling;
+        # clamp into the real capacity.
+        np.clip(out, 0, self.capacity - 1, out=out)
+        return out
+
+
+class LayoutError(ValueError):
+    """A transition frame disagrees with the shard's pinned layout."""
+
+
+@dataclasses.dataclass
+class _EpStats:
+    """Episode-return accounting riding the ingest path (actors append
+    finished-episode returns to their pushes; the learner drains the
+    aggregate through sample-reply metas)."""
+
+    return_sum: float = 0.0
+    count: int = 0
+
+
+class PrioritizedReplayShard:
+    """Host-side transition ring + sum-tree priority index (one shard).
+
+    Storage is a list of preallocated ``[capacity, ...]`` numpy arrays
+    whose layout is pinned by the FIRST ingested batch (same discipline
+    as the host arena: a stale-config actor's mismatched frame is
+    rejected, never enthroned). Thread-safe — ingest runs on server
+    connection threads while sampling runs on the replay handler's.
+    """
+
+    def __init__(
+        self,
+        capacity: int,
+        *,
+        alpha: float = 0.6,
+        eps: float = 1e-6,
+        seed: int = 0,
+    ):
+        if capacity <= 0:
+            raise ValueError(f"capacity must be positive, got {capacity}")
+        self.capacity = int(capacity)
+        self.alpha = float(alpha)
+        self.eps = float(eps)
+        self._lock = threading.Lock()
+        self._rng = np.random.RandomState(seed)
+        self._tree = SumTree(self.capacity)
+        self._storage: Optional[List[np.ndarray]] = None
+        self._leaf_specs: Optional[List[Tuple[tuple, np.dtype]]] = None
+        # Monotonic per-row transition ids: a priority update names
+        # (index, id) and applies only while the id still matches —
+        # wraparound overwrites invalidate stale updates exactly.
+        self._row_ids = np.full(self.capacity, -1, np.int64)
+        self._next_id = 0
+        self._insert_pos = 0
+        self.size = 0
+        # Exponentiated max priority (the sum-tree leaf value new rows
+        # enter at): Ape-X's "insert at max priority" rule.
+        self._max_pri = 1.0
+        self.ep = _EpStats()
+        # Counters (read under the lock via metrics()).
+        self.inserted = 0
+        self.overwritten = 0
+        self.samples_served = 0
+        self.sample_rows = 0
+        self.prio_applied = 0
+        self.prio_stale = 0
+        self.rejected_layout = 0
+
+    # -- ingest --------------------------------------------------------
+
+    def _pin_layout(self, leaves: Sequence[np.ndarray]) -> None:
+        self._leaf_specs = [
+            (tuple(a.shape[1:]), a.dtype) for a in leaves
+        ]
+        self._storage = [
+            np.empty((self.capacity,) + spec, dtype)
+            for spec, dtype in self._leaf_specs
+        ]
+
+    def _check_layout(self, leaves: Sequence[np.ndarray]) -> Optional[str]:
+        if len(leaves) != len(self._leaf_specs):
+            return (
+                f"{len(leaves)} leaves vs pinned {len(self._leaf_specs)}"
+            )
+        rows = {int(a.shape[0]) for a in leaves if a.ndim >= 1}
+        if len(rows) != 1:
+            return f"inconsistent row counts {sorted(rows)}"
+        for i, (a, (shape, dtype)) in enumerate(
+            zip(leaves, self._leaf_specs)
+        ):
+            if a.ndim < 1 or tuple(a.shape[1:]) != shape or a.dtype != dtype:
+                return (
+                    f"leaf {i} is {a.dtype.str}{tuple(a.shape)}, pinned "
+                    f"[n]{shape} {dtype.str}"
+                )
+        return None
+
+    def add(self, leaves: Sequence[np.ndarray]) -> int:
+        """Insert a ``[n, ...]``-rows transition batch at the cursor
+        (ring semantics; ``n`` > capacity keeps the last ``capacity``
+        rows). New rows enter the priority index at the max priority
+        seen. Returns rows inserted; raises ``LayoutError`` on a frame
+        that disagrees with the pinned layout."""
+        leaves = [np.asarray(a) for a in leaves]
+        if not leaves or leaves[0].ndim < 1:
+            raise LayoutError("transition frame carries no row axis")
+        with self._lock:
+            if self._storage is None:
+                self._pin_layout(leaves)
+            reason = self._check_layout(leaves)
+            if reason is not None:
+                self.rejected_layout += 1
+                raise LayoutError(reason)
+            n = int(leaves[0].shape[0])
+            keep = min(n, self.capacity)
+            if keep < n:
+                leaves = [a[n - keep:] for a in leaves]
+            rows = (
+                self._insert_pos + np.arange(keep, dtype=np.int64)
+            ) % self.capacity
+            for buf, a in zip(self._storage, leaves):
+                buf[rows] = a
+            self.overwritten += max(0, self.size + keep - self.capacity)
+            # Ids track the ORIGINAL stream position: when a batch
+            # exceeds capacity only its last ``keep`` rows survive,
+            # and they keep their stream ids.
+            self._row_ids[rows] = (
+                self._next_id + (n - keep) + np.arange(keep, dtype=np.int64)
+            )
+            self._next_id += n
+            self._tree.update(
+                rows, np.full(keep, self._max_pri, np.float64)
+            )
+            self._insert_pos = (self._insert_pos + keep) % self.capacity
+            self.size = min(self.size + keep, self.capacity)
+            self.inserted += n
+            return keep
+
+    def add_episode_returns(self, returns: np.ndarray) -> None:
+        r = np.asarray(returns, np.float64).reshape(-1)
+        if r.size == 0:
+            return
+        with self._lock:
+            self.ep.return_sum += float(r.sum())
+            self.ep.count += int(r.size)
+
+    def drain_episode_stats(self) -> Tuple[float, int]:
+        with self._lock:
+            out = (self.ep.return_sum, self.ep.count)
+            self.ep = _EpStats()
+            return out
+
+    # -- sampling ------------------------------------------------------
+
+    def sample(self, batch_size: int, beta: float):
+        """Stratified prioritized draw. Returns ``(indices, ids,
+        priorities, weights, batch_leaves)`` or ``None`` while the
+        shard cannot fill a batch (refilling)."""
+        batch_size = int(batch_size)
+        if batch_size <= 0:
+            raise ValueError(f"batch_size must be positive, {batch_size}")
+        with self._lock:
+            if self._storage is None or self.size < batch_size:
+                return None
+            total = self._tree.total()
+            if total <= 0.0:
+                return None
+            # Stratified: one uniform draw inside each of batch_size
+            # equal-mass segments — lower variance than iid draws and
+            # deterministic under the shard's seeded rng.
+            seg = total / batch_size
+            targets = (
+                np.arange(batch_size, dtype=np.float64)
+                + self._rng.uniform(size=batch_size)
+            ) * seg
+            idx = self._tree.find(targets)
+            # fp descent can land on a padded/unwritten leaf when the
+            # mass boundary falls exactly on it; fold back into the
+            # written region.
+            np.clip(idx, 0, self.size - 1, out=idx)
+            pri = self._tree.get(idx)
+            probs = pri / total
+            weights = np.power(
+                np.maximum(self.size * probs, 1e-12), -float(beta)
+            )
+            weights /= max(float(weights.max()), 1e-12)
+            batch = [buf[idx].copy() for buf in self._storage]
+            ids = self._row_ids[idx].copy()
+            self.samples_served += 1
+            self.sample_rows += batch_size
+            return (
+                idx.astype(np.int64),
+                ids,
+                pri,
+                weights.astype(np.float32),
+                batch,
+            )
+
+    def update_priorities(
+        self,
+        indices: np.ndarray,
+        ids: np.ndarray,
+        td_abs: np.ndarray,
+    ) -> Tuple[int, int]:
+        """Apply absolute-TD priorities: ``p = (|td| + eps) ** alpha``
+        for rows whose id still matches (overwritten rows are dropped
+        as stale). Returns (applied, stale)."""
+        idx = np.asarray(indices, np.int64).reshape(-1)
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        td = np.abs(np.asarray(td_abs, np.float64).reshape(-1))
+        if not (idx.size == ids.size == td.size):
+            raise ValueError("indices/ids/td size mismatch")
+        if idx.size == 0:
+            return 0, 0
+        if idx.min() < 0 or idx.max() >= self.capacity:
+            raise ValueError(f"row index outside [0, {self.capacity})")
+        # A hostile/corrupt TD vector must not poison the tree.
+        td = np.where(np.isfinite(td), td, 0.0)
+        pri = np.power(td + self.eps, self.alpha)
+        with self._lock:
+            fresh = self._row_ids[idx] == ids
+            applied = int(fresh.sum())
+            if applied:
+                self._tree.update(idx[fresh], pri[fresh])
+                self._max_pri = max(
+                    self._max_pri, float(pri[fresh].max())
+                )
+            self.prio_applied += applied
+            self.prio_stale += idx.size - applied
+            return applied, idx.size - applied
+
+    def priority_of(self, indices: np.ndarray) -> np.ndarray:
+        """Current sum-tree leaf values (the bit-audit probe)."""
+        with self._lock:
+            return self._tree.get(indices)
+
+    def metrics(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                REPLAY + "size": self.size,
+                REPLAY + "inserted": self.inserted,
+                REPLAY + "samples_served": self.samples_served,
+                REPLAY + "sample_rows": self.sample_rows,
+                REPLAY + "prio_applied": self.prio_applied,
+                REPLAY + "prio_stale": self.prio_stale,
+                REPLAY + "layout_rejects": self.rejected_layout,
+            }
+
+
+class _TransitionView:
+    """Adapter mapping a flattened ``offpolicy.Transition`` frame onto
+    the field names ``TrajectoryValidator`` checks (obs/rewards/dones/
+    last_obs/actions), so the PR-3 quarantine machinery applies to
+    transition frames unchanged. Frames with a different leaf count
+    still get whole-frame finite checks via ``obs``."""
+
+    def __init__(self, leaves: Sequence[np.ndarray]):
+        if len(leaves) == 5:
+            self.obs, self.actions, self.rewards, self.last_obs, \
+                self.dones = leaves
+        else:
+            self.obs = list(leaves)
+            self.actions = None
+            self.rewards = None
+            self.last_obs = None
+            self.dones = None
+
+
+class ReplayShardService:
+    """Glue between one ``LearnerServer`` and one
+    ``PrioritizedReplayShard``: the trajectory sink (transition ingest
+    with validator quarantine, plain or coded frames) and the replay
+    handler (sample RPC + priority updates).
+
+    Sample-reply wire contract (``KIND_SAMPLE_BATCH``, tag = request
+    seq): ``arrays[0]`` is a float64 meta vector
+    ``[rows_available, inserted_total, ep_return_sum, ep_count]``;
+    a served batch appends ``[indices (i64), ids (i64), priorities
+    (f64), weights (f32), *batch leaves]`` — meta alone means the
+    shard cannot fill the batch yet (refilling). Episode stats drain
+    through the meta so the learner's log stream keeps avg_return
+    without a separate reporting plane.
+    """
+
+    def __init__(
+        self,
+        shard: PrioritizedReplayShard,
+        *,
+        validator=None,
+        log: Callable[[str], None] | None = None,
+    ):
+        self.shard = shard
+        self.validator = validator
+        self._log = log if log is not None else (
+            lambda msg: print(f"[replay-shard] {msg}", flush=True)
+        )
+
+    # -- ingest (LearnerServer on_trajectory, 3-arg form) --------------
+
+    def ingest(self, traj, ep_leaves, peer) -> bool:
+        actor_id = getattr(peer, "actor_id", -1)
+        if isinstance(traj, codec.CodedTrajectory):
+            if self.validator is not None and (
+                self.validator.drop_quarantined(actor_id)
+            ):
+                return False
+            try:
+                leaves = traj.decode()
+            except codec.CodecError as e:
+                self._log(f"undecodable transition frame: {e}")
+                return False
+        else:
+            leaves = [np.asarray(x) for x in traj]
+        if self.validator is not None:
+            ok = self.validator.admit(
+                _TransitionView(leaves), {}, source_actor_id=actor_id
+            )
+            if not ok:
+                return False
+        try:
+            self.shard.add(leaves)
+        except LayoutError as e:
+            self._log(f"rejected transition frame: {e}")
+            return False
+        # Episode-info convention on this plane: one float leaf of
+        # finished-episode returns (possibly empty) per push.
+        if ep_leaves:
+            returns = np.asarray(ep_leaves[0], np.float64).reshape(-1)
+            if np.isfinite(returns).all():
+                self.shard.add_episode_returns(returns)
+        return True
+
+    # -- sample / priority plane (LearnerServer replay handler) --------
+
+    def handle(self, peer, kind, tag, arrays, reply) -> None:
+        from actor_critic_algs_on_tensorflow_tpu.distributed import (
+            transport,
+        )
+
+        if kind == transport.KIND_SAMPLE_REQ:
+            malformed = False
+            try:
+                batch_size = int(np.asarray(arrays[0]).reshape(-1)[0])
+                beta = float(np.asarray(arrays[1]).reshape(-1)[0])
+            except (IndexError, TypeError, ValueError):
+                # Answer meta-only rather than dropping the request:
+                # the client's sample_request is a BLOCKING
+                # request/reply, so silence here would hang every
+                # draw for the client's full idle deadline instead of
+                # surfacing as a visible refill + log line.
+                self._log(f"malformed sample request from {peer}")
+                malformed = True
+                batch_size = 0
+            # batch_size <= 0 is the STATUS PROBE: the learner
+            # refreshes its budget/episode meters without paying for
+            # (and without the shard serving) a discarded batch.
+            out = (
+                self.shard.sample(batch_size, beta)
+                if batch_size > 0 and not malformed
+                else None
+            )
+            ret_sum, ep_count = self.shard.drain_episode_stats()
+            meta = np.asarray(
+                [
+                    float(self.shard.size),
+                    float(self.shard.inserted),
+                    ret_sum,
+                    float(ep_count),
+                ],
+                np.float64,
+            )
+            if out is None:
+                reply([meta])
+                return
+            idx, ids, pri, weights, batch = out
+            reply([meta, idx, ids, pri, weights, *batch])
+        elif kind == transport.KIND_PRIO_UPDATE:
+            if len(arrays) != 3:
+                self._log(
+                    f"malformed priority update ({len(arrays)} arrays)"
+                )
+                return
+            try:
+                self.shard.update_priorities(
+                    np.asarray(arrays[1], np.int64),
+                    np.asarray(arrays[0], np.int64),
+                    np.asarray(arrays[2], np.float64),
+                )
+            except ValueError as e:
+                self._log(f"rejected priority update: {e}")
+
+    def metrics(self) -> Dict[str, float]:
+        return self.shard.metrics()
+
+
+def replay_server_main(
+    shard_id: int,
+    port_conn,
+    *,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    capacity: int = 100_000,
+    alpha: float = 0.6,
+    eps: float = 1e-6,
+    seed: int = 0,
+    validate: bool = True,
+    quarantine_threshold: int = 3,
+    idle_timeout_s: float | None = None,
+    max_frame_bytes: int = 1 << 30,
+    report_interval_s: float = 30.0,
+) -> None:
+    """Entry point of one spawned replay-server PROCESS.
+
+    Binds a ``LearnerServer`` whose trajectory sink feeds the shard's
+    ring (the full PR-6 ingest path: CRC at the wire, hello
+    provenance, coded-frame decode, validator quarantine) and whose
+    replay handler serves the sample/priority plane. Reports the bound
+    port back through ``port_conn`` (a multiprocessing pipe end) so
+    the parent can wire endpoints race-free, then serves until
+    terminated (the runner owns process lifetime — a replay server has
+    no work of its own to finish)."""
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+        LearnerServer,
+    )
+
+    validator = None
+    if validate:
+        from actor_critic_algs_on_tensorflow_tpu.utils.health import (
+            TrajectoryValidator,
+        )
+
+        validator = TrajectoryValidator(
+            quarantine_threshold=quarantine_threshold,
+            log=lambda msg: print(
+                f"[replay-server {shard_id}] {msg}", flush=True
+            ),
+        )
+    shard = PrioritizedReplayShard(
+        capacity, alpha=alpha, eps=eps, seed=seed
+    )
+    service = ReplayShardService(
+        shard,
+        validator=validator,
+        log=lambda msg: print(
+            f"[replay-server {shard_id}] {msg}", flush=True
+        ),
+    )
+    server = LearnerServer(
+        service.ingest,
+        host=host,
+        port=port,
+        idle_timeout_s=idle_timeout_s,
+        max_frame_bytes=max_frame_bytes,
+        # The replay tier publishes no params; the delta ring would
+        # only hold memory.
+        param_delta=False,
+        log=lambda msg: print(
+            f"[replay-server {shard_id}] {msg}", flush=True
+        ),
+    )
+    server.set_replay_handler(service.handle)
+    if port_conn is not None:
+        port_conn.send(server.port)
+        port_conn.close()
+    print(
+        f"[replay-server {shard_id}] serving on {host}:{server.port} "
+        f"(capacity {capacity}, alpha {alpha})",
+        flush=True,
+    )
+    try:
+        last_report = time.monotonic()
+        while True:
+            time.sleep(0.5)
+            if (
+                report_interval_s
+                and time.monotonic() - last_report >= report_interval_s
+            ):
+                last_report = time.monotonic()
+                print(
+                    f"[replay-server {shard_id}] {service.metrics()}",
+                    flush=True,
+                )
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.close()
+
+
+class SampledBatch:
+    """One prioritized draw as the learner consumes it."""
+
+    __slots__ = (
+        "shard_idx", "indices", "ids", "priorities", "weights", "leaves",
+    )
+
+    def __init__(self, shard_idx, indices, ids, priorities, weights, leaves):
+        self.shard_idx = shard_idx
+        self.indices = indices
+        self.ids = ids
+        self.priorities = priorities
+        self.weights = weights
+        self.leaves = leaves
+
+
+class ReplayClientGroup:
+    """Learner-side client over N replay shards: round-robin draws,
+    fail-fast failover, and priority routing.
+
+    Each shard gets its own ``ResilientActorClient`` with a SHORT
+    retry deadline: a draw against a dead shard costs ~``retry_s`` of
+    backoff, then the rotation moves on (``sample_failovers``
+    counted) — one replay-server restart degrades sampling sharpness,
+    never the learner. Priority updates route back to the shard that
+    served the batch and are best-effort by design."""
+
+    def __init__(
+        self,
+        endpoints: Sequence[Tuple[str, int]],
+        *,
+        client_id: int = 0,
+        retry_s: float = 2.0,
+        heartbeat_interval_s: float | None = 10.0,
+        idle_timeout_s: float | None = 60.0,
+        max_frame_bytes: int = 1 << 30,
+        connect_timeout: float = 5.0,
+        make_client=None,
+    ):
+        from actor_critic_algs_on_tensorflow_tpu.distributed.resilience import (  # noqa: E501
+            ResilientActorClient,
+            RetryPolicy,
+        )
+        from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (  # noqa: E501
+            CAP_REPLAY,
+            ROLE_ACTOR,
+        )
+
+        if not endpoints:
+            raise ValueError("replay client group needs >= 1 endpoint")
+        if make_client is None:
+            def make_client(host, port):
+                return ResilientActorClient(
+                    host,
+                    port,
+                    retry=RetryPolicy(deadline_s=retry_s),
+                    heartbeat_interval_s=heartbeat_interval_s,
+                    idle_timeout_s=idle_timeout_s,
+                    connect_timeout=connect_timeout,
+                    max_frame_bytes=max_frame_bytes,
+                    hello=(client_id, 0, ROLE_ACTOR, CAP_REPLAY),
+                )
+
+        # Clients are constructed LAZILY, per shard, on first use: a
+        # shard that is down when the group comes up (or restarting
+        # mid-run) must cost a failover, never the learner — eager
+        # construction would crash on the first dead endpoint.
+        self._endpoints = [(h, int(p)) for h, p in endpoints]
+        self._make_client = make_client
+        self._clients: List[Any] = [None] * len(self._endpoints)
+        self._rr = 0
+        self._seq = 0
+        self.draws = 0
+        self.refills = 0
+        self.sample_failovers = 0
+        self.prio_failures = 0
+        # Per-shard view from the last seen sample-reply meta. The
+        # budget meter is CUMULATIVE with reset detection: a respawned
+        # shard's counter restarts at 0, but the transitions its dead
+        # predecessor ingested were real env steps — summing raw
+        # meters would regress the global meter below an
+        # already-reached budget and wedge the runner's stop
+        # condition (found by the kill-drill test).
+        self.shard_rows = [0.0] * len(self._clients)
+        self.shard_inserted_last = [0.0] * len(self._clients)
+        self._shard_inserted_cum = [0.0] * len(self._clients)
+        self._ep_return_sum = 0.0
+        self._ep_count = 0
+
+    def __len__(self) -> int:
+        return len(self._clients)
+
+    def _client(self, k: int):
+        if self._clients[k] is None:
+            self._clients[k] = self._make_client(*self._endpoints[k])
+        return self._clients[k]
+
+    def _parse(self, shard_idx: int, arrays) -> Optional[SampledBatch]:
+        if not arrays:
+            raise ConnectionError("empty sample reply")
+        meta = np.asarray(arrays[0], np.float64).reshape(-1)
+        if meta.size >= 4:
+            self.shard_rows[shard_idx] = float(meta[0])
+            v = float(meta[1])
+            last = self.shard_inserted_last[shard_idx]
+            # v < last means the shard restarted and its meter reset:
+            # keep the predecessor's contribution and count the new
+            # meter from zero.
+            self._shard_inserted_cum[shard_idx] += (
+                v if v < last else v - last
+            )
+            self.shard_inserted_last[shard_idx] = v
+            self._ep_return_sum += float(meta[2])
+            self._ep_count += int(meta[3])
+        if len(arrays) == 1:
+            return None  # shard refilling
+        if len(arrays) < 6:
+            raise ConnectionError(
+                f"sample reply carries {len(arrays)} arrays"
+            )
+        return SampledBatch(
+            shard_idx,
+            np.asarray(arrays[1], np.int64),
+            np.asarray(arrays[2], np.int64),
+            np.asarray(arrays[3], np.float64),
+            np.asarray(arrays[4], np.float32),
+            [np.asarray(a) for a in arrays[5:]],
+        )
+
+    def sample(
+        self, batch_size: int, beta: float
+    ) -> Optional[SampledBatch]:
+        """One prioritized draw, rotating across shards. Walks every
+        shard at most once: a dead shard costs its client's (short)
+        retry budget and is skipped; a refilling shard is skipped for
+        free. None when no shard can serve yet."""
+        req = [
+            np.asarray([int(batch_size)], np.int64),
+            np.asarray([float(beta)], np.float64),
+        ]
+        n = len(self._clients)
+        for k in range(n):
+            shard_idx = (self._rr + k) % n
+            self._seq = (self._seq + 1) & ((1 << 48) - 1)
+            try:
+                reply = self._client(shard_idx).sample_request(
+                    self._seq, req
+                )
+            except (ConnectionError, OSError):
+                self.sample_failovers += 1
+                continue
+            batch = self._parse(shard_idx, reply)
+            if batch is None:
+                self.refills += 1
+                continue
+            self.draws += 1
+            # NEXT draw starts one past the shard that just served, so
+            # the rotation spreads draws evenly across live shards.
+            self._rr = (shard_idx + 1) % n
+            return batch
+        self._rr = (self._rr + 1) % n
+        return None
+
+    def poll_meters(self) -> None:
+        """Meter-refresh probe: a zero-row sample request, answered
+        meta-only (budget/episode accounting without a served batch).
+        The paced-out learner polls THIS instead of drawing-and-
+        discarding full batches — a real draw costs the shard a
+        sum-tree descent plus a batch copy over the wire, and would
+        inflate the draw/served counters with work no update consumed.
+        Advances the rotation one shard per call; failures are silent
+        (the next real draw pays the failover accounting)."""
+        k = self._rr
+        self._rr = (self._rr + 1) % len(self._clients)
+        self._seq = (self._seq + 1) & ((1 << 48) - 1)
+        try:
+            reply = self._client(k).sample_request(
+                self._seq,
+                [np.asarray([0], np.int64), np.asarray([0.0])],
+            )
+        except (ConnectionError, OSError):
+            return
+        self._parse(k, reply)
+
+    def update_priorities(
+        self,
+        shard_idx: int,
+        ids: np.ndarray,
+        indices: np.ndarray,
+        td_abs: np.ndarray,
+    ) -> None:
+        try:
+            self._client(shard_idx).prio_update(
+                [
+                    np.asarray(ids, np.int64),
+                    np.asarray(indices, np.int64),
+                    np.asarray(td_abs, np.float64),
+                ]
+            )
+        except (ConnectionError, OSError):
+            self.prio_failures += 1
+
+    def inserted_total(self) -> int:
+        """Aggregate transitions ever ingested across shards — the
+        runner's env-step budget meter. Monotonic across shard
+        restarts (see the reset detection in ``_parse``)."""
+        return int(sum(self._shard_inserted_cum))
+
+    def drain_episode_stats(self) -> Tuple[float, int]:
+        out = (self._ep_return_sum, self._ep_count)
+        self._ep_return_sum, self._ep_count = 0.0, 0
+        return out
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            REPLAY + "draws": self.draws,
+            REPLAY + "refills": self.refills,
+            REPLAY + "sample_failovers": self.sample_failovers,
+            REPLAY + "prio_failures": self.prio_failures,
+            REPLAY + "inserted": self.inserted_total(),
+        }
+
+    def close(self) -> None:
+        for c in self._clients:
+            if c is None:
+                continue
+            try:
+                c.close()
+            except Exception:
+                pass

@@ -353,6 +353,38 @@ class ResilientActorClient:
         with self._lock:
             return self._op(lambda c: c.fetch_params())
 
+    def sample_request(
+        self, seq: int, leaves: Sequence[np.ndarray]
+    ) -> List[np.ndarray]:
+        """Prioritized-replay draw with at-least-once delivery. Safe
+        to retry: sampling is stochastic, so a re-sent draw after a
+        reconnect is simply another draw — there is no server-side
+        state to double-step (unlike the serving tier's env lanes).
+        ``seq`` still rides the tag so a desynced reply is detected
+        and fails the connection instead of mispairing draws."""
+        with self._lock:
+            return self._op(lambda c: c.sample_request(seq, leaves))
+
+    def prio_update(self, leaves: Sequence[np.ndarray]) -> None:
+        """Best-effort priority update: one attempt, no retry loop. A
+        failure drops the connection (the next sample pays the
+        reconnect) and the update is simply lost — priorities are
+        advisory, and burning backoff budget on them would stall the
+        learner's sample loop for sharpness it can re-derive on the
+        next draw of the same rows."""
+        with self._lock:
+            if self._client is None:
+                try:
+                    self._retry.execute(self._ensure_connected, rng=self._rng)
+                except (ConnectionError, OSError):
+                    return
+            try:
+                self._client.prio_update(leaves)
+            except LearnerShutdown:
+                raise
+            except (ConnectionError, OSError):
+                self._drop()
+
     def poll_notified(self) -> int:
         """Drain already-arrived publish notifies without blocking;
         returns the newest notified param version (0 = none). Advisory
@@ -388,6 +420,22 @@ class ResilientActorClient:
             except (ConnectionError, OSError):
                 self._drop()
                 return 0
+
+    def rehome(self) -> bool:
+        """Drop the link if it currently sits on a NON-HEAD endpoint,
+        so the next operation reconnects head-first. A fault-free
+        landing on a fallback endpoint (the head was down for a
+        moment) otherwise persists forever — the priority walk only
+        runs on reconnects. Callers invoke this periodically (the
+        replay-tier actors do, every few pushes) to drift back onto
+        their primary shard once it returns; cost when the head is
+        still dead: one refused connect inside the ordinary retry
+        walk. Returns True when a drop happened."""
+        with self._lock:
+            if self._client is not None and self._ep_idx != 0:
+                self._drop()
+                return True
+        return False
 
     def stats(self) -> dict:
         out = {"reconnects": self.reconnects, "retries": self.retries}

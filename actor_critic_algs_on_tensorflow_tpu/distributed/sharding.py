@@ -124,6 +124,23 @@ class ShardPlan:
         per = num_actors // self.shard_count
         return range(shard * per, (shard + 1) * per)
 
+    def shard_of_actor(self, num_actors: int, actor_id: int) -> int:
+        """Inverse of ``actor_slice``: which shard owns this global
+        actor id. The replay tier uses it for actor->replay-shard
+        assignment (each actor pushes its transitions to exactly one
+        shard), reusing the learner plane's contiguous-slice topology
+        so provenance and slicing stay consistent across tiers."""
+        if not 0 <= actor_id < num_actors:
+            raise ValueError(
+                f"actor_id {actor_id} outside [0, {num_actors})"
+            )
+        if num_actors % self.shard_count:
+            raise ValueError(
+                f"num_actors={num_actors} not divisible by "
+                f"shard_count={self.shard_count}"
+            )
+        return actor_id // (num_actors // self.shard_count)
+
     def device_slice(self, mesh, shard: int) -> List[Any]:
         """The contiguous block of data-axis mesh devices shard
         ``shard`` feeds (in-process shape). Contiguity matters: the

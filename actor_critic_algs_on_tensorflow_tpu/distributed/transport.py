@@ -109,6 +109,24 @@ KIND_ACT_RESP = 18       # learner -> env-shim actor: tag = the request
 #                          sequence number echoed back, arrays =
 #                          [actions] sampled by the batched central
 #                          act() program
+# --- prioritized replay tier (distributed.replay) --------------------
+KIND_SAMPLE_REQ = 19     # learner -> replay server: tag = per-draw
+#                          sequence number, arrays = [int64
+#                          [batch_size], float64 [beta]] — "serve me a
+#                          prioritized batch" (routed to the replay
+#                          handler, see set_replay_handler)
+KIND_SAMPLE_BATCH = 20   # replay server -> learner: tag = the request
+#                          sequence number echoed back, arrays =
+#                          [meta] + batch leaves — meta alone when the
+#                          shard cannot fill a batch yet (refill), see
+#                          distributed.replay for the meta layout
+KIND_PRIO_UPDATE = 21    # learner -> replay server: tag = n rows,
+#                          arrays = [row ids, row indices, absolute TD
+#                          errors] from the learner step. One-way
+#                          (no reply): priority updates are advisory —
+#                          a lost update costs sampling sharpness, not
+#                          correctness — so the hot path pays no extra
+#                          round trip (routed to the replay handler)
 
 # KIND_OBS_REQ tag flag bit: the request's arrays are one coded
 # trajectory-codec frame ([meta] + wire leaves — the PR-6 byte-plane
@@ -158,6 +176,13 @@ CAP_TRAJ_CODED = 1
 # serving tier; the server accepts shim and classic actors on one
 # listener either way.
 CAP_INFERENCE = 2
+# The peer speaks the prioritized-replay protocol
+# (KIND_SAMPLE_REQ/SAMPLE_BATCH/PRIO_UPDATE): announced by the
+# learner's sample clients and by off-policy actors pushing transition
+# frames, so a replay server's registry distinguishes the consumers of
+# its sample plane from its transition producers (see
+# distributed.replay).
+CAP_REPLAY = 4
 
 _HEADER = struct_lib.Struct(">4sBQI")
 _ARRAY_HEADER = struct_lib.Struct(">B")
@@ -471,6 +496,13 @@ class LearnerServer:
         # KIND_OBS_REQ frames are routed to it instead of being a
         # protocol error. handler(peer, seq, arrays, coded, reply).
         self._inference = None
+        # Prioritized-replay handler (distributed.replay): when set,
+        # KIND_SAMPLE_REQ / KIND_PRIO_UPDATE frames are routed to it
+        # instead of being a protocol error.
+        # handler(peer, kind, tag, arrays, reply) — reply(arrays)
+        # sends the KIND_SAMPLE_BATCH for a sample request (None for
+        # the one-way priority update).
+        self._replay = None
         self._idle_timeout = idle_timeout_s
         # Param wire codec (distributed.codec): keep a small ring of
         # recent published versions' wire leaves and serve an XOR-delta
@@ -537,6 +569,12 @@ class LearnerServer:
         self._obs_reqs = 0
         self._obs_bytes_in = 0
         self._act_resps = 0
+        # Replay-tier accounting: sample requests in, batches served
+        # out (and their payload bytes), priority updates applied.
+        self._sample_reqs = 0
+        self._sample_batches = 0
+        self._sample_bytes_out = 0
+        self._prio_updates = 0
         # Param-staleness-at-fetch accounting (actors only, excluding
         # the first fetch): how many publishes behind a fetching actor
         # was when it asked. The mid-rollout-fetch A/B reads this as
@@ -588,6 +626,18 @@ class LearnerServer:
         ``KIND_OBS_REQ`` is a protocol error (a shim actor pointed at
         a non-serving learner fails loudly instead of hanging)."""
         self._inference = handler
+
+    def set_replay_handler(self, handler) -> None:
+        """Install the prioritized-replay request handler
+        (``distributed.replay.ReplayShardService.handle``). Called as
+        ``handler(peer, kind, tag, arrays, reply)`` on the
+        connection's thread for ``KIND_SAMPLE_REQ`` (``reply(arrays)``
+        sends the ``KIND_SAMPLE_BATCH`` echoing the request's sequence
+        tag) and ``KIND_PRIO_UPDATE`` (one-way; ``reply`` is None).
+        Without a handler either kind is a protocol error — a sample
+        client pointed at a non-replay learner fails loudly instead of
+        hanging."""
+        self._replay = handler
 
     @staticmethod
     def _crcs_of(arrays: Sequence[np.ndarray]) -> List[int]:
@@ -766,6 +816,15 @@ class LearnerServer:
                     self._obs_bytes_in / 1e6, 6
                 ),
                 "transport_act_resps": self._act_resps,
+                # Replay tier: sample requests in / prioritized
+                # batches out (KIND_SAMPLE_REQ / KIND_SAMPLE_BATCH)
+                # and one-way priority updates received.
+                "transport_sample_reqs": self._sample_reqs,
+                "transport_sample_batches": self._sample_batches,
+                "transport_sample_mb_out": round(
+                    self._sample_bytes_out / 1e6, 6
+                ),
+                "transport_prio_updates": self._prio_updates,
                 # Mean publishes-behind at actor param fetches (first
                 # fetches excluded — "behind" is undefined before a
                 # version is held).
@@ -961,6 +1020,21 @@ class LearnerServer:
             self._act_resps += 1
         return True
 
+    def _reply_sample(self, c: _Conn, seq: int, arrays) -> bool:
+        """Send one ``KIND_SAMPLE_BATCH`` on ``c`` (called by the
+        replay handler, from the connection's thread or its own).
+        False when the connection is already gone — the sample client
+        reconnects and re-asks with a fresh sequence number (sampling
+        is stochastic; a duplicate draw is just another draw)."""
+        try:
+            n = self._send(c, KIND_SAMPLE_BATCH, seq, arrays)
+        except (OSError, ValueError):
+            return False
+        with self._reg_lock:
+            self._sample_batches += 1
+            self._sample_bytes_out += n
+        return True
+
     def _retire(self, c: _Conn, reason: str) -> None:
         with self._reg_lock:
             if self._conns.pop(c.cid, None) is None:
@@ -1088,6 +1162,37 @@ class LearnerServer:
                             _c, _s, arrs
                         ),
                     )
+                elif kind in (KIND_SAMPLE_REQ, KIND_PRIO_UPDATE):
+                    handler = self._replay
+                    if handler is None:
+                        # A sample client pointed at a learner that is
+                        # not a replay server: fail the connection
+                        # loudly (the client's retries surface it)
+                        # instead of letting it block on a batch that
+                        # will never come.
+                        raise ConnectionError(
+                            "replay frame (kind "
+                            f"{kind}) but the prioritized-replay "
+                            "handler is not installed on this server"
+                        )
+                    with self._reg_lock:
+                        peer = PeerInfo(
+                            c.cid, c.actor_id, c.generation, c.role
+                        )
+                        if kind == KIND_SAMPLE_REQ:
+                            self._sample_reqs += 1
+                        else:
+                            self._prio_updates += 1
+                    reply = (
+                        (
+                            lambda arrs, _c=c, _s=tag: self._reply_sample(
+                                _c, _s, arrs
+                            )
+                        )
+                        if kind == KIND_SAMPLE_REQ
+                        else None
+                    )
+                    handler(peer, kind, tag, arrays, reply)
                 elif kind == KIND_GET_PARAMS:
                     # tag = the version the client already holds (0 =
                     # none / legacy client): ring hit -> delta frame.
@@ -1515,6 +1620,39 @@ class ActorClient:
                 f"act reply for seq {rtag}, expected {seq}"
             )
         return out
+
+    def sample_request(
+        self, seq: int, arrays: Sequence[np.ndarray]
+    ) -> List[np.ndarray]:
+        """Prioritized-replay sample request: ship the draw spec
+        (``[int64 [batch_size], float64 [beta]]``) and block for the
+        ``KIND_SAMPLE_BATCH``. ``seq`` tags the request and must be
+        echoed back (the serving tier's lane discipline): a reply for
+        some other draw means the strictly request/reply stream
+        desynced, so the connection is failed and the resilient
+        wrapper reconnects and re-draws. Returns the reply's arrays
+        (``[meta] + batch leaves``; meta alone while the shard
+        refills)."""
+        self._send(KIND_SAMPLE_REQ, seq, [np.asarray(a) for a in arrays])
+        kind, rtag, out = self._await_reply()
+        if kind != KIND_SAMPLE_BATCH:
+            raise ConnectionError(f"expected SAMPLE_BATCH, got kind {kind}")
+        if rtag != seq:
+            raise ConnectionError(
+                f"sample reply for seq {rtag}, expected {seq}"
+            )
+        return out
+
+    def prio_update(self, arrays: Sequence[np.ndarray]) -> None:
+        """One-way priority update (``[row ids, row indices, absolute
+        TD errors]``). No reply — a priority refresh is advisory, and
+        the next sample request's reply confirms the stream is
+        healthy. A send failure still surfaces as ``ConnectionError``
+        so the resilient wrapper reconnects (and may re-send: applying
+        absolute priorities twice is idempotent)."""
+        arrays = [np.asarray(a) for a in arrays]
+        n = int(arrays[0].shape[0]) if arrays else 0
+        self._send(KIND_PRIO_UPDATE, n, arrays)
 
     def fetch_params(self) -> Tuple[int, List[np.ndarray]]:
         """Fetch the newest published params, reporting the version

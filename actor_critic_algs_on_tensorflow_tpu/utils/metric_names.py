@@ -30,9 +30,11 @@ PIPELINE = "pipeline_"
 SERVE = "serve_"
 DEVICE = "device_"
 SHARD = "shard"          # shard{N}_* dynamic keys + shard_* statics
+REPLAY = "replay_"       # prioritized replay tier (distributed/replay.py)
 SERVE_ACT = SERVE + "act_"   # LatencyStats.summary prefix (serving tier)
+REPLAY_SAMPLE = REPLAY + "sample_"  # LatencyStats.summary prefix (draws)
 
-FAMILY_PREFIXES = (TRANSPORT, PIPELINE, SERVE, DEVICE, SHARD)
+FAMILY_PREFIXES = (TRANSPORT, PIPELINE, SERVE, DEVICE, SHARD, REPLAY)
 
 # --- registry: family key -> one-line provenance ---------------------
 # ``*`` covers runtime-formatted segments (shard indices). Keep keys
@@ -56,6 +58,10 @@ METRIC_NAMES: dict = {
     TRANSPORT + "obs_reqs": "serving-tier observation requests in",
     TRANSPORT + "obs_mb_in": "observation request payload MB",
     TRANSPORT + "act_resps": "serving-tier action replies out",
+    TRANSPORT + "sample_reqs": "replay-tier sample requests in",
+    TRANSPORT + "sample_batches": "replay-tier prioritized batches out",
+    TRANSPORT + "sample_mb_out": "replay-tier batch payload MB out",
+    TRANSPORT + "prio_updates": "replay-tier priority updates received",
     TRANSPORT + "param_staleness_mean": "mean publishes-behind at fetch",
     TRANSPORT + "pings": "heartbeat probes received",
     TRANSPORT + "hellos": "identity announcements received",
@@ -118,6 +124,31 @@ METRIC_NAMES: dict = {
     DEVICE + "step_share": "bench device leg: step_s share of wall",
     DEVICE + "vs_pipelined": "bench device leg: speedup vs pipelined",
     DEVICE + "vs_serial": "bench device leg: speedup vs serial",
+    # -- replay_*: prioritized replay tier (distributed/replay.py
+    # shard + client-group counters, algos/offpolicy_distributed.py
+    # learner loop, plus the pre-existing fused-path ring gauge)
+    REPLAY + "size": "rows resident in a shard's ring (also the "
+                     "fused path's HBM ring gauge)",
+    REPLAY + "inserted": "transitions ingested (shard / aggregate)",
+    REPLAY + "samples_served": "prioritized batches a shard served",
+    REPLAY + "sample_rows": "rows a shard served across batches",
+    REPLAY + "prio_applied": "priority updates applied to live rows",
+    REPLAY + "prio_stale": "priority updates dropped (row overwritten)",
+    REPLAY + "layout_rejects": "transition frames off the pinned layout",
+    REPLAY + "draws": "learner draws served across shards",
+    REPLAY + "refills": "draws answered meta-only (shard refilling)",
+    REPLAY + "sample_failovers": "draws failed over past a dead shard",
+    REPLAY + "prio_failures": "priority updates lost to transport",
+    REPLAY + "updates": "gradient updates on wire-sourced batches",
+    REPLAY + "server_restarts": "replay-server processes respawned",
+    REPLAY + "actor_respawns": "env-stepper actor processes respawned",
+    REPLAY + "batch_rejects": "sampled batches off the expected layout",
+    REPLAY + "shards": "replay shard count (log attribution)",
+    REPLAY_SAMPLE + "count": "sample-draw latency samples",
+    REPLAY_SAMPLE + "mean_ms": "sample-draw latency mean",
+    REPLAY_SAMPLE + "p50_ms": "sample-draw latency p50",
+    REPLAY_SAMPLE + "p99_ms": "sample-draw latency p99",
+    REPLAY_SAMPLE + "max_ms": "sample-draw latency max",
     # -- shard*: sharded-learner log attribution (algos/impala.py)
     # + the shard bench ledger (scripts/shard_bench.py)
     SHARD + "_count": "topology echo: shard count (log attribution)",

@@ -77,6 +77,15 @@ Merged under ``"serve"``; same off-by-default contract
 (scripts/serve_bench.py owns the measurement helpers;
 ``BENCH_SERVE_LIGHT=1`` switches to scripted in-process clients to
 isolate the serving path from client env CPU on small hosts).
+
+Optional prioritized-replay leg (``BENCH_REPLAY=1``): a subprocess
+runs the Ape-X replay tier — wire-path transition ingest into a real
+replay shard (transitions/sec), prioritized-draw latency p50/p99 with
+the priority write-back in the loop, and a distributed-DDPG vs
+single-process end-to-end steps/sec comparison. Merged under
+``"replay"`` with the required key set pinned by
+``analysis/bench_schema.py`` (scripts/replay_bench.py owns the
+helpers; ``BENCH_REPLAY_E2E=0`` skips the heavy e2e leg).
 """
 
 from __future__ import annotations
@@ -491,6 +500,40 @@ def measure_shard() -> dict:
     )
 
 
+def measure_replay() -> dict:
+    """Prioritized-replay-tier leg (scripts/replay_bench.py owns the
+    helpers): wire-path ingest transitions/sec, prioritized-draw
+    p50/p99, and end-to-end distributed-vs-single-process steps/sec
+    with ``cpu_limited`` discipline."""
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts"),
+    )
+    import replay_bench as rpb
+
+    return rpb.bench(
+        ingest_kwargs={
+            "n_pushers": int(os.environ.get("BENCH_REPLAY_PUSHERS", 2)),
+            "pushes_per_pusher": int(
+                os.environ.get("BENCH_REPLAY_PUSHES", 50)
+            ),
+            "rows_per_push": int(os.environ.get("BENCH_REPLAY_ROWS", 512)),
+            "coded": bool(int(os.environ.get("BENCH_REPLAY_CODED", 1))),
+        },
+        sample_kwargs={
+            "rows": int(os.environ.get("BENCH_REPLAY_SAMPLE_ROWS", 50_000)),
+            "batch_size": int(os.environ.get("BENCH_REPLAY_BATCH", 256)),
+            "draws": int(os.environ.get("BENCH_REPLAY_DRAWS", 200)),
+        },
+        e2e_kwargs={
+            "total_env_steps": int(
+                os.environ.get("BENCH_REPLAY_E2E_STEPS", 16_000)
+            ),
+        },
+        run_e2e=bool(int(os.environ.get("BENCH_REPLAY_E2E", 1))),
+    )
+
+
 def _notify_latencies_ms(cpb, versions) -> list:
     """publish() -> fetch-complete latencies (ms); the harness itself
     lives in controlplane_bench (single source of truth)."""
@@ -558,6 +601,15 @@ def main() -> int:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         try:
             print(json.dumps(measure_shard()))
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+            return 1
+        return 0
+
+    if len(sys.argv) > 1 and sys.argv[1] == "--measure-replay":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        try:
+            print(json.dumps(measure_replay()))
         except Exception:
             traceback.print_exc(file=sys.stderr)
             return 1
@@ -776,6 +828,27 @@ def main() -> int:
             sys.stderr.write(
                 "[bench] shard leg failed\n"
                 + (dchild.stderr[-2000:] if dchild is not None else "")
+            )
+    if os.environ.get("BENCH_REPLAY"):
+        rchild = None
+        try:
+            rchild = subprocess.run(
+                [
+                    sys.executable, os.path.abspath(__file__),
+                    "--measure-replay",
+                ],
+                capture_output=True,
+                text=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                timeout=int(os.environ.get("BENCH_CHILD_TIMEOUT", 900)),
+            )
+            payload["replay"] = json.loads(
+                rchild.stdout.strip().splitlines()[-1]
+            )
+        except Exception:
+            sys.stderr.write(
+                "[bench] replay leg failed\n"
+                + (rchild.stderr[-2000:] if rchild is not None else "")
             )
     if os.environ.get("BENCH_SERVE"):
         schild = None

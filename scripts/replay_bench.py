@@ -1,0 +1,332 @@
+"""Prioritized-replay-tier bench: ingest throughput, sample latency,
+and the end-to-end distributed-vs-single-process steps/sec leg.
+
+Three legs, mirroring the tier's three planes:
+
+  - ``ingest``: N pusher threads stream synthetic transition frames
+    through a REAL ``LearnerServer`` + ``ReplayShardService`` (the
+    production wire path: framing, CRC, optional byte-plane codec) —
+    transitions/sec into the ring.
+  - ``sample``: a preloaded shard serves prioritized batches over the
+    wire; per-draw latency p50/p99 through ``LatencyStats``, with the
+    priority-update write-back in the loop (the learner's real cycle).
+  - ``e2e``: a tiny distributed DDPG run (real replay-server + actor
+    processes) vs the single-process fused iteration at the same
+    config — median steps/sec each, ratio reported as
+    ``vs_single_process``.
+
+Caveat recorded with every result: on a host with fewer cores than
+``learner + shards + actors`` the e2e legs timeshare one CPU, so the
+ratio measures scheduler overlap, not the tier's parallel capacity —
+``cpu_limited`` flags it (BENCH_SHARD discipline).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import numpy as np
+
+
+def _cpu_budget() -> int:
+    try:
+        return len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        return os.cpu_count() or 1
+
+
+def _transition_rows(rng, rows: int, obs_dim: int, action_dim: int):
+    """Synthetic flattened-Transition frame: [obs, action, reward,
+    next_obs, terminated] with a row axis."""
+    return [
+        rng.standard_normal((rows, obs_dim)).astype(np.float32),
+        rng.standard_normal((rows, action_dim)).astype(np.float32),
+        rng.standard_normal(rows).astype(np.float32),
+        rng.standard_normal((rows, obs_dim)).astype(np.float32),
+        (rng.random(rows) < 0.01).astype(np.float32),
+    ]
+
+
+def _start_shard_server(capacity: int, *, alpha: float = 0.6):
+    """In-process replay shard behind a real ``LearnerServer``."""
+    from actor_critic_algs_on_tensorflow_tpu.distributed.replay import (
+        PrioritizedReplayShard,
+        ReplayShardService,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+        LearnerServer,
+    )
+
+    shard = PrioritizedReplayShard(capacity, alpha=alpha)
+    service = ReplayShardService(shard, log=lambda m: None)
+    server = LearnerServer(
+        service.ingest, param_delta=False, log=lambda m: None
+    )
+    server.set_replay_handler(service.handle)
+    return shard, service, server
+
+
+def ingest_leg(
+    *,
+    n_pushers: int = 2,
+    pushes_per_pusher: int = 50,
+    rows_per_push: int = 512,
+    obs_dim: int = 64,
+    action_dim: int = 4,
+    coded: bool = True,
+) -> dict:
+    """Wire-path ingest throughput into one shard."""
+    from actor_critic_algs_on_tensorflow_tpu.distributed import codec
+    from actor_critic_algs_on_tensorflow_tpu.distributed.resilience import (
+        ResilientActorClient,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+        CAP_REPLAY,
+        ROLE_ACTOR,
+    )
+
+    shard, _, server = _start_shard_server(
+        n_pushers * pushes_per_pusher * rows_per_push
+    )
+    frames = [
+        _transition_rows(
+            np.random.default_rng(i), rows_per_push, obs_dim, action_dim
+        )
+        for i in range(n_pushers)
+    ]
+
+    def pusher(i: int):
+        client = ResilientActorClient(
+            "127.0.0.1", server.port,
+            hello=(i, 0, ROLE_ACTOR, CAP_REPLAY),
+        )
+        encoder = codec.TrajEncoder(obs_delta=False) if coded else None
+        try:
+            for _ in range(pushes_per_pusher):
+                client.push_trajectory(frames[i], [], encoder=encoder)
+        finally:
+            client.close()
+
+    threads = [
+        threading.Thread(target=pusher, args=(i,)) for i in range(n_pushers)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    total = n_pushers * pushes_per_pusher * rows_per_push
+    m = server.metrics()
+    server.close()
+    assert shard.inserted == total, (shard.inserted, total)
+    return {
+        "transitions": total,
+        "ingest_tps": round(total / max(wall, 1e-9), 1),
+        "wire_mb_in": m["transport_traj_mb_in"],
+        "coded": coded,
+        "wall_s": round(wall, 3),
+    }
+
+
+def sample_leg(
+    *,
+    rows: int = 50_000,
+    batch_size: int = 256,
+    draws: int = 200,
+    obs_dim: int = 64,
+    action_dim: int = 4,
+    beta: float = 0.4,
+) -> dict:
+    """Prioritized-draw latency over the wire, priority write-back in
+    the loop."""
+    from actor_critic_algs_on_tensorflow_tpu.distributed.replay import (
+        ReplayClientGroup,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.utils.metrics import (
+        LatencyStats,
+    )
+
+    shard, _, server = _start_shard_server(rows)
+    rng = np.random.default_rng(0)
+    # Preload directly (the ingest leg owns wire-path ingest cost).
+    done = 0
+    while done < rows:
+        n = min(4096, rows - done)
+        shard.add(_transition_rows(rng, n, obs_dim, action_dim))
+        done += n
+    group = ReplayClientGroup([("127.0.0.1", server.port)], client_id=1)
+    lat = LatencyStats()
+    for _ in range(draws):
+        t0 = time.perf_counter()
+        batch = group.sample(batch_size, beta)
+        lat.add_s(time.perf_counter() - t0)
+        assert batch is not None
+        group.update_priorities(
+            batch.shard_idx, batch.ids, batch.indices,
+            rng.random(batch_size),
+        )
+    summary = lat.summary()
+    group.close()
+    server.close()
+    return {
+        "rows": rows,
+        "batch_size": batch_size,
+        "draws": draws,
+        "sample_p50_ms": summary["p50_ms"],
+        "sample_p99_ms": summary["p99_ms"],
+        "sample_mean_ms": summary["mean_ms"],
+        "prio_applied": shard.prio_applied,
+    }
+
+
+def e2e_leg(
+    *,
+    total_env_steps: int = 16_000,
+    n_replay_shards: int = 2,
+    n_actors: int = 2,
+    env: str = "Pendulum-v1",
+) -> dict:
+    """Distributed DDPG through the replay tier vs the single-process
+    fused iteration at the same config.
+
+    Rate = budget / wall-clock TO COMPLETION for both legs (each pays
+    its own compile; the distributed leg additionally pays process
+    spawn and the learner's paced update catch-up) — acting and
+    learning are unsynchronized in the tier, so a windowed ingest
+    rate would compare an actor burst against the fused loop's
+    steady state. On a core-starved host the ratio measures
+    timesharing, which ``cpu_limited`` flags."""
+    from actor_critic_algs_on_tensorflow_tpu.algos import common
+    from actor_critic_algs_on_tensorflow_tpu.algos.ddpg import (
+        DDPGConfig,
+        make_ddpg,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.algos.offpolicy_distributed import (  # noqa: E501
+        run_offpolicy_distributed,
+    )
+
+    cfg = DDPGConfig(
+        env=env,
+        num_envs=8,
+        steps_per_iter=8,
+        updates_per_iter=8,
+        replay_capacity=total_env_steps,
+        batch_size=64,
+        warmup_env_steps=500,
+        total_env_steps=total_env_steps,
+        num_devices=1,
+    )
+    fns = make_ddpg(cfg)
+
+    t0 = time.perf_counter()
+    common.run_loop(
+        fns,
+        total_env_steps=total_env_steps,
+        seed=0,
+        log_interval_iters=25,
+        log_fn=lambda s, m: None,
+    )
+    single_wall = time.perf_counter() - t0
+    single_rate = total_env_steps / max(single_wall, 1e-9)
+
+    t0 = time.perf_counter()
+    result, _ = run_offpolicy_distributed(
+        fns,
+        total_env_steps=total_env_steps,
+        seed=0,
+        n_replay_shards=n_replay_shards,
+        n_actors=n_actors,
+        log_interval=25,
+        log_fn=lambda s, m: None,
+    )
+    dist_wall = time.perf_counter() - t0
+    dist_rate = result.env_steps / max(dist_wall, 1e-9)
+    return {
+        "total_env_steps": total_env_steps,
+        "replay_shards": n_replay_shards,
+        "actors": n_actors,
+        "updates": result.updates,
+        "e2e_steps_per_sec": round(dist_rate, 1),
+        "e2e_wall_s": round(dist_wall, 2),
+        "single_steps_per_sec": round(single_rate, 1),
+        "single_wall_s": round(single_wall, 2),
+        "vs_single_process": round(
+            dist_rate / max(single_rate, 1e-9), 4
+        ),
+    }
+
+
+def bench(
+    *,
+    ingest_kwargs: dict | None = None,
+    sample_kwargs: dict | None = None,
+    e2e_kwargs: dict | None = None,
+    run_e2e: bool = True,
+) -> dict:
+    """The ``BENCH_REPLAY`` payload (schema pinned by
+    ``analysis/bench_schema.py``)."""
+    ingest = ingest_leg(**(ingest_kwargs or {}))
+    sample = sample_leg(**(sample_kwargs or {}))
+    out = {
+        "ingest": ingest,
+        "sample": sample,
+        "ingest_tps": ingest["ingest_tps"],
+        "sample_p50_ms": sample["sample_p50_ms"],
+        "sample_p99_ms": sample["sample_p99_ms"],
+    }
+    if run_e2e:
+        e2e = e2e_leg(**(e2e_kwargs or {}))
+        out["e2e"] = e2e
+        out["e2e_steps_per_sec"] = e2e["e2e_steps_per_sec"]
+        out["vs_single_process"] = e2e["vs_single_process"]
+    else:
+        out["e2e_steps_per_sec"] = 0.0
+        out["vs_single_process"] = 0.0
+    cpus = _cpu_budget()
+    out["cpus"] = cpus
+    # Fewer cores than learner + shards + actors: the e2e ratio
+    # measures scheduler overlap on a shared core, not the tier's
+    # parallel capacity.
+    e2e_cfg = e2e_kwargs or {}
+    workers = 1 + e2e_cfg.get("n_replay_shards", 2) + e2e_cfg.get(
+        "n_actors", 2
+    )
+    out["cpu_limited"] = cpus < workers
+    return out
+
+
+def main() -> int:
+    import json
+
+    out = bench(
+        ingest_kwargs={
+            "n_pushers": int(os.environ.get("BENCH_REPLAY_PUSHERS", 2)),
+            "pushes_per_pusher": int(
+                os.environ.get("BENCH_REPLAY_PUSHES", 50)
+            ),
+            "rows_per_push": int(os.environ.get("BENCH_REPLAY_ROWS", 512)),
+            "coded": bool(int(os.environ.get("BENCH_REPLAY_CODED", 1))),
+        },
+        sample_kwargs={
+            "rows": int(os.environ.get("BENCH_REPLAY_SAMPLE_ROWS", 50_000)),
+            "batch_size": int(os.environ.get("BENCH_REPLAY_BATCH", 256)),
+            "draws": int(os.environ.get("BENCH_REPLAY_DRAWS", 200)),
+        },
+        e2e_kwargs={
+            "total_env_steps": int(
+                os.environ.get("BENCH_REPLAY_E2E_STEPS", 16_000)
+            ),
+        },
+        run_e2e=bool(int(os.environ.get("BENCH_REPLAY_E2E", 1))),
+    )
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

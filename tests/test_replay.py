@@ -73,6 +73,38 @@ def test_jit_and_donation():
     assert sample["x"].shape == (4, 3)
 
 
+def test_full_ring_overwrite_never_aliases_sampled_batch():
+    """ISSUE 13 satellite: pin the wraparound semantics the
+    distributed replay tier inherits — inside ONE jitted (donated)
+    program, a batch sampled from a FULL ring must hold the
+    pre-overwrite rows even when the same program then overwrites the
+    oldest rows in place. A gather that aliased the donated storage
+    after the scatter would leak post-overwrite values into the
+    sampled batch."""
+    import functools
+
+    buf = ReplayBuffer(8)
+    state = buf.init({"x": jnp.zeros(())})
+    state = buf.add_batch(state, {"x": jnp.arange(8.0)})  # full ring
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def sample_then_overwrite(state, new):
+        batch = buf.sample(state, jax.random.PRNGKey(3), 16)
+        state = buf.add_batch(state, new)
+        return state, batch
+
+    state, batch = sample_then_overwrite(
+        state, {"x": jnp.arange(100.0, 106.0)}
+    )
+    vals = np.asarray(batch["x"])
+    # Sampled rows are pre-overwrite stream items only.
+    assert ((vals >= 0.0) & (vals <= 7.0)).all(), vals
+    # ...and the overwrite itself landed: oldest 6 rows replaced.
+    assert sorted(np.asarray(state.storage["x"]).tolist()) == [
+        6.0, 7.0, 100.0, 101.0, 102.0, 103.0, 104.0, 105.0,
+    ]
+
+
 def test_sharded_per_device_replay():
     """Each device owns an independent buffer shard under shard_map."""
     from jax.sharding import Mesh, PartitionSpec as P
