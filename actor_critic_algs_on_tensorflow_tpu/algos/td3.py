@@ -68,6 +68,16 @@ class TD3Config:
     per_beta: float = 0.4
     per_eps: float = 1e-6
     replay_codec: bool = True
+    # Replay-ring durability (the distributed tier's server processes):
+    # each shard spills atomic full+incremental ring snapshots every
+    # replay_snapshot_interval_s under replay_snapshot_dir (default ""
+    # = <checkpoint dir>/replay when the learner checkpoints, else
+    # off), so a respawned shard restores its ring instead of
+    # refilling from zero; every replay_snapshot_full_every-th save is
+    # a full cut (the chain full+incs replays bit-exactly).
+    replay_snapshot_dir: str = ""
+    replay_snapshot_interval_s: float = 30.0
+    replay_snapshot_full_every: int = 8
     seed: int = 0
     num_devices: int = 0
 

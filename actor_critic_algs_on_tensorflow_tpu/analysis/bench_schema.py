@@ -40,6 +40,10 @@ MULTICHIP_REQUIRED = ("n_devices", "rc", "ok", "skipped", "tail")
 REPLAY_REQUIRED = (
     "ingest_tps", "sample_p50_ms", "sample_p99_ms",
     "e2e_steps_per_sec", "vs_single_process", "cpu_limited",
+    # PR 14 recovery leg: SIGKILL a snapshotting replay server,
+    # respawn it on the same port, and measure kill -> first
+    # post-restore prioritized sample (seconds).
+    "recovery_gap_s",
 )
 
 
@@ -184,7 +188,8 @@ def check(root: Path, files: Sequence[Path]) -> List[Finding]:
                                   "sample_p50_ms": "num",
                                   "sample_p99_ms": "num",
                                   "e2e_steps_per_sec": "num",
-                                  "vs_single_process": "num"})
+                                  "vs_single_process": "num",
+                                  "recovery_gap_s": "num"})
         else:
             _check_typed(findings, path, "", data,
                          {"n_devices": "int", "rc": "int",

@@ -6,8 +6,10 @@ must agree: the ``ImpalaConfig`` dataclass (what exists), the CLI's
 is documented), and ``utils/metric_names.py`` (what the log stream
 emits). Rules:
 
-  DRIFT001  an ``ImpalaConfig`` field whose default is not coercible
-            by ``utils.config._coerce`` — unreachable via ``--set``
+  DRIFT001  a config field (``ImpalaConfig``, or an off-policy
+            trainer config — DDPG/TD3/SAC) whose default is not
+            coercible by ``utils.config._coerce`` — unreachable via
+            ``--set``
   DRIFT002  a ``transport_*``/``pipeline_*``/``serve_*``/``device_*``/
             ``shard*`` metric key used in source but missing from the
             ``METRIC_NAMES`` registry
@@ -16,7 +18,12 @@ emits). Rules:
   DRIFT004  a registry collision: duplicate declaration, or a metric
             name identical to a config-knob name (one string, two
             meanings, in one log stream)
-  DRIFT005  an ``ImpalaConfig`` field with no README knob-table row
+  DRIFT005  an ``ImpalaConfig`` field with no README knob-table row —
+            and, for the off-policy configs, a ``per_*``/``replay_*``
+            field without one: the distributed replay tier's
+            operational knobs are README-documented by contract
+            (core off-policy training hyperparameters are preset-
+            owned and exempt)
 
 Metric *uses* are collected statically: dict-literal keys, subscript
 keys (read or write), ``.get("...")`` first args, ``TimeSplit``
@@ -58,6 +65,15 @@ _TIMESPLIT_DEFAULT = "pipeline_"
 _SUMMARY_SUFFIXES = ("count", "mean_ms", "p50_ms", "p99_ms", "max_ms")
 
 _CONFIG_REL = "actor_critic_algs_on_tensorflow_tpu/algos/impala.py"
+# Off-policy trainer configs: every field must be --set-coercible
+# (DRIFT001); the distributed replay tier's operational knobs
+# (``per_*``/``replay_*``) additionally need README rows (DRIFT005).
+_OFFPOLICY_CONFIGS = {
+    "actor_critic_algs_on_tensorflow_tpu/algos/ddpg.py": "DDPGConfig",
+    "actor_critic_algs_on_tensorflow_tpu/algos/td3.py": "TD3Config",
+    "actor_critic_algs_on_tensorflow_tpu/algos/sac.py": "SACConfig",
+}
+_OFFPOLICY_DOC_RE = re.compile(r"^(per_|replay_)")
 _REGISTRY_REL = "actor_critic_algs_on_tensorflow_tpu/utils/metric_names.py"
 # Files whose family-prefixed strings are metric uses. Tests are
 # excluded (they assert against literals on purpose); the analysis
@@ -228,15 +244,17 @@ def _matches(a: str, b: str) -> bool:
     return a == b or fnmatch.fnmatch(a, b) or fnmatch.fnmatch(b, a)
 
 
-def config_fields(config_path: Path) -> Dict[str, Tuple[int, ast.AST]]:
-    """``ImpalaConfig`` fields: ``{name: (line, default_node)}``."""
+def config_fields(
+    config_path: Path, class_name: str = "ImpalaConfig"
+) -> Dict[str, Tuple[int, ast.AST]]:
+    """``class_name``'s fields: ``{name: (line, default_node)}``."""
     out: Dict[str, Tuple[int, ast.AST]] = {}
     try:
         tree = parse_file(config_path)
     except (OSError, SyntaxError):
         return out
     for node in ast.walk(tree):
-        if isinstance(node, ast.ClassDef) and node.name == "ImpalaConfig":
+        if isinstance(node, ast.ClassDef) and node.name == class_name:
             for stmt in node.body:
                 if isinstance(stmt, ast.AnnAssign) and isinstance(
                     stmt.target, ast.Name
@@ -303,10 +321,10 @@ def check(root: Path, files: Sequence[Path]) -> List[Finding]:
     )
     readme = root / "README.md"
 
+    rows = readme_knob_rows(readme)
     fields: Dict[str, Tuple[int, ast.AST]] = {}
     if config_path is not None:
         fields = config_fields(config_path)
-        rows = readme_knob_rows(readme)
         for name, (line, default) in sorted(fields.items()):
             if not _coercible(default):
                 findings.append(Finding(
@@ -325,6 +343,36 @@ def check(root: Path, files: Sequence[Path]) -> List[Finding]:
                     hint="add a `| name | default | effect |` row to "
                          "the README config reference",
                 ))
+    for cfg_rel, cls in sorted(_OFFPOLICY_CONFIGS.items()):
+        cfg_file = next(
+            (p for p in files if rel(root, p) == cfg_rel), None
+        )
+        if cfg_file is None:
+            continue
+        op_fields = config_fields(cfg_file, cls)
+        for name, (line, default) in sorted(op_fields.items()):
+            if not _coercible(default):
+                findings.append(Finding(
+                    "DRIFT001", cfg_rel, line,
+                    f"{cls}.{name} has a default that --set cannot "
+                    f"coerce (utils.config._coerce handles "
+                    f"bool/int/float/str/None/tuple literals)",
+                    hint="give the field a coercible default or add "
+                         "a coercion branch to utils.config._coerce",
+                ))
+            if _OFFPOLICY_DOC_RE.match(name) and name not in rows:
+                findings.append(Finding(
+                    "DRIFT005", cfg_rel, line,
+                    f"{cls}.{name} is a distributed replay-tier "
+                    f"knob with no README knob-table row",
+                    hint="add a `| name | default | effect |` row to "
+                         "the README replay-tier section",
+                ))
+            # Replay-tier knobs join the metric/knob collision
+            # surface: their names interleave with replay_* metrics
+            # in one log stream.
+            if _OFFPOLICY_DOC_RE.match(name) and name not in fields:
+                fields[name] = (line, default)
 
     if registry is None:
         return findings

@@ -429,8 +429,34 @@ def build_parser() -> argparse.ArgumentParser:
                         "process count, default 2 (must divide evenly "
                         "across the replay shards; each actor runs "
                         "num_envs envs)")
+    p.add_argument("--replay-ports", default=None, metavar="P0,P1,..",
+                   help="with --replay-servers: pin each replay "
+                        "shard's bind port (default: ephemeral). "
+                        "Fixed ports are the contract an off-policy "
+                        "warm standby's --replay-endpoints list — and "
+                        "a resumed run's surviving actor fleet — "
+                        "relies on")
+    p.add_argument("--actor-param-endpoints", default=None,
+                   metavar="H:P[,H:P...]",
+                   help="with --replay-servers: PRIORITY-ordered "
+                        "param-plane endpoint list the spawned "
+                        "env-stepper actors walk (this learner first, "
+                        "warm standbys after) — name each standby's "
+                        "--learner-bind here so actors that lose the "
+                        "primary land on a standby's early listener "
+                        "on their first retry")
+    p.add_argument("--replay-endpoints", default=None,
+                   metavar="H:P[,H:P...]",
+                   help="off-policy --standby: the EXISTING replay "
+                        "tier's shard endpoints (the primary's "
+                        "--replay-ports). At takeover the standby "
+                        "ATTACHES to these shards instead of spawning "
+                        "its own tier; ring snapshots cover shards "
+                        "that die unsupervised after the primary")
     p.add_argument("--standby", default=None, metavar="HOST:PORT",
-                   help="impala: run as a WARM-STANDBY learner for the "
+                   help="impala or off-policy (ddpg/td3/sac with "
+                        "--replay-endpoints): run as a WARM-STANDBY "
+                        "learner for the "
                         "primary at HOST:PORT — compile up front, tail "
                         "its --checkpoint-dir (restoring each step into "
                         "memory), and on primary death (missed "
@@ -1025,17 +1051,126 @@ def _run_standby(args, cfg, writer, coordinator) -> int:
     return 0
 
 
+def _run_offpolicy_standby(args, fns, cfg, writer) -> int:
+    """Off-policy ``--standby`` mode: warm-standby learner for the
+    Ape-X replay topology (``run_offpolicy_standby``). The standby
+    tails the primary's checkpoints + acting publishes, and at
+    takeover attaches to the EXISTING replay tier named by
+    ``--replay-endpoints`` — fixed shard ports (the primary's
+    ``--replay-ports``) are the contract that makes that list valid
+    across shard respawns."""
+    from actor_critic_algs_on_tensorflow_tpu.algos.offpolicy_distributed import (  # noqa: E501
+        run_offpolicy_standby,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
+        Checkpointer,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.utils.health import (
+        ShutdownSignal,
+    )
+
+    if not args.checkpoint_dir:
+        raise SystemExit(
+            "--standby requires --checkpoint-dir (the primary's "
+            "checkpoint directory — the warm restore source)"
+        )
+    phost, pport = parse_hostport(args.standby, "--standby")
+    host, port = parse_bind(args.learner_bind)
+    endpoints = [
+        parse_hostport(s.strip(), "--replay-endpoints")
+        for s in args.replay_endpoints.split(",")
+        if s.strip()
+    ]
+    if not endpoints:
+        raise SystemExit("--replay-endpoints: empty endpoint list")
+    peers = None
+    if args.standby_peers:
+        peers = [
+            parse_hostport(s.strip(), "--standby-peers")
+            for s in args.standby_peers.split(",")
+            if s.strip()
+        ]
+        if not peers:
+            raise SystemExit("--standby-peers: empty endpoint list")
+        if not 0 <= args.standby_rank < len(peers):
+            raise SystemExit(
+                f"--standby-rank {args.standby_rank} outside the "
+                f"{len(peers)}-entry --standby-peers list"
+            )
+        if port != peers[args.standby_rank][1]:
+            raise SystemExit(
+                f"--learner-bind must pin this standby's own "
+                f"--standby-peers entry (rank {args.standby_rank} = "
+                f"{peers[args.standby_rank][0]}:"
+                f"{peers[args.standby_rank][1]}, got port "
+                f"{port or 'ephemeral'}): the election probes the "
+                f"peers list, so an unmatched bind is an unreachable "
+                f"standby"
+            )
+    elif args.standby_rank:
+        raise SystemExit(
+            "--standby-rank needs --standby-peers (the rank indexes "
+            "that list)"
+        )
+    checkpointer = Checkpointer(args.checkpoint_dir)
+    shutdown = None
+    if args.preempt_save:
+        shutdown = ShutdownSignal().install()
+    try:
+        out = run_offpolicy_standby(
+            fns,
+            checkpointer=checkpointer,
+            primary_host=phost,
+            primary_port=pport,
+            replay_endpoints=endpoints,
+            total_env_steps=cfg.total_env_steps,
+            n_actors=(
+                args.replay_actors if args.replay_actors is not None
+                else 2
+            ),
+            seed=cfg.seed,
+            host=host,
+            port=port,
+            log_interval=args.log_interval,
+            summary_writer=writer,
+            checkpoint_interval=args.checkpoint_interval,
+            stop_event=shutdown.event if shutdown is not None else None,
+            standby_id=args.standby_rank,
+            peers=peers,
+        )
+    finally:
+        if shutdown is not None:
+            shutdown.uninstall()
+        checkpointer.wait()
+        checkpointer.close()
+    if out is None:
+        print("[train] standby: primary finished; no takeover needed")
+        return 0
+    result, history = out
+    final = history[-1][1] if history else {}
+    print(
+        f"[train] standby run ended at env_steps={result.env_steps} "
+        f"updates={result.updates} "
+        f"avg_return={final.get('avg_return', float('nan')):.2f} "
+        f"(took over as primary)"
+    )
+    return 0
+
+
 def _run(args, algo, cfg, writer) -> int:
     if args.render_dir and not args.eval:
         raise SystemExit("--render-dir requires --eval")
     if args.learner_bind and not (
         (algo == "impala" and (args.actor_processes or args.standby))
         or args.replay_servers
+        or (args.standby and algo in ("ddpg", "td3", "sac"))
     ):
         raise SystemExit(
             "--learner-bind requires impala with --actor-processes "
-            "or --standby, or an off-policy run with --replay-servers"
+            "or --standby, or an off-policy run with --replay-servers "
+            "or --standby"
         )
+    offpolicy_standby = args.standby and algo in ("ddpg", "td3", "sac")
     if args.replay_servers:
         if args.replay_actors is None:
             args.replay_actors = 2
@@ -1055,12 +1190,6 @@ def _run(args, algo, cfg, writer) -> int:
                 "--replay-servers runs its own learner loop; drop "
                 "--host-loop async"
             )
-        if args.checkpoint_dir and not args.eval:
-            raise SystemExit(
-                "--replay-servers does not support checkpointing yet "
-                "(the replay rings live in the server processes); "
-                "drop --checkpoint-dir"
-            )
         if args.replay_servers < 1 or args.replay_actors < 1:
             raise SystemExit(
                 "--replay-servers/--replay-actors must be >= 1"
@@ -1072,12 +1201,71 @@ def _run(args, algo, cfg, writer) -> int:
                 f"{args.replay_servers} (ShardPlan's contiguous "
                 f"actor->shard slices)"
             )
-    elif args.replay_actors is not None:
+        if args.resume and not args.checkpoint_dir:
+            raise SystemExit("--resume requires --checkpoint-dir")
+        if args.replay_ports is not None:
+            try:
+                ports = [
+                    int(s) for s in args.replay_ports.split(",") if s.strip()
+                ]
+            except ValueError:
+                raise SystemExit(
+                    f"--replay-ports: bad port list {args.replay_ports!r}"
+                )
+            if len(ports) != args.replay_servers:
+                raise SystemExit(
+                    f"--replay-ports names {len(ports)} port(s) for "
+                    f"--replay-servers {args.replay_servers}"
+                )
+            # Stash the VALIDATED list for the run call below — one
+            # parse, one truth.
+            args.replay_ports = ports
+    elif args.replay_actors is not None and not offpolicy_standby:
+        # The off-policy standby consumes --replay-actors (the fleet
+        # it validates against at takeover); everyone else needs the
+        # tier.
         raise SystemExit("--replay-actors requires --replay-servers")
-    if (args.standby or args.coordinate_preemption) and algo != "impala":
+    elif args.replay_ports is not None:
+        raise SystemExit("--replay-ports requires --replay-servers")
+    elif args.actor_param_endpoints is not None:
         raise SystemExit(
-            "--standby / --coordinate-preemption are impala-only "
+            "--actor-param-endpoints requires --replay-servers (it "
+            "configures the spawned env-stepper fleet)"
+        )
+    if args.standby and not (algo == "impala" or offpolicy_standby):
+        raise SystemExit(
+            "--standby supports impala and the off-policy trainers "
+            "(ddpg/td3/sac, with --replay-endpoints)"
+        )
+    if args.coordinate_preemption and algo != "impala":
+        raise SystemExit(
+            "--coordinate-preemption is impala-only "
             "(the actor-learner control plane)"
+        )
+    if offpolicy_standby and not args.replay_endpoints:
+        raise SystemExit(
+            "an off-policy --standby needs --replay-endpoints (the "
+            "existing replay tier it attaches to at takeover; pin the "
+            "primary's shard ports with --replay-ports)"
+        )
+    if offpolicy_standby and args.replay_servers:
+        raise SystemExit(
+            "--standby attaches to the primary's replay tier; drop "
+            "--replay-servers (shard count = the --replay-endpoints "
+            "list)"
+        )
+    if args.replay_endpoints and not offpolicy_standby:
+        raise SystemExit(
+            "--replay-endpoints requires an off-policy --standby "
+            "(ddpg/td3/sac)"
+        )
+    if offpolicy_standby and args.redirector is not None:
+        raise SystemExit(
+            "--redirector is the IMPALA standby's actor-facing tier; "
+            "off-policy env-stepper actors fail over via their "
+            "param-plane priority endpoint lists (the primary's "
+            "actor_param_endpoints naming each standby's "
+            "--learner-bind) — drop --redirector"
         )
     if args.redirector is not None and not args.standby:
         raise SystemExit("--redirector requires --standby")
@@ -1281,11 +1469,21 @@ def _run(args, algo, cfg, writer) -> int:
 
         fns = make_sac(cfg)
 
+    if args.standby and algo in ("ddpg", "td3", "sac"):
+        return _run_offpolicy_standby(args, fns, cfg, writer)
+
     if args.replay_servers:
         from actor_critic_algs_on_tensorflow_tpu.algos.offpolicy_distributed import (  # noqa: E501
             run_offpolicy_distributed,
         )
 
+        checkpointer = None
+        if args.checkpoint_dir:
+            from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (  # noqa: E501
+                Checkpointer,
+            )
+
+            checkpointer = Checkpointer(args.checkpoint_dir)
         shutdown = None
         if args.preempt_save:
             from actor_critic_algs_on_tensorflow_tpu.utils.health import (
@@ -1308,16 +1506,40 @@ def _run(args, algo, cfg, writer) -> int:
                 stop_event=(
                     shutdown.event if shutdown is not None else None
                 ),
+                checkpointer=checkpointer,
+                checkpoint_interval=args.checkpoint_interval,
+                resume=args.resume,
+                replay_ports_fixed=args.replay_ports,
+                actor_param_endpoints=(
+                    [
+                        parse_hostport(
+                            s.strip(), "--actor-param-endpoints"
+                        )
+                        for s in args.actor_param_endpoints.split(",")
+                        if s.strip()
+                    ]
+                    if args.actor_param_endpoints else None
+                ),
             )
         finally:
             if shutdown is not None:
                 shutdown.uninstall()
+            if checkpointer is not None:
+                checkpointer.wait()
+                checkpointer.close()
         final = history[-1][1] if history else {}
-        print(
-            f"[train] done: env_steps={result.env_steps} "
-            f"updates={result.updates} "
-            f"avg_return={final.get('avg_return', float('nan')):.2f}"
-        )
+        if shutdown is not None and shutdown.event.is_set():
+            print(
+                f"[train] preempted: clean shutdown at env_steps="
+                f"{result.env_steps} (learner checkpoint + final "
+                f"replay-ring snapshots flushed; resume with --resume)"
+            )
+        else:
+            print(
+                f"[train] done: env_steps={result.env_steps} "
+                f"updates={result.updates} "
+                f"avg_return={final.get('avg_return', float('nan')):.2f}"
+            )
         return 0
 
     use_async = False

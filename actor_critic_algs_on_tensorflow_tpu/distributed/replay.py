@@ -43,6 +43,8 @@ Priority discipline (bit-auditable; pinned by unit test):
 from __future__ import annotations
 
 import dataclasses
+import os
+import re
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -50,6 +52,9 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from actor_critic_algs_on_tensorflow_tpu.distributed import codec
+from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+    EPOCH_SHIFT,
+)
 from actor_critic_algs_on_tensorflow_tpu.utils.metric_names import REPLAY
 
 __all__ = [
@@ -57,6 +62,7 @@ __all__ = [
     "PrioritizedReplayShard",
     "ReplayShardService",
     "ReplayClientGroup",
+    "ReplaySnapshotter",
     "SampledBatch",
     "replay_server_main",
 ]
@@ -203,6 +209,30 @@ class PrioritizedReplayShard:
         self.prio_applied = 0
         self.prio_stale = 0
         self.rejected_layout = 0
+        # -- durability / failover state --------------------------------
+        # While ``restoring`` (a respawned server loading its ring
+        # snapshot), ingest is dropped-and-counted and sampling answers
+        # "refilling" — a half-applied ring must never serve or accept.
+        # ``restore_frac`` is the load progress the sample-reply meta
+        # exports so the learner's stall guard can tell "restoring
+        # (ring N% loaded)" from "dead". ``ring_restored`` marks a
+        # shard whose ``inserted`` meter CONTINUED from a snapshot
+        # (the client group's meter reconciliation keys on it).
+        self.restoring = False
+        self.restore_frac = 1.0
+        self.ring_restored = False
+        self.restored_rows = 0
+        self.dropped_restoring = 0
+        self.snapshots_taken = 0
+        self.last_snapshot_t: Optional[float] = None
+        # Fencing epoch (quorum control plane): the highest reign any
+        # sample/priority peer ever announced. Priority updates tagged
+        # with an OLDER reign are a deposed learner's late frames —
+        # dropped and counted, never applied (see
+        # ``ReplayShardService.handle``). Snapshot-persisted so a
+        # restored shard keeps fencing its old deposed learner.
+        self.fence_epoch = 0
+        self.prio_fenced = 0
 
     # -- ingest --------------------------------------------------------
 
@@ -243,6 +273,13 @@ class PrioritizedReplayShard:
         if not leaves or leaves[0].ndim < 1:
             raise LayoutError("transition frame carries no row axis")
         with self._lock:
+            if self.restoring:
+                # A half-applied ring must not interleave fresh rows
+                # with the snapshot being loaded; the frame is dropped
+                # (the server still ACKs) and counted. The window is
+                # the snapshot load time — seconds, bounded.
+                self.dropped_restoring += 1
+                return 0
             if self._storage is None:
                 self._pin_layout(leaves)
             reason = self._check_layout(leaves)
@@ -298,6 +335,8 @@ class PrioritizedReplayShard:
         if batch_size <= 0:
             raise ValueError(f"batch_size must be positive, {batch_size}")
         with self._lock:
+            if self.restoring:
+                return None  # loading the ring snapshot: refill-like
             if self._storage is None or self.size < batch_size:
                 return None
             total = self._tree.total()
@@ -372,8 +411,177 @@ class PrioritizedReplayShard:
         with self._lock:
             return self._tree.get(indices)
 
+    # -- durability (snapshot / restore / fencing) ---------------------
+
+    def raise_fence(self, epoch: int) -> int:
+        """Adopt a (monotonically larger) fencing epoch; returns the
+        epoch in force. Epochs never regress — a deposed learner
+        re-announcing its old reign cannot lower the fence."""
+        with self._lock:
+            if int(epoch) > self.fence_epoch:
+                self.fence_epoch = int(epoch)
+            return self.fence_epoch
+
+    def note_fenced(self, n: int = 1) -> None:
+        with self._lock:
+            self.prio_fenced += int(n)
+
+    def begin_restore(self) -> None:
+        with self._lock:
+            self.restoring = True
+            self.restore_frac = 0.0
+
+    def set_restore_progress(self, frac: float) -> None:
+        with self._lock:
+            self.restore_frac = min(1.0, max(0.0, float(frac)))
+
+    def end_restore(self) -> None:
+        with self._lock:
+            self.restoring = False
+            self.restore_frac = 1.0
+
+    def durability_meta(self) -> Tuple[float, float, float]:
+        """(restore_frac, snapshot_age_s, ring_restored) for the
+        sample-reply meta — the learner's view of this shard's
+        durability state (age −1.0 = never snapshotted)."""
+        with self._lock:
+            age = (
+                time.monotonic() - self.last_snapshot_t
+                if self.last_snapshot_t is not None
+                else -1.0
+            )
+            return (
+                float(self.restore_frac),
+                float(age),
+                1.0 if self.ring_restored else 0.0,
+            )
+
+    def snapshot_cut(
+        self, since_id: Optional[int] = None
+    ) -> Optional[Dict[str, np.ndarray]]:
+        """One CONSISTENT copy of the shard's durable state, taken
+        under the lock (the caller writes it to disk off the serve
+        threads). ``since_id=None`` cuts the FULL ring; otherwise only
+        rows whose stream ids are >= ``since_id`` (the incremental
+        delta since the previous cut's ``next_id`` watermark) ride,
+        while the small per-row vectors (ids, priorities) and the
+        scalar meters always ship whole — so applying full + deltas in
+        order reproduces the ring, tree, rng and meters bit-exactly.
+        ``None`` when nothing was ever ingested."""
+        with self._lock:
+            if self._storage is None:
+                return None
+            _, rng_keys, rng_pos, rng_has_g, rng_gauss = (
+                self._rng.get_state()
+            )
+            state: Dict[str, np.ndarray] = {
+                "meta_i": np.asarray(
+                    [
+                        self.capacity,
+                        len(self._storage),
+                        self._insert_pos,
+                        self.size,
+                        self._next_id,
+                        self.inserted,
+                        self.overwritten,
+                        self.fence_epoch,
+                        self.ep.count,
+                        -1 if since_id is None else int(since_id),
+                    ],
+                    np.int64,
+                ),
+                "meta_f": np.asarray(
+                    [self._max_pri, self.ep.return_sum], np.float64
+                ),
+                "row_ids": self._row_ids.copy(),
+                "pri": self._tree.get(np.arange(self.capacity)),
+                "rng_keys": np.asarray(rng_keys, np.uint32),
+                "rng_meta": np.asarray([rng_pos, rng_has_g], np.int64),
+                "rng_gauss": np.asarray([rng_gauss], np.float64),
+            }
+            if since_id is None:
+                rows = None
+            else:
+                rows = np.nonzero(self._row_ids >= int(since_id))[0]
+                state["positions"] = rows.astype(np.int64)
+            for i, buf in enumerate(self._storage):
+                state[f"leaf{i:02d}"] = (
+                    buf.copy() if rows is None else buf[rows].copy()
+                )
+            return state
+
+    def apply_snapshot(self, states: Sequence[Dict[str, np.ndarray]]) -> int:
+        """Install a snapshot chain (one FULL cut, then its deltas in
+        order) wholesale: storage, ids, priorities, rng and meters all
+        come from the chain, so a restored shard samples bit-
+        identically to the pre-kill shard at the snapshot point.
+        Returns resident rows. Whatever the ring held before (e.g. a
+        few frames that raced in pre-restore) is overwritten — those
+        transitions were counted by the meters when first ingested."""
+        if not states:
+            raise ValueError("empty snapshot chain")
+        full, incs = states[0], states[1:]
+        meta_i = np.asarray(full["meta_i"], np.int64).reshape(-1)
+        if int(meta_i[0]) != self.capacity:
+            raise ValueError(
+                f"snapshot capacity {int(meta_i[0])} != shard capacity "
+                f"{self.capacity} (restore into a same-shape shard)"
+            )
+        if int(meta_i[9]) != -1:
+            raise ValueError("snapshot chain does not start with a full cut")
+        n_leaves = int(meta_i[1])
+        storage = [
+            np.asarray(full[f"leaf{i:02d}"]).copy() for i in range(n_leaves)
+        ]
+        for inc in incs:
+            if int(np.asarray(inc["meta_i"], np.int64)[1]) != n_leaves:
+                raise ValueError("incremental cut leaf count mismatch")
+            pos = np.asarray(inc["positions"], np.int64).reshape(-1)
+            for i in range(n_leaves):
+                storage[i][pos] = np.asarray(inc[f"leaf{i:02d}"])
+        last = states[-1]
+        meta_i = np.asarray(last["meta_i"], np.int64).reshape(-1)
+        meta_f = np.asarray(last["meta_f"], np.float64).reshape(-1)
+        with self._lock:
+            self._storage = storage
+            self._leaf_specs = [
+                (tuple(a.shape[1:]), a.dtype) for a in storage
+            ]
+            self._row_ids = np.asarray(last["row_ids"], np.int64).copy()
+            self._tree = SumTree(self.capacity)
+            self._tree.update(
+                np.arange(self.capacity),
+                np.asarray(last["pri"], np.float64),
+            )
+            self._insert_pos = int(meta_i[2])
+            self.size = int(meta_i[3])
+            self._next_id = int(meta_i[4])
+            self.inserted = int(meta_i[5])
+            self.overwritten = int(meta_i[6])
+            self.fence_epoch = max(self.fence_epoch, int(meta_i[7]))
+            self.ep = _EpStats(
+                return_sum=float(meta_f[1]), count=int(meta_i[8])
+            )
+            self._max_pri = float(meta_f[0])
+            rng_meta = np.asarray(last["rng_meta"], np.int64).reshape(-1)
+            self._rng.set_state((
+                "MT19937",
+                np.asarray(last["rng_keys"], np.uint32),
+                int(rng_meta[0]),
+                int(rng_meta[1]),
+                float(np.asarray(last["rng_gauss"], np.float64)[0]),
+            ))
+            self.ring_restored = True
+            self.restored_rows = self.size
+            return self.size
+
     def metrics(self) -> Dict[str, float]:
         with self._lock:
+            age = (
+                time.monotonic() - self.last_snapshot_t
+                if self.last_snapshot_t is not None
+                else -1.0
+            )
             return {
                 REPLAY + "size": self.size,
                 REPLAY + "inserted": self.inserted,
@@ -382,7 +590,206 @@ class PrioritizedReplayShard:
                 REPLAY + "prio_applied": self.prio_applied,
                 REPLAY + "prio_stale": self.prio_stale,
                 REPLAY + "layout_rejects": self.rejected_layout,
+                REPLAY + "snapshots": self.snapshots_taken,
+                REPLAY + "snapshot_age_s": round(age, 3),
+                REPLAY + "restore_frac": self.restore_frac,
+                REPLAY + "restored_rows": self.restored_rows,
+                REPLAY + "drop_restoring": self.dropped_restoring,
+                REPLAY + "prio_fenced": self.prio_fenced,
             }
+
+
+_SNAP_RE = re.compile(r"^snap-(\d{8})-(full|inc)\.npz$")
+
+
+class ReplaySnapshotter:
+    """Atomic on-disk ring snapshots for one ``PrioritizedReplayShard``.
+
+    The replay ring is the only training state that lives nowhere but
+    a server process's memory; this spills it with the same
+    atomic-write discipline as ``utils.checkpoint.Checkpointer``
+    (write to a temp name, ``os.replace`` to finalize — a kill
+    mid-write leaves a ``.tmp-`` dropping, never a corrupt snapshot).
+
+    Layout under ``directory``: ``snap-<seq>-full.npz`` (the whole
+    ring) and ``snap-<seq>-inc.npz`` (rows newer than the previous
+    snapshot's stream-id watermark, plus the full small vectors —
+    ids, priorities, rng, meters). Every ``full_every``-th save is
+    full; the chain ``full + incs`` replays to the exact pre-kill
+    state (``PrioritizedReplayShard.apply_snapshot``). Retention: a
+    new full snapshot prunes everything OLDER than the previous full,
+    so the previous chain stays as the crash-safe fallback when the
+    newest full itself is the partial write.
+
+    Restore walks fulls newest-first; a corrupt incremental truncates
+    its chain there (the prefix is still a consistent, just older,
+    state), a corrupt full falls back to the previous chain — the
+    ``Checkpointer.restore`` fallback discipline, file-local."""
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        full_every: int = 8,
+        log: Callable[[str], None] | None = None,
+    ):
+        self.directory = os.path.abspath(os.fspath(directory))
+        os.makedirs(self.directory, exist_ok=True)
+        self._full_every = max(1, int(full_every))
+        self._log = log if log is not None else (
+            lambda msg: print(f"[replay-snapshot] {msg}", flush=True)
+        )
+        files = self._files()
+        self._seq = files[-1][0] if files else 0
+        # Stream-id watermark of the last save/restore: None forces the
+        # next save to be FULL (a respawned snapshotter cannot know
+        # what the on-disk chain covers relative to a live ring).
+        self._watermark: Optional[int] = None
+        self._saves_since_full = 0
+
+    def _files(self) -> List[Tuple[int, str, str]]:
+        """Sorted ``(seq, kind, path)`` of finalized snapshots."""
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        for name in names:
+            m = _SNAP_RE.match(name)
+            if m:
+                out.append((
+                    int(m.group(1)), m.group(2),
+                    os.path.join(self.directory, name),
+                ))
+        return sorted(out)
+
+    def available(self) -> bool:
+        return any(kind == "full" for _, kind, _ in self._files())
+
+    def save(self, shard: "PrioritizedReplayShard") -> int:
+        """Write one snapshot (full or incremental per the cadence);
+        returns the sequence id, or -1 when the ring is still empty.
+        The cut is taken under the shard lock; the (slow) disk write
+        happens after release, off the serve threads."""
+        full = (
+            self._watermark is None
+            or self._saves_since_full >= self._full_every - 1
+        )
+        cut = shard.snapshot_cut(None if full else self._watermark)
+        if cut is None:
+            return -1
+        self._seq += 1
+        seq = self._seq
+        kind = "full" if full else "inc"
+        path = os.path.join(self.directory, f"snap-{seq:08d}-{kind}.npz")
+        tmp = os.path.join(self.directory, f".tmp-snap-{seq:08d}.npz")
+        with open(tmp, "wb") as f:
+            np.savez(f, **cut)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        self._watermark = int(np.asarray(cut["meta_i"], np.int64)[4])
+        self._saves_since_full = 0 if full else self._saves_since_full + 1
+        with shard._lock:
+            shard.snapshots_taken += 1
+            shard.last_snapshot_t = time.monotonic()
+        if full:
+            self._prune(seq)
+        return seq
+
+    def _prune(self, new_full_seq: int) -> None:
+        """Keep the new full's chain plus the previous full's chain;
+        drop everything older (and any stale temp droppings)."""
+        fulls = [
+            s for s, kind, _ in self._files()
+            if kind == "full" and s < new_full_seq
+        ]
+        keep_from = fulls[-1] if fulls else new_full_seq
+        for s, _, path in self._files():
+            if s < keep_from:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+        try:
+            for name in os.listdir(self.directory):
+                if name.startswith(".tmp-"):
+                    os.remove(os.path.join(self.directory, name))
+        except OSError:
+            pass
+
+    def restore(self, shard: "PrioritizedReplayShard") -> int:
+        """Load the newest restorable chain into ``shard``; returns
+        rows restored (0 = nothing usable on disk). Progress is
+        surfaced through ``shard.set_restore_progress`` so the
+        sample-reply meta can report "ring N% loaded" while files
+        stream in."""
+        files = self._files()
+        fulls = [f for f in files if f[1] == "full"]
+        for base_seq, _, base_path in reversed(fulls):
+            chain_paths = [(base_seq, base_path)]
+            for s, kind, path in files:
+                if s > base_seq and kind == "inc":
+                    chain_paths.append((s, path))
+                elif s > base_seq and kind == "full":
+                    break  # a newer full owns the incs after it
+            total = sum(
+                max(1, os.path.getsize(p)) for _, p in chain_paths
+            )
+            states, done = [], 0
+            for i, (s, path) in enumerate(chain_paths):
+                size = max(1, os.path.getsize(path))
+                try:
+                    with np.load(path, allow_pickle=False) as z:
+                        # Per-member progress: one full cut usually
+                        # dominates the chain, and a multi-GB load
+                        # that reported nothing until the whole file
+                        # landed would sit at 0.0 across the
+                        # learner's stall windows — read as "dead",
+                        # not "loading". npz members decompress on
+                        # access, so each storage leaf advances the
+                        # fraction.
+                        keys = list(z.files)
+                        state = {}
+                        for j, key in enumerate(keys):
+                            state[key] = z[key]
+                            shard.set_restore_progress(
+                                (done + size * (j + 1) / len(keys))
+                                / total
+                            )
+                        states.append(state)
+                except Exception as e:
+                    if i == 0:
+                        self._log(
+                            f"full snapshot seq {s} unreadable "
+                            f"({type(e).__name__}: {e}); trying the "
+                            f"previous chain"
+                        )
+                        states = None
+                        break
+                    self._log(
+                        f"incremental snapshot seq {s} unreadable "
+                        f"({type(e).__name__}: {e}); truncating the "
+                        f"chain there (restoring the older prefix)"
+                    )
+                    break
+                done += size
+                shard.set_restore_progress(done / total)
+            if not states:
+                continue
+            try:
+                rows = shard.apply_snapshot(states)
+            except (KeyError, ValueError, IndexError) as e:
+                self._log(
+                    f"snapshot chain at full seq {base_seq} failed to "
+                    f"apply ({type(e).__name__}: {e}); trying the "
+                    f"previous chain"
+                )
+                continue
+            self._watermark = shard._next_id
+            self._seq = max(self._seq, chain_paths[-1][0])
+            return rows
+        return 0
 
 
 class _TransitionView:
@@ -437,6 +844,13 @@ class ReplayShardService:
 
     def ingest(self, traj, ep_leaves, peer) -> bool:
         actor_id = getattr(peer, "actor_id", -1)
+        if self.shard.restoring:
+            # Loading the ring snapshot: fresh rows must not interleave
+            # with the wholesale apply. Dropped (still ACKed) and
+            # counted; the window is the snapshot load, seconds.
+            with self.shard._lock:
+                self.shard.dropped_restoring += 1
+            return False
         if isinstance(traj, codec.CodedTrajectory):
             if self.validator is not None and (
                 self.validator.drop_quarantined(actor_id)
@@ -475,7 +889,20 @@ class ReplayShardService:
             transport,
         )
 
+        # Fencing (quorum control plane): every sample/priority frame's
+        # tag carries its sender's reign in the high bits
+        # (transport.EPOCH_SHIFT), and the sender's hello announced one
+        # too. The highest reign ever seen is the fence; a PRIORITY
+        # update tagged with an older reign is a deposed learner's
+        # late frame — dropped and counted, never applied. Sample
+        # draws are not fenced (a stale draw wastes only bandwidth;
+        # its priorities will be fenced anyway). Legacy peers tag and
+        # announce 0, so a fleet that never elects never fences.
+        peer_epoch = getattr(peer, "epoch", 0)
         if kind == transport.KIND_SAMPLE_REQ:
+            self.shard.raise_fence(
+                max(peer_epoch, transport.epoch_of(tag))
+            )
             malformed = False
             try:
                 batch_size = int(np.asarray(arrays[0]).reshape(-1)[0])
@@ -498,12 +925,22 @@ class ReplayShardService:
                 else None
             )
             ret_sum, ep_count = self.shard.drain_episode_stats()
+            restore_frac, snap_age, restored = (
+                self.shard.durability_meta()
+            )
             meta = np.asarray(
                 [
                     float(self.shard.size),
                     float(self.shard.inserted),
                     ret_sum,
                     float(ep_count),
+                    # Durability view (meta[4:7], absent on legacy
+                    # shards): load progress while a respawn restores
+                    # its ring, snapshot age (-1 = never), and whether
+                    # this process's meter CONTINUED from a snapshot.
+                    restore_frac,
+                    snap_age,
+                    restored,
                 ],
                 np.float64,
             )
@@ -517,6 +954,13 @@ class ReplayShardService:
                 self._log(
                     f"malformed priority update ({len(arrays)} arrays)"
                 )
+                return
+            sender_epoch = transport.epoch_of(tag)
+            fence = self.shard.raise_fence(
+                max(peer_epoch, sender_epoch)
+            )
+            if sender_epoch < fence:
+                self.shard.note_fenced()
                 return
             try:
                 self.shard.update_priorities(
@@ -546,6 +990,9 @@ def replay_server_main(
     idle_timeout_s: float | None = None,
     max_frame_bytes: int = 1 << 30,
     report_interval_s: float = 30.0,
+    snapshot_dir: str | None = None,
+    snapshot_interval_s: float = 30.0,
+    snapshot_full_every: int = 8,
 ) -> None:
     """Entry point of one spawned replay-server PROCESS.
 
@@ -555,15 +1002,36 @@ def replay_server_main(
     replay handler serves the sample/priority plane. Reports the bound
     port back through ``port_conn`` (a multiprocessing pipe end) so
     the parent can wire endpoints race-free, then serves until
-    terminated (the runner owns process lifetime — a replay server has
-    no work of its own to finish)."""
+    drained or terminated.
+
+    Durability (``snapshot_dir`` set): the ring is restored from the
+    newest on-disk snapshot chain at boot — a respawned shard resumes
+    its rows, priorities, rng and ``inserted`` meter instead of
+    refilling from zero (draws during the load answer meta-only with
+    the load fraction, so the learner reports "restoring", not
+    "dead") — and re-snapshotted every ``snapshot_interval_s`` off
+    the serve threads. Clean drain: SIGTERM, or an orderly
+    ``KIND_CLOSE`` goodbye from a ``ROLE_LEARNER`` peer (the
+    coordinated ``--preempt-save`` teardown), flushes one final
+    snapshot before exit so the shutdown is resumable end-to-end —
+    only a SIGKILL costs the since-last-snapshot tail."""
     import os
+    import signal as signal_lib
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+        ROLE_LEARNER,
         LearnerServer,
     )
 
+    log = lambda msg: print(f"[replay-server {shard_id}] {msg}", flush=True)
+    drain = threading.Event()
+    try:
+        signal_lib.signal(
+            signal_lib.SIGTERM, lambda signum, frame: drain.set()
+        )
+    except (ValueError, OSError):
+        pass  # not this process's main thread (in-process test drive)
     validator = None
     if validate:
         from actor_critic_algs_on_tensorflow_tpu.utils.health import (
@@ -571,21 +1039,22 @@ def replay_server_main(
         )
 
         validator = TrajectoryValidator(
-            quarantine_threshold=quarantine_threshold,
-            log=lambda msg: print(
-                f"[replay-server {shard_id}] {msg}", flush=True
-            ),
+            quarantine_threshold=quarantine_threshold, log=log
         )
     shard = PrioritizedReplayShard(
         capacity, alpha=alpha, eps=eps, seed=seed
     )
-    service = ReplayShardService(
-        shard,
-        validator=validator,
-        log=lambda msg: print(
-            f"[replay-server {shard_id}] {msg}", flush=True
-        ),
-    )
+    snapshotter = None
+    if snapshot_dir:
+        snapshotter = ReplaySnapshotter(
+            snapshot_dir, full_every=snapshot_full_every, log=log
+        )
+        if snapshotter.available():
+            # Gate ingest/sampling BEFORE the listener binds: frames
+            # that race the load are dropped-and-counted, and draws
+            # answer meta-only with the load fraction.
+            shard.begin_restore()
+    service = ReplayShardService(shard, validator=validator, log=log)
     server = LearnerServer(
         service.ingest,
         host=host,
@@ -595,36 +1064,99 @@ def replay_server_main(
         # The replay tier publishes no params; the delta ring would
         # only hold memory.
         param_delta=False,
-        log=lambda msg: print(
-            f"[replay-server {shard_id}] {msg}", flush=True
-        ),
+        log=log,
     )
     server.set_replay_handler(service.handle)
+
+    def _on_goodbye(peer):
+        # Drain only on the CURRENT reign's learner goodbye: a
+        # deposed-but-alive learner (it stalled past the takeover
+        # deadline, a standby took over, and it tears down later)
+        # announces its OLD epoch — its KIND_CLOSE must not shut the
+        # tier down under the new primary, whose first draw raised
+        # the fence past it. Residual window: a goodbye landing
+        # before the new reign ever touched this shard still drains,
+        # and the flushed final snapshot makes even that recoverable.
+        if peer.role == ROLE_LEARNER and peer.epoch >= shard.fence_epoch:
+            drain.set()
+        elif peer.role == ROLE_LEARNER:
+            log(
+                f"ignored goodbye from deposed learner (epoch "
+                f"{peer.epoch} < fence {shard.fence_epoch})"
+            )
+
+    server.set_goodbye_handler(_on_goodbye)
     if port_conn is not None:
         port_conn.send(server.port)
         port_conn.close()
     print(
         f"[replay-server {shard_id}] serving on {host}:{server.port} "
-        f"(capacity {capacity}, alpha {alpha})",
+        f"(capacity {capacity}, alpha {alpha}"
+        + (f", snapshots -> {snapshot_dir}" if snapshot_dir else "")
+        + ")",
         flush=True,
     )
+    if shard.restoring:
+        try:
+            rows = snapshotter.restore(shard)
+            if rows:
+                log(
+                    f"ring restored: {rows} rows, meter continues at "
+                    f"{shard.inserted} (fence epoch "
+                    f"{shard.fence_epoch})"
+                )
+            else:
+                log("no restorable snapshot chain; starting empty")
+        except Exception as e:
+            log(
+                f"ring restore failed ({type(e).__name__}: {e}); "
+                f"starting empty"
+            )
+        finally:
+            shard.end_restore()
     try:
-        last_report = time.monotonic()
-        while True:
-            time.sleep(0.5)
+        last_report = last_snap = time.monotonic()
+        while not drain.is_set():
+            drain.wait(0.5)
+            now = time.monotonic()
+            if (
+                snapshotter is not None
+                and snapshot_interval_s
+                and now - last_snap >= snapshot_interval_s
+            ):
+                last_snap = now
+                try:
+                    snapshotter.save(shard)
+                except OSError as e:
+                    log(
+                        f"snapshot failed ({type(e).__name__}: {e}); "
+                        f"will retry next interval"
+                    )
             if (
                 report_interval_s
-                and time.monotonic() - last_report >= report_interval_s
+                and now - last_report >= report_interval_s
             ):
-                last_report = time.monotonic()
-                print(
-                    f"[replay-server {shard_id}] {service.metrics()}",
-                    flush=True,
-                )
+                last_report = now
+                log(f"{service.metrics()}")
     except KeyboardInterrupt:
         pass
     finally:
+        if snapshotter is not None:
+            # The clean-drain contract: SIGTERM / learner goodbye /
+            # Ctrl-C all flush a final cut so the shutdown is
+            # resumable; only SIGKILL loses the tail.
+            try:
+                seq = snapshotter.save(shard)
+                if seq >= 0:
+                    log(
+                        f"final snapshot seq {seq} "
+                        f"({shard.size} rows, meter {shard.inserted})"
+                    )
+            except OSError as e:
+                log(f"final snapshot failed ({type(e).__name__}: {e})")
         server.close()
+        if drain.is_set():
+            log("drained (clean shutdown)")
 
 
 class SampledBatch:
@@ -659,6 +1191,7 @@ class ReplayClientGroup:
         endpoints: Sequence[Tuple[str, int]],
         *,
         client_id: int = 0,
+        epoch: int = 0,
         retry_s: float = 2.0,
         heartbeat_interval_s: float | None = 10.0,
         idle_timeout_s: float | None = 60.0,
@@ -672,11 +1205,16 @@ class ReplayClientGroup:
         )
         from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (  # noqa: E501
             CAP_REPLAY,
-            ROLE_ACTOR,
+            ROLE_LEARNER,
         )
 
         if not endpoints:
             raise ValueError("replay client group needs >= 1 endpoint")
+        # The learner's fencing reign: announced in the hello and
+        # stamped into every sample/priority tag's high bits, so a
+        # shard can drop a DEPOSED learner's late priority updates
+        # after a standby takeover bumps the epoch.
+        self.epoch = int(epoch)
         if make_client is None:
             def make_client(host, port):
                 return ResilientActorClient(
@@ -687,7 +1225,14 @@ class ReplayClientGroup:
                     idle_timeout_s=idle_timeout_s,
                     connect_timeout=connect_timeout,
                     max_frame_bytes=max_frame_bytes,
-                    hello=(client_id, 0, ROLE_ACTOR, CAP_REPLAY),
+                    # ROLE_LEARNER: a replay server treats THIS peer's
+                    # orderly goodbye as "the run is over — flush a
+                    # final ring snapshot and drain" (actors' goodbyes
+                    # mean nothing tier-wide).
+                    hello=(
+                        client_id, 0, ROLE_LEARNER, CAP_REPLAY,
+                        self.epoch,
+                    ),
                 )
 
         # Clients are constructed LAZILY, per shard, on first use: a
@@ -713,6 +1258,13 @@ class ReplayClientGroup:
         self.shard_rows = [0.0] * len(self._clients)
         self.shard_inserted_last = [0.0] * len(self._clients)
         self._shard_inserted_cum = [0.0] * len(self._clients)
+        # Per-shard durability view from the extended sample-reply
+        # meta: snapshot-restore progress (1.0 = fully serving),
+        # snapshot age (-1 = never), and whether the shard's meter
+        # continued from a restored ring (reconciliation keys on it).
+        self.shard_restore_frac = [1.0] * len(self._clients)
+        self.shard_snapshot_age = [-1.0] * len(self._clients)
+        self._shard_ring_restored = [False] * len(self._clients)
         self._ep_return_sum = 0.0
         self._ep_count = 0
 
@@ -730,17 +1282,40 @@ class ReplayClientGroup:
         meta = np.asarray(arrays[0], np.float64).reshape(-1)
         if meta.size >= 4:
             self.shard_rows[shard_idx] = float(meta[0])
+            restored = self._shard_ring_restored[shard_idx]
+            if meta.size >= 7:
+                self.shard_restore_frac[shard_idx] = float(meta[4])
+                self.shard_snapshot_age[shard_idx] = float(meta[5])
+                restored = meta[6] > 0.5
+                self._shard_ring_restored[shard_idx] = restored
             v = float(meta[1])
             last = self.shard_inserted_last[shard_idx]
-            # v < last means the shard restarted and its meter reset:
-            # keep the predecessor's contribution and count the new
-            # meter from zero.
-            self._shard_inserted_cum[shard_idx] += (
-                v if v < last else v - last
-            )
-            self.shard_inserted_last[shard_idx] = v
-            self._ep_return_sum += float(meta[2])
-            self._ep_count += int(meta[3])
+            if meta.size >= 7 and meta[4] < 1.0:
+                # MID-RESTORE reply: the meter is the half-applied
+                # ring's (zero until the chain lands). Reconciliation
+                # must not see it — zeroing ``last`` here would make
+                # the first post-restore reply re-add the restored
+                # meter on top of the predecessor's contribution,
+                # double-counting the whole pre-kill ingest.
+                pass
+            else:
+                if v >= last:
+                    self._shard_inserted_cum[shard_idx] += v - last
+                elif not restored:
+                    # Cold respawn (no ring snapshot): the meter
+                    # restarted at zero. Keep the dead predecessor's
+                    # contribution and count the new meter from
+                    # scratch.
+                    self._shard_inserted_cum[shard_idx] += v
+                # else: the respawn RESTORED its ring, so the meter
+                # CONTINUED from the snapshot — v is the pre-kill
+                # meter minus the unsnapshotted tail, which was
+                # already counted when first seen. Adding anything
+                # here would double-count; regrowth past ``last``
+                # resumes counting new steps above.
+                self.shard_inserted_last[shard_idx] = v
+                self._ep_return_sum += float(meta[2])
+                self._ep_count += int(meta[3])
         if len(arrays) == 1:
             return None  # shard refilling
         if len(arrays) < 6:
@@ -770,10 +1345,14 @@ class ReplayClientGroup:
         n = len(self._clients)
         for k in range(n):
             shard_idx = (self._rr + k) % n
-            self._seq = (self._seq + 1) & ((1 << 48) - 1)
+            self._seq = (self._seq + 1) & ((1 << EPOCH_SHIFT) - 1)
+            # The tag's high bits carry this learner's fencing reign
+            # (the server echoes the tag verbatim, so the seq match
+            # still holds); the low 48 bits stay the per-draw seq.
+            wire_seq = (self.epoch << EPOCH_SHIFT) | self._seq
             try:
                 reply = self._client(shard_idx).sample_request(
-                    self._seq, req
+                    wire_seq, req
                 )
             except (ConnectionError, OSError):
                 self.sample_failovers += 1
@@ -801,10 +1380,10 @@ class ReplayClientGroup:
         (the next real draw pays the failover accounting)."""
         k = self._rr
         self._rr = (self._rr + 1) % len(self._clients)
-        self._seq = (self._seq + 1) & ((1 << 48) - 1)
+        self._seq = (self._seq + 1) & ((1 << EPOCH_SHIFT) - 1)
         try:
             reply = self._client(k).sample_request(
-                self._seq,
+                (self.epoch << EPOCH_SHIFT) | self._seq,
                 [np.asarray([0], np.int64), np.asarray([0.0])],
             )
         except (ConnectionError, OSError):
@@ -824,10 +1403,57 @@ class ReplayClientGroup:
                     np.asarray(ids, np.int64),
                     np.asarray(indices, np.int64),
                     np.asarray(td_abs, np.float64),
-                ]
+                ],
+                epoch=self.epoch,
             )
         except (ConnectionError, OSError):
             self.prio_failures += 1
+
+    def rehome(self, shard_idx: Optional[int] = None) -> int:
+        """Reset the (stale) link state of a shard the runner just
+        respawned in place — or of every shard with ``None``. The old
+        connection is half-open against a process that no longer
+        exists: left alone, the first post-restore draw pays a fault
+        on it and burns part (or all) of the SHORT per-draw retry
+        deadline — spuriously counted as a failover against a shard
+        that is actually back and serving. Dropping the link NOW (no
+        goodbye frame — the new process must not mistake this for the
+        learner's orderly drain) makes the next draw reconnect fresh.
+        Returns how many links were reset."""
+        idxs = (
+            range(len(self._clients))
+            if shard_idx is None else [int(shard_idx)]
+        )
+        n = 0
+        for k in idxs:
+            c = self._clients[k]
+            if c is not None and c.reset():
+                n += 1
+        return n
+
+    def meter_state(self) -> Tuple[List[float], List[float]]:
+        """(cumulative, last-seen) per-shard ingest watermarks — the
+        learner checkpoint's slice of this group, so a resumed run
+        continues the global transition meter instead of re-deriving
+        a misleading budget from respawned shards."""
+        return (
+            list(self._shard_inserted_cum),
+            list(self.shard_inserted_last),
+        )
+
+    def restore_meter_state(
+        self, cum: Sequence[float], last: Sequence[float]
+    ) -> None:
+        if len(cum) != len(self._clients) or (
+            len(last) != len(self._clients)
+        ):
+            raise ValueError(
+                f"meter state for {len(cum)} shards, group has "
+                f"{len(self._clients)} (resume with the same "
+                f"n_replay_shards)"
+            )
+        self._shard_inserted_cum = [float(x) for x in cum]
+        self.shard_inserted_last = [float(x) for x in last]
 
     def inserted_total(self) -> int:
         """Aggregate transitions ever ingested across shards — the

@@ -365,13 +365,17 @@ class ResilientActorClient:
         with self._lock:
             return self._op(lambda c: c.sample_request(seq, leaves))
 
-    def prio_update(self, leaves: Sequence[np.ndarray]) -> None:
+    def prio_update(
+        self, leaves: Sequence[np.ndarray], *, epoch: int = 0
+    ) -> None:
         """Best-effort priority update: one attempt, no retry loop. A
         failure drops the connection (the next sample pays the
         reconnect) and the update is simply lost — priorities are
         advisory, and burning backoff budget on them would stall the
         learner's sample loop for sharpness it can re-derive on the
-        next draw of the same rows."""
+        next draw of the same rows. ``epoch`` is the sender's fencing
+        reign, stamped into the frame tag (see
+        ``ActorClient.prio_update``)."""
         with self._lock:
             if self._client is None:
                 try:
@@ -379,7 +383,7 @@ class ResilientActorClient:
                 except (ConnectionError, OSError):
                     return
             try:
-                self._client.prio_update(leaves)
+                self._client.prio_update(leaves, epoch=epoch)
             except LearnerShutdown:
                 raise
             except (ConnectionError, OSError):
@@ -420,6 +424,21 @@ class ResilientActorClient:
             except (ConnectionError, OSError):
                 self._drop()
                 return 0
+
+    def reset(self) -> bool:
+        """Drop the current link unconditionally — WITHOUT the goodbye
+        frame (``close()`` would send ``KIND_CLOSE``, which a replay
+        server treats as the learner's orderly drain signal). The next
+        operation reconnects head-first and pays only the connect. The
+        learner's client group calls this for a shard the runner just
+        respawned in place, so the first post-restore draw is not
+        spent faulting on a half-open link to a process that no longer
+        exists. Returns True when a link was dropped."""
+        with self._lock:
+            if self._client is not None:
+                self._drop()
+                return True
+        return False
 
     def rehome(self) -> bool:
         """Drop the link if it currently sits on a NON-HEAD endpoint,

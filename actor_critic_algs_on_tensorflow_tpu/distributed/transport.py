@@ -137,6 +137,12 @@ OBS_REQ_CODED = 1 << 62
 # KIND_HELLO role field values.
 ROLE_ACTOR = 0
 ROLE_STANDBY = 1
+# The learner side of the replay tier's sample/priority plane. A
+# replay server distinguishes its LEARNER (whose orderly goodbye means
+# "the run is over — flush a final ring snapshot and drain") from its
+# transition-pushing actors (whose goodbyes mean nothing tier-wide):
+# see distributed.replay.replay_server_main's goodbye handler.
+ROLE_LEARNER = 2
 
 # --- fencing epoch (quorum control plane) ----------------------------
 # The epoch identifies a primary's REIGN: the first primary serves
@@ -418,6 +424,12 @@ class PeerInfo:
     actor_id: int
     generation: int
     role: int
+    # Optional extended provenance (defaults keep 4-field call sites
+    # valid): capability bits and the fencing epoch the peer announced
+    # in its hello — the replay tier fences a deposed learner's late
+    # priority updates on the latter.
+    caps: int = 0
+    epoch: int = 0
 
 
 @dataclasses.dataclass
@@ -503,6 +515,11 @@ class LearnerServer:
         # sends the KIND_SAMPLE_BATCH for a sample request (None for
         # the one-way priority update).
         self._replay = None
+        # Goodbye hook: called with the PeerInfo of a peer that sent
+        # an orderly KIND_CLOSE (before the connection retires). The
+        # replay tier uses it to turn the learner's goodbye into a
+        # final ring snapshot + clean drain.
+        self._goodbye = None
         self._idle_timeout = idle_timeout_s
         # Param wire codec (distributed.codec): keep a small ring of
         # recent published versions' wire leaves and serve an XOR-delta
@@ -638,6 +655,15 @@ class LearnerServer:
         client pointed at a non-replay learner fails loudly instead of
         hanging."""
         self._replay = handler
+
+    def set_goodbye_handler(self, handler) -> None:
+        """Install a hook called with a peer's ``PeerInfo`` when it
+        announces an orderly ``KIND_CLOSE`` (hello provenance attached,
+        so the callee can tell a departing LEARNER from a departing
+        actor). Runs on the connection's thread, just before the
+        connection retires; exceptions are swallowed — a goodbye hook
+        must never turn a clean drain into a crash."""
+        self._goodbye = handler
 
     @staticmethod
     def _crcs_of(arrays: Sequence[np.ndarray]) -> List[int]:
@@ -1177,7 +1203,8 @@ class LearnerServer:
                         )
                     with self._reg_lock:
                         peer = PeerInfo(
-                            c.cid, c.actor_id, c.generation, c.role
+                            c.cid, c.actor_id, c.generation, c.role,
+                            c.caps, c.epoch,
                         )
                         if kind == KIND_SAMPLE_REQ:
                             self._sample_reqs += 1
@@ -1232,6 +1259,20 @@ class LearnerServer:
                         self._hellos += 1
                 elif kind == KIND_CLOSE:
                     reason = "graceful"
+                    goodbye = self._goodbye
+                    if goodbye is not None:
+                        with self._reg_lock:
+                            peer = PeerInfo(
+                                c.cid, c.actor_id, c.generation,
+                                c.role, c.caps, c.epoch,
+                            )
+                        try:
+                            goodbye(peer)
+                        except Exception as e:
+                            self._log(
+                                f"goodbye handler failed for actor#"
+                                f"{c.cid}: {type(e).__name__}: {e}"
+                            )
                     break
                 else:
                     raise ConnectionError(f"unknown frame kind {kind}")
@@ -1643,16 +1684,21 @@ class ActorClient:
             )
         return out
 
-    def prio_update(self, arrays: Sequence[np.ndarray]) -> None:
+    def prio_update(
+        self, arrays: Sequence[np.ndarray], *, epoch: int = 0
+    ) -> None:
         """One-way priority update (``[row ids, row indices, absolute
         TD errors]``). No reply — a priority refresh is advisory, and
         the next sample request's reply confirms the stream is
         healthy. A send failure still surfaces as ``ConnectionError``
         so the resilient wrapper reconnects (and may re-send: applying
-        absolute priorities twice is idempotent)."""
+        absolute priorities twice is idempotent). ``epoch`` rides the
+        tag's high bits (row count stays in the low bits) so a replay
+        shard can fence a DEPOSED learner's late updates after a
+        standby takeover bumps the reign."""
         arrays = [np.asarray(a) for a in arrays]
         n = int(arrays[0].shape[0]) if arrays else 0
-        self._send(KIND_PRIO_UPDATE, n, arrays)
+        self._send(KIND_PRIO_UPDATE, (int(epoch) << EPOCH_SHIFT) | n, arrays)
 
     def fetch_params(self) -> Tuple[int, List[np.ndarray]]:
         """Fetch the newest published params, reporting the version

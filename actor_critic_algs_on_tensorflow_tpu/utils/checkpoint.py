@@ -97,6 +97,12 @@ class Checkpointer:
         # crash-safe fallback can make this OLDER than latest_step().
         self.last_restored_step: int | None = None
 
+    @property
+    def directory(self) -> str:
+        """Root checkpoint directory (the off-policy runner derives
+        its replay-ring snapshot root, ``<dir>/replay``, from it)."""
+        return os.fspath(self._mgr.directory)
+
     def save(self, step: int, state: Any) -> None:
         self._mgr.save(int(step), args=ocp.args.StandardSave(state))
 

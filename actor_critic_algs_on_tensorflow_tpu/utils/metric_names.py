@@ -144,6 +144,24 @@ METRIC_NAMES: dict = {
     REPLAY + "actor_respawns": "env-stepper actor processes respawned",
     REPLAY + "batch_rejects": "sampled batches off the expected layout",
     REPLAY + "shards": "replay shard count (log attribution)",
+    # -- replay_* durability / failover (PR 14: ring snapshots,
+    # learner checkpoint/resume, warm-standby fencing)
+    REPLAY + "snapshots": "ring snapshots a shard wrote to disk",
+    REPLAY + "snapshot_age_s": "seconds since a shard's last snapshot "
+                               "(-1 = never)",
+    REPLAY + "restore_frac": "ring-restore load progress (1.0 = "
+                             "serving)",
+    REPLAY + "restored_rows": "rows a respawned shard restored from "
+                              "its snapshot chain",
+    REPLAY + "drop_restoring": "ingest frames dropped during a ring "
+                               "restore",
+    REPLAY + "prio_fenced": "priority updates dropped from a deposed "
+                            "learner's reign",
+    REPLAY + "ckpt_saves": "learner checkpoints written this run",
+    REPLAY + "fence_epoch": "the learner's fencing reign (bumps per "
+                            "takeover/resume)",
+    REPLAY + "shards_restoring": "shards currently loading a ring "
+                                 "snapshot",
     REPLAY_SAMPLE + "count": "sample-draw latency samples",
     REPLAY_SAMPLE + "mean_ms": "sample-draw latency mean",
     REPLAY_SAMPLE + "p50_ms": "sample-draw latency p50",

@@ -182,6 +182,126 @@ def sample_leg(
     }
 
 
+def recovery_leg(
+    *,
+    rows: int = 20_000,
+    batch_size: int = 256,
+    obs_dim: int = 64,
+    action_dim: int = 4,
+    snapshot_interval_s: float = 0.5,
+) -> dict:
+    """Kill -> first-post-restore-sample gap (the PR-14 durability
+    headline): a REAL replay-server PROCESS with ring snapshots
+    enabled is SIGKILLed after its ring is loaded and a periodic
+    snapshot has landed; a respawn on the SAME port restores the ring
+    from the on-disk chain, and the leg times SIGKILL -> the first
+    prioritized batch the restored process serves. The gap covers
+    process spawn + chain load + reconnect — the window the learner's
+    stall guard reports as "restoring (ring N% loaded)"."""
+    import multiprocessing as mp
+    import os as os_lib
+    import signal
+    import tempfile
+
+    from actor_critic_algs_on_tensorflow_tpu.distributed.replay import (
+        ReplayClientGroup,
+        replay_server_main,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.distributed.resilience import (
+        ResilientActorClient,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+        CAP_REPLAY,
+        ROLE_ACTOR,
+    )
+
+    snap_dir = tempfile.mkdtemp(prefix="replay-bench-snap-")
+    ctx = mp.get_context("spawn")
+
+    def spawn(port=0):
+        parent = child = None
+        if port == 0:
+            parent, child = ctx.Pipe()
+        p = ctx.Process(
+            target=replay_server_main,
+            args=(0, child),
+            kwargs=dict(
+                port=port, capacity=rows, alpha=0.6, eps=1e-6,
+                validate=False, report_interval_s=0.0,
+                snapshot_dir=snap_dir,
+                snapshot_interval_s=snapshot_interval_s,
+            ),
+            daemon=True,
+        )
+        p.start()
+        if child is not None:
+            child.close()
+        bound = port
+        if parent is not None:
+            assert parent.poll(120.0), "replay server never reported"
+            bound = int(parent.recv())
+            parent.close()
+        return p, bound
+
+    proc, port = spawn()
+    pusher = ResilientActorClient(
+        "127.0.0.1", port, hello=(0, 0, ROLE_ACTOR, CAP_REPLAY),
+    )
+    rng = np.random.default_rng(0)
+    done = 0
+    while done < rows:
+        n = min(2048, rows - done)
+        pusher.push_trajectory(
+            _transition_rows(rng, n, obs_dim, action_dim), []
+        )
+        done += n
+    pusher.close()
+    # A periodic snapshot covering the full ring must be on disk
+    # before the kill — poll for it rather than trusting one interval.
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        if any(
+            name.startswith("snap-")
+            for name in os_lib.listdir(snap_dir)
+        ):
+            break
+        time.sleep(0.1)
+    time.sleep(2 * snapshot_interval_s)  # let the newest cut finish
+
+    os_lib.kill(proc.pid, signal.SIGKILL)
+    proc.join(10)
+    t_kill = time.perf_counter()
+    proc2, _ = spawn(port=port)
+    group = ReplayClientGroup(
+        [("127.0.0.1", port)], client_id=1, retry_s=0.5,
+        connect_timeout=0.5,
+    )
+    gap = None
+    restored_rows = 0.0
+    deadline = time.monotonic() + 120.0
+    try:
+        while time.monotonic() < deadline:
+            batch = group.sample(batch_size, 0.4)
+            if batch is not None:
+                gap = time.perf_counter() - t_kill
+                restored_rows = group.shard_rows[0]
+                break
+            time.sleep(0.05)
+    finally:
+        group.close()
+        for p in (proc, proc2):
+            if p.is_alive():
+                p.terminate()
+        proc2.join(5)
+    assert gap is not None, "restored shard never served a batch"
+    return {
+        "rows": rows,
+        "batch_size": batch_size,
+        "restored_rows": restored_rows,
+        "recovery_gap_s": round(gap, 3),
+    }
+
+
 def e2e_leg(
     *,
     total_env_steps: int = 16_000,
@@ -263,6 +383,7 @@ def bench(
     *,
     ingest_kwargs: dict | None = None,
     sample_kwargs: dict | None = None,
+    recovery_kwargs: dict | None = None,
     e2e_kwargs: dict | None = None,
     run_e2e: bool = True,
 ) -> dict:
@@ -270,12 +391,15 @@ def bench(
     ``analysis/bench_schema.py``)."""
     ingest = ingest_leg(**(ingest_kwargs or {}))
     sample = sample_leg(**(sample_kwargs or {}))
+    recovery = recovery_leg(**(recovery_kwargs or {}))
     out = {
         "ingest": ingest,
         "sample": sample,
+        "recovery": recovery,
         "ingest_tps": ingest["ingest_tps"],
         "sample_p50_ms": sample["sample_p50_ms"],
         "sample_p99_ms": sample["sample_p99_ms"],
+        "recovery_gap_s": recovery["recovery_gap_s"],
     }
     if run_e2e:
         e2e = e2e_leg(**(e2e_kwargs or {}))
@@ -314,6 +438,11 @@ def main() -> int:
             "rows": int(os.environ.get("BENCH_REPLAY_SAMPLE_ROWS", 50_000)),
             "batch_size": int(os.environ.get("BENCH_REPLAY_BATCH", 256)),
             "draws": int(os.environ.get("BENCH_REPLAY_DRAWS", 200)),
+        },
+        recovery_kwargs={
+            "rows": int(
+                os.environ.get("BENCH_REPLAY_RECOVERY_ROWS", 20_000)
+            ),
         },
         e2e_kwargs={
             "total_env_steps": int(

@@ -75,10 +75,12 @@ def test_controlplane_flags_parse_and_validate():
     with pytest.raises(SystemExit, match="unknown role"):
         cli.make_coordinator("boss:2@127.0.0.1:9000")
     # Non-impala algos reject the control-plane flags outright.
+    # PR 14: --standby also serves the off-policy trainers; a2c still
+    # rejects it outright.
     args = cli.build_parser().parse_args(
         ["--algo", "a2c", "--standby", "127.0.0.1:7000"]
     )
-    with pytest.raises(SystemExit, match="impala-only"):
+    with pytest.raises(SystemExit, match="impala and the off-policy"):
         cli._run(args, "a2c", None, None)
     args = cli.build_parser().parse_args(
         ["--algo", "a2c", "--coordinate-preemption", "follow@h:1"]

@@ -448,11 +448,70 @@ def test_cli_replay_flags_validate():
             ]),
             "ddpg", None, None,
         )
-    with pytest.raises(SystemExit, match="checkpoint"):
+    # PR 14: checkpointing IS supported on this path now — the
+    # refusals that remain are the topology-contract ones.
+    with pytest.raises(SystemExit, match="requires --checkpoint-dir"):
+        cli._run(
+            parse([
+                "--algo", "ddpg", "--replay-servers", "2", "--resume",
+            ]),
+            "ddpg", None, None,
+        )
+    with pytest.raises(SystemExit, match="names 1 port"):
         cli._run(
             parse([
                 "--algo", "ddpg", "--replay-servers", "2",
-                "--checkpoint-dir", "/tmp/x",
+                "--replay-ports", "7001",
+            ]),
+            "ddpg", None, None,
+        )
+    with pytest.raises(SystemExit, match="requires --replay-servers"):
+        cli._run(
+            parse([
+                "--algo", "ddpg", "--replay-ports", "7001,7002",
+            ]),
+            "ddpg", None, None,
+        )
+    # An IMPALA standby must still reject --replay-actors loudly (the
+    # exemption is for the OFF-POLICY standby, which consumes it).
+    with pytest.raises(SystemExit, match="requires --replay-servers"):
+        cli._run(
+            parse([
+                "--algo", "impala", "--standby", "127.0.0.1:7000",
+                "--replay-actors", "4",
+            ]),
+            "impala", None, None,
+        )
+    with pytest.raises(SystemExit, match="needs --replay-endpoints"):
+        cli._run(
+            parse([
+                "--algo", "ddpg", "--standby", "127.0.0.1:7000",
+            ]),
+            "ddpg", None, None,
+        )
+    with pytest.raises(SystemExit, match="off-policy --standby"):
+        cli._run(
+            parse([
+                "--algo", "ddpg",
+                "--replay-endpoints", "127.0.0.1:7001",
+            ]),
+            "ddpg", None, None,
+        )
+    with pytest.raises(SystemExit, match="drop --replay-servers"):
+        cli._run(
+            parse([
+                "--algo", "ddpg", "--standby", "127.0.0.1:7000",
+                "--replay-servers", "2",
+                "--replay-endpoints", "127.0.0.1:7001,127.0.0.1:7002",
+            ]),
+            "ddpg", None, None,
+        )
+    with pytest.raises(SystemExit, match="priority endpoint lists"):
+        cli._run(
+            parse([
+                "--algo", "ddpg", "--standby", "127.0.0.1:7000",
+                "--replay-endpoints", "127.0.0.1:7001,127.0.0.1:7002",
+                "--redirector", "7100",
             ]),
             "ddpg", None, None,
         )
@@ -529,6 +588,7 @@ def test_replay_bench_smoke():
         sample_kwargs=dict(
             rows=512, batch_size=32, draws=5, obs_dim=8
         ),
+        recovery_kwargs=dict(rows=512, batch_size=32, obs_dim=8),
         run_e2e=False,
     )
     from actor_critic_algs_on_tensorflow_tpu.analysis.bench_schema import (
@@ -539,6 +599,329 @@ def test_replay_bench_smoke():
         assert k in out, k
     assert out["ingest_tps"] > 0
     assert isinstance(out["cpu_limited"], bool)
+
+
+# --- durability: ring snapshots (ISSUE 14) ---------------------------
+
+def test_shard_snapshot_restore_sample_bit_audit(tmp_path):
+    """ISSUE 14 bit-audit satellite: snapshot -> restore -> sample
+    equals the pre-snapshot shard's draw at the same point — rows,
+    ids, priorities, weights, AND the seeded rng all come back
+    bit-exactly, so a restored shard samples identically to the
+    pre-kill tree state."""
+    from actor_critic_algs_on_tensorflow_tpu.distributed.replay import (
+        ReplaySnapshotter,
+    )
+
+    shard = PrioritizedReplayShard(64, alpha=0.6, eps=1e-6, seed=7)
+    shard.add(_rows(0, 40))
+    first = shard.sample(8, 0.4)
+    shard.update_priorities(
+        first[1], first[0], np.linspace(0.5, 4.0, 8)
+    )
+    snap = ReplaySnapshotter(str(tmp_path), full_every=4)
+    assert snap.save(shard) == 1          # full cut
+    shard.add(_rows(40, 56))              # post-cut rows -> the delta
+    mid = shard.sample(8, 0.4)
+    shard.update_priorities(mid[1], mid[0], np.full(8, 2.5))
+    assert snap.save(shard) == 2          # incremental cut
+    expected = shard.sample(16, 0.4)      # the "pre-kill" draw
+
+    restored = PrioritizedReplayShard(64, alpha=0.6, eps=1e-6, seed=999)
+    loader = ReplaySnapshotter(str(tmp_path), full_every=4)
+    assert loader.restore(restored) == shard.size
+    assert restored.inserted == shard.inserted
+    assert restored._next_id == shard._next_id
+    assert restored._tree.total() == shard._tree.total()
+    assert restored.ring_restored
+    got = restored.sample(16, 0.4)
+    for e, g in zip(expected[:4], got[:4]):   # idx, ids, pri, weights
+        np.testing.assert_array_equal(e, g)
+    for e, g in zip(expected[4], got[4]):     # batch leaves
+        np.testing.assert_array_equal(e, g)
+
+
+def test_snapshotter_incremental_chain_and_retention(tmp_path):
+    """Every full_every-th save is a full cut; a new full prunes
+    chains older than the PREVIOUS full (the crash-safe fallback
+    stays); the restore replays full + deltas in order."""
+    from actor_critic_algs_on_tensorflow_tpu.distributed.replay import (
+        ReplaySnapshotter,
+    )
+
+    shard = PrioritizedReplayShard(32, alpha=1.0, eps=0.0, seed=3)
+    snap = ReplaySnapshotter(str(tmp_path), full_every=2)
+    assert snap.save(shard) == -1         # empty ring: nothing to cut
+    kinds = []
+    for i in range(5):
+        shard.add(_rows(8 * i, 8 * (i + 1)))
+        seq = snap.save(shard)
+        names = sorted(os.listdir(tmp_path))
+        kinds.append(
+            [n for n in names if f"{seq:08d}" in n][0].split("-")[-1]
+        )
+    assert kinds == [
+        "full.npz", "inc.npz", "full.npz", "inc.npz", "full.npz",
+    ]
+    # Retention after the seq-5 full: seqs 1-2 (older than the
+    # previous full, seq 3) are pruned; 3..5 remain.
+    seqs = sorted(
+        int(n.split("-")[1]) for n in os.listdir(tmp_path)
+    )
+    assert seqs == [3, 4, 5]
+    restored = PrioritizedReplayShard(32, alpha=1.0, eps=0.0, seed=5)
+    loader = ReplaySnapshotter(str(tmp_path), full_every=2)
+    assert loader.restore(restored) == shard.size
+    assert restored.inserted == 40
+    got = restored.sample(16, 0.4)
+    exp = shard.sample(16, 0.4)
+    np.testing.assert_array_equal(exp[1], got[1])
+
+
+def test_snapshotter_corrupt_full_falls_back_to_previous_chain(tmp_path):
+    """A torn/corrupt newest full snapshot falls back to the previous
+    chain (the Checkpointer.restore fallback discipline, file-local);
+    an unreadable dir restores nothing and the shard starts empty."""
+    from actor_critic_algs_on_tensorflow_tpu.distributed.replay import (
+        ReplaySnapshotter,
+    )
+
+    shard = PrioritizedReplayShard(32, alpha=1.0, eps=0.0, seed=3)
+    snap = ReplaySnapshotter(str(tmp_path), full_every=1)
+    shard.add(_rows(0, 10))
+    snap.save(shard)
+    inserted_at_first = shard.inserted
+    shard.add(_rows(10, 20))
+    seq2 = snap.save(shard)
+    bad = os.path.join(str(tmp_path), f"snap-{seq2:08d}-full.npz")
+    with open(bad, "wb") as f:
+        f.write(b"not a zipfile")
+    restored = PrioritizedReplayShard(32, alpha=1.0, eps=0.0, seed=9)
+    loader = ReplaySnapshotter(str(tmp_path), full_every=1)
+    assert loader.restore(restored) == 10
+    assert restored.inserted == inserted_at_first
+    empty = PrioritizedReplayShard(32, alpha=1.0, eps=0.0)
+    none_loader = ReplaySnapshotter(
+        str(tmp_path / "never-written"), full_every=1
+    )
+    assert none_loader.restore(empty) == 0
+
+
+def test_shard_restoring_gates_ingest_and_sampling():
+    """While a ring snapshot loads, ingest is dropped-and-counted and
+    draws answer None; the durability meta reports the load fraction
+    so the learner's stall guard says 'restoring', not 'dead'."""
+    shard = PrioritizedReplayShard(16, alpha=1.0, eps=0.0)
+    shard.add(_rows(0, 8))
+    shard.begin_restore()
+    shard.set_restore_progress(0.25)
+    assert shard.add(_rows(8, 12)) == 0
+    assert shard.dropped_restoring == 1
+    assert shard.sample(4, 0.4) is None
+    frac, age, restored_flag = shard.durability_meta()
+    assert frac == 0.25 and age == -1.0 and restored_flag == 0.0
+    shard.end_restore()
+    assert shard.sample(4, 0.4) is not None
+    m = shard.metrics()
+    assert m["replay_drop_restoring"] == 1
+    assert m["replay_restore_frac"] == 1.0
+
+
+def test_prio_update_fenced_below_the_raised_epoch():
+    """ISSUE 14 fencing: once any peer announces a newer reign, a
+    KIND_PRIO_UPDATE tagged with an older epoch (the deposed
+    learner's late frame) is dropped and counted, never applied —
+    while the new reign's updates still land."""
+    shard, server = _start_service(capacity=4096)
+    try:
+        _push(server.port, _rows(0, 256))
+        new_group = ReplayClientGroup(
+            [("127.0.0.1", server.port)], client_id=1, epoch=2,
+        )
+        old_group = ReplayClientGroup(
+            [("127.0.0.1", server.port)], client_id=2, epoch=1,
+        )
+        batch = new_group.sample(32, 0.4)   # raises the fence to 2
+        assert batch is not None
+        assert shard.fence_epoch == 2
+        idx, ids = batch.indices, batch.ids
+        before = shard._tree.get(np.asarray(idx))
+        old_group.update_priorities(0, ids, idx, np.full(32, 9.0))
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and shard.prio_fenced == 0:
+            time.sleep(0.02)
+        assert shard.prio_fenced == 1
+        np.testing.assert_array_equal(
+            shard._tree.get(np.asarray(idx)), before
+        )
+        new_group.update_priorities(0, ids, idx, np.full(32, 9.0))
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and shard.prio_applied == 0:
+            time.sleep(0.02)
+        assert shard.prio_applied >= 1
+        assert shard._tree.get(np.asarray(idx))[0] != before[0]
+        new_group.close()
+        old_group.close()
+    finally:
+        server.close()
+
+
+def test_group_meter_skips_mid_restore_replies():
+    """Replies served WHILE a respawned shard is loading its ring
+    snapshot carry a zeroed meter; the group's reconciliation must
+    skip them — folding one in would zero ``last`` and re-add the
+    whole restored meter on the first post-restore reply, double-
+    counting the predecessor's ingest."""
+    shard, server = _start_service(capacity=64)
+    try:
+        _push(server.port, _rows(0, 48, obs_dim=4))
+        group = ReplayClientGroup(
+            [("127.0.0.1", server.port)], client_id=1,
+        )
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline and (
+            group.inserted_total() < 48
+        ):
+            group.sample(16, 0.4)
+            time.sleep(0.02)
+        assert group.inserted_total() == 48
+
+        # "Respawn": a fresh shard mid-restore behind the same server.
+        shard2 = PrioritizedReplayShard(64, alpha=1.0, eps=0.0)
+        shard2.begin_restore()
+        service2 = ReplayShardService(shard2, log=lambda m: None)
+        server.set_replay_handler(service2.handle)
+        server.set_trajectory_sink(service2.ingest)
+        assert group.sample(16, 0.4) is None  # mid-restore: meta-only
+        assert group.inserted_total() == 48   # zeroed meter skipped
+        assert group.shard_restore_frac[0] < 1.0
+
+        # Restore completes from the old shard's cut; the meter
+        # CONTINUES at 48 and the group adds nothing.
+        shard2.apply_snapshot([shard.snapshot_cut(None)])
+        shard2.end_restore()
+        assert group.sample(16, 0.4) is not None
+        assert group.inserted_total() == 48
+        # New ingest counts as regrowth above the continued meter.
+        _push(server.port, _rows(48, 64, obs_dim=4), actor_id=1)
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline and (
+            group.inserted_total() < 64
+        ):
+            group.sample(16, 0.4)
+            time.sleep(0.02)
+        assert group.inserted_total() == 64
+        group.close()
+    finally:
+        server.close()
+
+
+# --- warm standby (fast paths) ---------------------------------------
+
+def _standby_fns(**kw):
+    from actor_critic_algs_on_tensorflow_tpu.algos.ddpg import make_ddpg
+
+    return make_ddpg(_pendulum_cfg(**kw))
+
+
+def test_offpolicy_standby_stands_down_when_primary_finishes(tmp_path):
+    """A primary that closes cleanly (KIND_CLOSE on the monitor's
+    link) means 'training finished' — the standby returns None and
+    never takes over."""
+    from actor_critic_algs_on_tensorflow_tpu.algos.offpolicy_distributed import (  # noqa: E501
+        run_offpolicy_standby,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
+        Checkpointer,
+    )
+
+    primary = LearnerServer(lambda t, e: True, log=lambda m: None)
+    ready = threading.Event()
+
+    def close_when_watched(monitor):
+        def closer():
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline and monitor.pongs == 0:
+                time.sleep(0.05)
+            primary.close()
+        threading.Thread(target=closer, daemon=True).start()
+        ready.set()
+
+    ck = Checkpointer(str(tmp_path / "ck"), async_save=False)
+    with time_limit(120, "standby stand-down"):
+        out = run_offpolicy_standby(
+            _standby_fns(),
+            checkpointer=ck,
+            primary_host="127.0.0.1",
+            primary_port=primary.port,
+            replay_endpoints=[
+                ("127.0.0.1", 1), ("127.0.0.1", 2),
+            ],  # never contacted before a takeover
+            total_env_steps=60_000,
+            n_actors=2,
+            warm_compile=False,
+            heartbeat_interval_s=0.2,
+            takeover_deadline_s=1.0,
+            on_ready=close_when_watched,
+        )
+    ck.close()
+    assert ready.is_set()
+    assert out is None
+
+
+def test_offpolicy_standby_stands_down_on_covered_budget(tmp_path):
+    """The lost-KIND_CLOSE race: a dead primary whose tailed
+    checkpoint already covers the env-step budget has nothing to take
+    over — the standby stands down instead of 're-running' a finished
+    job."""
+    import jax as jax_lib
+
+    from actor_critic_algs_on_tensorflow_tpu.algos.offpolicy_distributed import (  # noqa: E501
+        _ckpt_state,
+        run_offpolicy_standby,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
+        Checkpointer,
+    )
+
+    fns = _standby_fns()
+    parts = fns.parts
+    s = parts.setup
+    obs_spec = jax_lib.eval_shape(
+        lambda k: s.genv.reset(k, s.env_params)[1],
+        jax_lib.random.PRNGKey(0),
+    )
+    obs_example = jnp.zeros((1,) + obs_spec.shape[1:], obs_spec.dtype)
+    params, opt_state = jax_lib.jit(parts.init_params)(
+        jax_lib.random.PRNGKey(0), obs_example
+    )
+    budget = 60_000
+    ck = Checkpointer(str(tmp_path / "ck"), async_save=False)
+    ck.save(budget, _ckpt_state(
+        jax_lib.device_get(params), jax_lib.device_get(opt_state),
+        7_500, np.full(2, budget / 2.0), np.full(2, budget / 2.0),
+        budget, 0,
+    ))
+    dead = reserve_port()  # held: nothing ever listens here
+    try:
+        with time_limit(120, "covered-budget stand-down"):
+            out = run_offpolicy_standby(
+                fns,
+                checkpointer=ck,
+                primary_host="127.0.0.1",
+                primary_port=dead.port,
+                replay_endpoints=[("127.0.0.1", 1), ("127.0.0.1", 2)],
+                total_env_steps=budget,
+                n_actors=2,
+                warm_compile=False,
+                heartbeat_interval_s=0.2,
+                takeover_deadline_s=0.5,
+                never_seen_grace_s=0.6,
+            )
+    finally:
+        dead.release()
+        ck.close()
+    assert out is None
 
 
 # --- process tier (slow) ---------------------------------------------
@@ -715,6 +1098,298 @@ def _pendulum_cfg(**kw):
 
 
 @pytest.mark.slow
+def test_replay_server_sigterm_final_snapshot_then_ring_restore(tmp_path):
+    """ISSUE 14: SIGTERM is a clean drain — the server flushes a final
+    ring snapshot before exit, and a respawn on the same port restores
+    the ring (meter CONTINUES) instead of refilling from zero."""
+    snap_dir = str(tmp_path / "snap")
+    ctx = mp.get_context("spawn")
+    with time_limit(240, "sigterm drain + restore"):
+        p, port = _spawn_replay_proc(
+            ctx, 0, snapshot_dir=snap_dir,
+            snapshot_interval_s=3600.0,  # periodic off: the final cut
+        )
+        _push(port, _rows(0, 512, obs_dim=4))
+        p.terminate()  # SIGTERM
+        p.join(30)
+        assert p.exitcode == 0, p.exitcode
+        assert any(
+            n.startswith("snap-") for n in os.listdir(snap_dir)
+        ), "no final snapshot flushed on SIGTERM"
+
+        p2, _ = _spawn_replay_proc(
+            ctx, 0, port=port, snapshot_dir=snap_dir,
+            snapshot_interval_s=3600.0,
+        )
+        group = ReplayClientGroup(
+            [("127.0.0.1", port)], client_id=1, retry_s=0.5,
+            connect_timeout=0.5,
+        )
+        try:
+            batch = None
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline and batch is None:
+                batch = group.sample(32, 0.4)
+                if batch is None:
+                    time.sleep(0.1)
+            assert batch is not None, "restored ring never served"
+            # Meter CONTINUED from the snapshot (512), and the group's
+            # restore-aware reconciliation did not double-count.
+            assert group.shard_inserted_last[0] == 512.0
+            assert group.inserted_total() == 512
+            _push(port, _rows(512, 576, obs_dim=4))
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline and (
+                group.inserted_total() < 576
+            ):
+                group.sample(32, 0.4)
+                time.sleep(0.05)
+            assert group.inserted_total() == 576
+        finally:
+            group.close()
+            for proc in (p, p2):
+                if proc.is_alive():
+                    proc.terminate()
+            p2.join(15)
+
+
+@pytest.mark.slow
+def test_group_close_goodbye_flushes_snapshot_and_drains(tmp_path):
+    """ISSUE 14 satellite: the learner group's orderly KIND_CLOSE
+    goodbye (it hello'd ROLE_LEARNER) makes the replay server flush a
+    final snapshot and drain BY ITSELF — the coordinated
+    --preempt-save teardown is resumable end-to-end without any
+    signal delivery."""
+    snap_dir = str(tmp_path / "snap")
+    ctx = mp.get_context("spawn")
+    with time_limit(240, "goodbye drain"):
+        p, port = _spawn_replay_proc(
+            ctx, 0, snapshot_dir=snap_dir,
+            snapshot_interval_s=3600.0,
+        )
+        _push(port, _rows(0, 256, obs_dim=4))
+        group = ReplayClientGroup(
+            [("127.0.0.1", port)], client_id=1, retry_s=0.5,
+        )
+        try:
+            deadline = time.monotonic() + 60.0
+            batch = None
+            while time.monotonic() < deadline and batch is None:
+                batch = group.sample(32, 0.4)
+                time.sleep(0.05)
+            assert batch is not None
+        finally:
+            group.close()  # ROLE_LEARNER goodbye -> drain
+        p.join(60)
+        assert not p.is_alive(), "server never drained on goodbye"
+        assert p.exitcode == 0, p.exitcode
+        assert any(
+            n.startswith("snap-") for n in os.listdir(snap_dir)
+        ), "no final snapshot flushed on the learner goodbye"
+
+
+@pytest.mark.slow
+def test_deposed_learner_goodbye_does_not_drain_the_tier(tmp_path):
+    """A deposed-but-alive learner's teardown goodbye (old epoch) must
+    NOT drain a replay server the new reign is using; the CURRENT
+    reign's goodbye still does."""
+    snap_dir = str(tmp_path / "snap")
+    ctx = mp.get_context("spawn")
+    with time_limit(240, "fenced goodbye"):
+        p, port = _spawn_replay_proc(
+            ctx, 0, snapshot_dir=snap_dir, snapshot_interval_s=3600.0,
+        )
+        _push(port, _rows(0, 256, obs_dim=4))
+        deposed = ReplayClientGroup(
+            [("127.0.0.1", port)], client_id=1, epoch=0, retry_s=0.5,
+        )
+        current = ReplayClientGroup(
+            [("127.0.0.1", port)], client_id=2, epoch=1, retry_s=0.5,
+        )
+        try:
+            deadline = time.monotonic() + 60.0
+            b = None
+            while time.monotonic() < deadline and b is None:
+                b = deposed.sample(32, 0.4)
+                time.sleep(0.05)
+            assert b is not None
+            assert current.sample(32, 0.4) is not None  # fence -> 1
+            deposed.close()   # old-reign goodbye: fenced, no drain
+            p.join(5)
+            assert p.is_alive(), (
+                "deposed learner's goodbye drained the tier"
+            )
+            assert current.sample(32, 0.4) is not None
+            current.close()   # current reign's goodbye: clean drain
+            p.join(30)
+            assert not p.is_alive()
+            assert p.exitcode == 0
+        finally:
+            if p.is_alive():
+                p.terminate()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_rehome_after_respawn_avoids_spurious_failover(tmp_path):
+    """ISSUE 14 satellite: after the runner respawns a shard in
+    place, ``group.rehome(k)`` drops the half-open link so the first
+    post-restore draw reconnects fresh and serves — NOT spuriously
+    counted as a failover against a shard that is back."""
+    snap_dir = str(tmp_path / "snap")
+    ctx = mp.get_context("spawn")
+    with time_limit(300, "rehome failover accounting"):
+        p, port = _spawn_replay_proc(
+            ctx, 0, snapshot_dir=snap_dir, snapshot_interval_s=0.5,
+        )
+        _push(port, _rows(0, 512, obs_dim=4))
+        group = ReplayClientGroup(
+            [("127.0.0.1", port)], client_id=1, retry_s=1.0,
+            connect_timeout=0.5,
+        )
+        probe = ReplayClientGroup(
+            [("127.0.0.1", port)], client_id=2, retry_s=0.5,
+            connect_timeout=0.5,
+        )
+        try:
+            deadline = time.monotonic() + 60.0
+            batch = None
+            while time.monotonic() < deadline and batch is None:
+                batch = group.sample(32, 0.4)
+                time.sleep(0.05)
+            assert batch is not None
+            # A periodic snapshot must cover the ring before the kill.
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline and not any(
+                n.startswith("snap-") for n in os.listdir(snap_dir)
+            ):
+                time.sleep(0.1)
+            time.sleep(1.0)  # let the newest cut finalize
+            os.kill(p.pid, signal.SIGKILL)
+            p.join(10)
+            hold = PortReservation.hold("127.0.0.1", port)
+            hold.release()
+            p2, _ = _spawn_replay_proc(
+                ctx, 0, port=port, snapshot_dir=snap_dir,
+                snapshot_interval_s=3600.0,
+            )
+            # Wait until the respawn is restored and serving, via an
+            # independent probe link (the main group's stale link must
+            # stay untouched — that is what rehome is for).
+            deadline = time.monotonic() + 120.0
+            served = None
+            while time.monotonic() < deadline and served is None:
+                served = probe.sample(32, 0.4)
+                if served is None:
+                    time.sleep(0.1)
+            assert served is not None, "respawn never served"
+            failovers_before = group.sample_failovers
+            assert group.rehome(0) == 1   # one stale link dropped
+            batch = group.sample(32, 0.4)
+            assert batch is not None
+            assert group.sample_failovers == failovers_before, (
+                "post-restore draw was counted as a failover"
+            )
+        finally:
+            group.close()
+            probe.close()
+            for proc in (p, p2):
+                try:
+                    if proc.is_alive():
+                        proc.terminate()
+                except NameError:
+                    pass
+
+
+@pytest.mark.slow
+def test_offpolicy_resume_continues_meter_and_pacing(tmp_path):
+    """ISSUE 14: a preempted distributed off-policy run (stop_event,
+    the --preempt-save path) resumes end-to-end — learner checkpoint
+    + final ring snapshots restored, the global transition meter and
+    update pacing CONTINUE (no warmup restart, no re-derived
+    budget)."""
+    from actor_critic_algs_on_tensorflow_tpu.algos.ddpg import make_ddpg
+    from actor_critic_algs_on_tensorflow_tpu.algos.offpolicy_distributed import (  # noqa: E501
+        paced_update_target,
+        run_offpolicy_distributed,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
+        Checkpointer,
+    )
+
+    cfg = _pendulum_cfg(
+        num_envs=4, steps_per_iter=4, batch_size=16,
+        warmup_env_steps=200, replay_capacity=20_000,
+        replay_snapshot_interval_s=1.0,
+    )
+    budget = 12_000
+    ck_dir = str(tmp_path / "ck")
+    stop = threading.Event()
+
+    def on_start(handles):
+        def watcher():
+            deadline = time.monotonic() + 240.0
+            while time.monotonic() < deadline and not stop.is_set():
+                if handles.group.inserted_total() >= 5_000:
+                    stop.set()
+                    return
+                time.sleep(0.1)
+        threading.Thread(target=watcher, daemon=True).start()
+
+    with time_limit(900, "preempt + resume drill"):
+        ck = Checkpointer(ck_dir, async_save=False)
+        r1, _ = run_offpolicy_distributed(
+            make_ddpg(cfg),
+            total_env_steps=budget,
+            seed=0, n_replay_shards=2, n_actors=2,
+            log_interval=5, log_fn=lambda s, m: None,
+            stop_event=stop, on_start=on_start,
+            checkpointer=ck, checkpoint_interval=25,
+            actor_throttle_steps_per_s=600.0,
+        )
+        ck.close()
+        assert stop.is_set(), "preemption never fired"
+        assert r1.env_steps < budget, "run finished before the stop"
+        interrupted_meter = r1.env_steps
+        # Both halves of the durable state landed: a learner
+        # checkpoint and a final ring snapshot per shard.
+        for k in range(2):
+            assert any(
+                n.startswith("snap-")
+                for n in os.listdir(
+                    os.path.join(ck_dir, "replay", f"shard-{k}")
+                )
+            ), f"shard {k} flushed no snapshot at teardown"
+
+        ck2 = Checkpointer(ck_dir, async_save=False)
+        r2, h2 = run_offpolicy_distributed(
+            make_ddpg(cfg),
+            total_env_steps=budget,
+            seed=1, n_replay_shards=2, n_actors=2,
+            log_interval=5, log_fn=lambda s, m: None,
+            checkpointer=ck2, checkpoint_interval=25, resume=True,
+            actor_throttle_steps_per_s=600.0,
+        )
+        ck2.close()
+    # Meter monotonic across the preemption: the resumed run's FIRST
+    # log window already sits at (or above) the interrupted meter —
+    # the replay warmup did not restart from zero.
+    assert h2, "resumed run emitted no log windows"
+    assert h2[0][0] >= min(interrupted_meter, 5_000) - 500, (
+        h2[0][0], interrupted_meter,
+    )
+    assert r2.env_steps >= budget
+    # Pacing intact: total updates across both halves meet the paced
+    # target for the FULL budget (a re-derived budget would overshoot;
+    # a restarted meter would undershoot against the stall guard).
+    target = paced_update_target(
+        budget, cfg.warmup_env_steps,
+        cfg.updates_per_iter / (cfg.num_envs * cfg.steps_per_iter),
+    )
+    assert r2.updates >= target, (r2.updates, target)
+
+
+@pytest.mark.slow
 def test_distributed_run_survives_replay_server_kill():
     """Full-topology chaos: SIGKILL a replay server inside a real
     ``run_offpolicy_distributed`` run — the runner fails draws over,
@@ -771,6 +1446,217 @@ def test_distributed_run_survives_replay_server_kill():
     final = history[-1][1]
     assert final["replay_server_restarts"] >= 1
     assert handles.replay_procs[0] is not None
+
+
+def _offpolicy_primary_main(cfg, pport, replay_ports, ckpt_dir, budget):
+    """Primary off-policy learner process (top-level for mp-spawn
+    pickling). Attaches to the test-owned replay tier and actor fleet
+    (external topology — the same shape a standby takes over), so a
+    SIGKILL here kills ONLY the learner."""
+    import jax as jax_lib
+
+    jax_lib.config.update("jax_platforms", "cpu")
+    from actor_critic_algs_on_tensorflow_tpu.algos.ddpg import make_ddpg
+    from actor_critic_algs_on_tensorflow_tpu.algos.offpolicy_distributed import (  # noqa: E501
+        run_offpolicy_distributed,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
+        Checkpointer,
+    )
+
+    ck = Checkpointer(ckpt_dir, async_save=False)
+    run_offpolicy_distributed(
+        make_ddpg(cfg),
+        total_env_steps=budget,
+        seed=0,
+        n_replay_shards=len(replay_ports),
+        n_actors=2,
+        port=pport,
+        log_interval=5,
+        log_fn=lambda s, m: None,
+        checkpointer=ck,
+        checkpoint_interval=50,
+        external_replay_endpoints=[
+            ("127.0.0.1", p) for p in replay_ports
+        ],
+        spawn_actors=False,
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_offpolicy_standby_takeover_reaches_eval_bar(tmp_path):
+    """ISSUE 14 acceptance: SIGKILL the off-policy LEARNER mid-run.
+    The warm standby takes over behind a fencing-epoch bump, attaches
+    to the surviving replay tier and actor fleet, and the run
+    continues from the checkpointed meter/pacing state — the replay
+    warmup does NOT restart from zero (transition meter monotonic
+    across the takeover) and the distributed-DDPG learning gate still
+    reaches the single-process Pendulum greedy bar (> -400)."""
+    from actor_critic_algs_on_tensorflow_tpu import envs as envs_lib
+    from actor_critic_algs_on_tensorflow_tpu.algos import common
+    from actor_critic_algs_on_tensorflow_tpu.algos.ddpg import make_ddpg
+    from actor_critic_algs_on_tensorflow_tpu.algos.offpolicy_distributed import (  # noqa: E501
+        _offpolicy_actor_main,
+        run_offpolicy_standby,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.models import (
+        DeterministicActor,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.utils.checkpoint import (
+        Checkpointer,
+    )
+
+    budget = 60_000
+    cfg = _pendulum_cfg(
+        total_env_steps=budget, replay_snapshot_interval_s=5.0,
+    )
+    ckpt_dir = str(tmp_path / "ck")
+    ctx = mp.get_context("spawn")
+    with time_limit(1800, "standby takeover drill"):
+        # Test-owned tier: 2 replay shards (snapshotting) + 2 actors,
+        # so killing the learner kills only the learner — the shape
+        # ROADMAP's "warm-standby for the off-policy topology" names.
+        shard_procs = []
+        shard_ports = []
+        for k in range(2):
+            p, port = _spawn_replay_proc(
+                ctx, k, capacity=cfg.replay_capacity,
+                alpha=cfg.per_alpha, eps=cfg.per_eps,
+                snapshot_dir=os.path.join(
+                    ckpt_dir, "replay", f"shard-{k}"
+                ),
+                snapshot_interval_s=5.0,
+            )
+            shard_procs.append(p)
+            shard_ports.append(port)
+        endpoints = [("127.0.0.1", p) for p in shard_ports]
+        primary_r = reserve_port()
+        standby_r = reserve_port()
+        pport, sport = primary_r.port, standby_r.port
+        param_endpoints = [
+            ("127.0.0.1", pport), ("127.0.0.1", sport),
+        ]
+        primary_r.release()
+        primary = ctx.Process(
+            target=_offpolicy_primary_main,
+            args=(cfg, pport, shard_ports, ckpt_dir, budget),
+            daemon=True,
+        )
+        primary.start()
+        actor_procs = [
+            ctx.Process(
+                target=_offpolicy_actor_main,
+                args=(
+                    "ddpg", cfg, i, "127.0.0.1", pport,
+                    [endpoints[i % 2], endpoints[(i + 1) % 2]],
+                    # Throttled to ~1500 steps/s per actor: unpaced,
+                    # two pure-JAX Pendulum actors fill the 60k meter
+                    # in ~2s and the kill-at-15k choreography has no
+                    # window to land in.
+                    100 + i, 0, budget // 2, 1500.0, param_endpoints,
+                ),
+                daemon=True,
+            )
+            for i in range(2)
+        ]
+        for a in actor_procs:
+            a.start()
+
+        # THE FAULT: SIGKILL the learner once real progress is
+        # checkpointed (well past warmup).
+        killed_at = [None]
+
+        def killer():
+            reader = Checkpointer(ckpt_dir, async_save=False)
+            try:
+                deadline = time.monotonic() + 600.0
+                while time.monotonic() < deadline:
+                    reader.refresh()
+                    latest = reader.latest_step()
+                    if latest is not None and latest >= 15_000:
+                        killed_at[0] = latest
+                        os.kill(primary.pid, signal.SIGKILL)
+                        return
+                    time.sleep(0.25)
+            finally:
+                reader.close()
+
+        killer_t = threading.Thread(target=killer, daemon=True)
+        killer_t.start()
+
+        ck = Checkpointer(ckpt_dir, async_save=False)
+        standby_r.release()
+        try:
+            out = run_offpolicy_standby(
+                make_ddpg(cfg),
+                checkpointer=ck,
+                primary_host="127.0.0.1",
+                primary_port=pport,
+                replay_endpoints=endpoints,
+                total_env_steps=budget,
+                n_actors=2,
+                seed=0,
+                port=sport,
+                log_interval=20,
+                log_fn=lambda s, m: None,
+                heartbeat_interval_s=0.25,
+                takeover_deadline_s=1.5,
+                # The primary's jax import/trace phase runs well past
+                # the default 10x-deadline grace; a never-seen
+                # "death" here would split the run before it starts.
+                never_seen_grace_s=600.0,
+            )
+        finally:
+            ck.close()
+            killer_t.join(timeout=10)
+            for p in [primary] + actor_procs + shard_procs:
+                if p.is_alive():
+                    p.terminate()
+            for p in actor_procs + shard_procs:
+                p.join(timeout=15)
+
+    assert killed_at[0] is not None, "kill never fired"
+    assert primary.exitcode is not None and primary.exitcode != 0
+    assert out is not None, "standby never took over"
+    result, history = out
+    # Transition meter monotonic across the takeover: the takeover
+    # run's FIRST log window already sits at the checkpointed meter —
+    # no replay-warmup restart from zero.
+    assert history, "takeover run emitted no log windows"
+    assert history[0][0] >= 15_000, history[0][0]
+    assert result.env_steps >= budget, result.env_steps
+    # Pacing intact across the reigns: the combined update count
+    # meets the paced target for the full budget.
+    update_ratio = cfg.updates_per_iter / (
+        cfg.num_envs * cfg.steps_per_iter
+    )
+    from actor_critic_algs_on_tensorflow_tpu.algos.offpolicy_distributed import (  # noqa: E501
+        paced_update_target,
+    )
+
+    target = paced_update_target(
+        budget, cfg.warmup_env_steps, update_ratio
+    )
+    assert result.updates >= target, (result.updates, target)
+    # The takeover reign is fenced above the deposed learner's.
+    assert history[-1][1]["replay_fence_epoch"] >= 1
+    # Learning gate: the takeover run's final params still clear the
+    # single-process DDPG Pendulum greedy bar.
+    env, env_params = envs_lib.make("Pendulum-v1", num_envs=16)
+    actor = DeterministicActor(1)
+    actor_params = result.params.actor
+
+    def act(obs, key):
+        return actor.apply(actor_params, obs) * 2.0
+
+    mean_ret, _, frac_done = jax.jit(
+        lambda key: common.evaluate(
+            env, env_params, act, key, num_envs=16, max_steps=200
+        )
+    )(jax.random.PRNGKey(1))
+    assert float(frac_done) == 1.0
+    assert float(mean_ret) > -400.0, float(mean_ret)
 
 
 @pytest.mark.slow
