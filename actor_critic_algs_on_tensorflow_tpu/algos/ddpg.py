@@ -88,6 +88,17 @@ class DDPGConfig:
     replay_snapshot_dir: str = ""
     replay_snapshot_interval_s: float = 30.0
     replay_snapshot_full_every: int = 8
+    # Elastic fleet (run_offpolicy_distributed): when
+    # autoscaler_enabled, a threshold policy over the learner's own
+    # metrics stream resizes the supervised actor fleet between
+    # [autoscaler_min_actors, min(n_actors, autoscaler_max_actors)] —
+    # double up on starvation, halve down on backlog — holding
+    # autoscaler_cooldown_s between moves. Off by default: a
+    # fixed-budget run's step accounting stays deterministic.
+    autoscaler_enabled: bool = False
+    autoscaler_min_actors: int = 1
+    autoscaler_max_actors: int = 1_024
+    autoscaler_cooldown_s: float = 30.0
     seed: int = 0
     num_devices: int = 0
 

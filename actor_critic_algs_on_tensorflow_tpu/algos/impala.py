@@ -2803,6 +2803,17 @@ def run_impala_distributed(
             max_decode_bytes=cfg.transport_max_frame_mb << 20,
         )
         server.set_inference_handler(serving.submit)
+        # Elastic leave: an orderly actor goodbye retires its serving
+        # lane eagerly, so a scale-down does not leave ghost lanes
+        # (and partial-segment builders) pinned for the rest of the
+        # run. Learner/standby goodbyes carry no lane to retire.
+        server.set_goodbye_handler(
+            lambda peer: (
+                serving.retire_lane(peer.actor_id)
+                if peer.role == ROLE_ACTOR and peer.actor_id >= 0
+                else None
+            )
+        )
 
     # Mixed mode: device-resident self-play as a second batch source.
     # The collect program runs on the learner's own mesh (zero host
@@ -2995,6 +3006,23 @@ def run_impala_distributed(
                 shard_info["shard_id"] = shard.shard_id
         print(f"[impala] topology {shard_info}", flush=True)
 
+    # Live-fleet membership over the hello/generation registry: one
+    # view across every shard listener, refreshed per log line, so
+    # join/leave/rejoin churn is visible in the same stream as the
+    # learning metrics (the elastic-fleet observability floor).
+    from actor_critic_algs_on_tensorflow_tpu.distributed.elastic import (
+        MembershipView,
+    )
+
+    membership = MembershipView()
+
+    def _membership_metrics():
+        rows: List[dict] = []
+        for s in servers:
+            rows.extend(s.connections())
+        membership.refresh(rows)
+        return membership.metrics()
+
     def _merged_server_metrics():
         if len(servers) == 1:
             return server.metrics()
@@ -3067,6 +3095,7 @@ def run_impala_distributed(
             **(serving.metrics() if serving is not None else {}),
             **(validator.metrics() if validator is not None else {}),
             **(_per_shard_metrics() if shard is not None else {}),
+            **_membership_metrics(),
             **shard_info,
         }
 

@@ -88,12 +88,10 @@ def _validate_cfg(cfg, n_replay_shards: int, n_actors: int) -> None:
             f"need >= 1 replay shard and >= 1 actor, got "
             f"{n_replay_shards}/{n_actors}"
         )
-    if n_actors % n_replay_shards:
-        raise ValueError(
-            f"n_actors={n_actors} not divisible by "
-            f"n_replay_shards={n_replay_shards} (actor->shard "
-            f"assignment uses ShardPlan's contiguous equal slices)"
-        )
+    # No divisibility requirement: actor->shard assignment uses
+    # ShardPlan.balanced()'s remainder-spreading slices, so any fleet
+    # size maps onto any shard count — the elasticity precondition
+    # (an autoscaler-ramped fleet cannot promise divisibility).
 
 
 def _offpolicy_actor_main(
@@ -462,6 +460,11 @@ def run_offpolicy_distributed(
     import os as os_lib
 
     from actor_critic_algs_on_tensorflow_tpu.algos.common import emit_log
+    from actor_critic_algs_on_tensorflow_tpu.distributed.elastic import (
+        Autoscaler,
+        MembershipView,
+        ThresholdPolicy,
+    )
     from actor_critic_algs_on_tensorflow_tpu.distributed.replay import (
         ReplayClientGroup,
         replay_server_main,
@@ -487,7 +490,9 @@ def run_offpolicy_distributed(
     if external_replay_endpoints is not None:
         n_replay_shards = len(external_replay_endpoints)
     _validate_cfg(cfg, n_replay_shards, n_actors)
-    plan = ShardPlan(n_replay_shards)
+    # Balanced (remainder-spreading) slices: fleet size need not
+    # divide the shard count — the elastic-fleet precondition.
+    plan = ShardPlan.balanced(n_replay_shards)
     ctx = mp.get_context("spawn")
     log = lambda msg: print(f"[offpolicy-dist] {msg}", flush=True)
 
@@ -851,6 +856,11 @@ def run_offpolicy_distributed(
             # back and serving.
             group.rehome(k)
         for i in range(n_actors):
+            if i in retired_actors:
+                # An autoscaler scale-down is a deliberate leave, not a
+                # death — the supervisor must not fight the policy by
+                # respawning what it just retired.
+                continue
             p = actor_procs.get(i)
             if p is None or p.is_alive():
                 continue
@@ -862,6 +872,63 @@ def run_offpolicy_distributed(
                 )
             log(f"actor {i} died (exit {p.exitcode}); respawning")
             actor_procs[i] = spawn_actor(i, actor_restarts[i])
+
+    # -- elastic fleet: live membership + optional autoscaler ----------
+    # MembershipView diffs the param plane's hello registry each log
+    # tick, so joins/leaves/rejoins and the fleet count ride the
+    # metrics stream. The autoscaler (off by default — determinism for
+    # fixed-budget runs) resizes the SUPERVISED fleet between
+    # [min_actors, n_actors]: a scale-down terminates the highest-id
+    # actors (their shard slices are the remainder tail, so the move
+    # count is minimal) and marks them retired so check_procs() does
+    # not respawn them; a scale-up un-retires and respawns in place.
+    retired_actors: set = set()
+    membership = MembershipView(server)
+    autoscaler = None
+    if spawn_actors and getattr(cfg, "autoscaler_enabled", False):
+        autoscaler = Autoscaler(
+            ThresholdPolicy(),
+            min_actors=max(
+                1, int(getattr(cfg, "autoscaler_min_actors", 1))
+            ),
+            max_actors=max(1, min(
+                n_actors,
+                int(getattr(cfg, "autoscaler_max_actors", n_actors)),
+            )),
+            cooldown_s=float(
+                getattr(cfg, "autoscaler_cooldown_s", 30.0)
+            ),
+        )
+
+    def apply_autoscale(metrics: Dict[str, float]) -> None:
+        nonlocal actor_respawns
+        if autoscaler is None:
+            return
+        live = n_actors - len(retired_actors)
+        target = autoscaler.evaluate(live, metrics)
+        if target is None or target == live:
+            return
+        if target < live:
+            for i in sorted(actor_procs, reverse=True):
+                if live <= target:
+                    break
+                if i in retired_actors:
+                    continue
+                retired_actors.add(i)
+                p = actor_procs.get(i)
+                if p is not None and p.is_alive():
+                    p.terminate()
+                live -= 1
+            log(f"autoscaler: scaled down to {live} actors")
+        else:
+            for i in sorted(retired_actors):
+                if live >= target:
+                    break
+                retired_actors.discard(i)
+                actor_procs[i] = spawn_actor(i, actor_restarts[i])
+                actor_respawns += 1
+                live += 1
+            log(f"autoscaler: scaled up to {live} actors")
 
     # The run is done when the ingest budget is met AND the learner
     # has caught up to its paced update target. A shard SIGKILL can
@@ -1046,6 +1113,12 @@ def run_offpolicy_distributed(
                 m[REPLAY + "shards_restoring"] = sum(
                     1 for f in group.shard_restore_frac if f < 1.0
                 )
+                m[REPLAY + "ingest_tps"] = rate
+                membership.refresh()
+                m.update(membership.metrics())
+                if autoscaler is not None:
+                    apply_autoscale(m)
+                    m.update(autoscaler.metrics())
                 m["episodes"] = ep_count
                 m["avg_return"] = (
                     ep_returns_sum / ep_count if ep_count else 0.0

@@ -78,6 +78,11 @@ class SACConfig:
     replay_snapshot_dir: str = ""
     replay_snapshot_interval_s: float = 30.0
     replay_snapshot_full_every: int = 8
+    # Elastic actor-fleet autoscaler (see DDPGConfig).
+    autoscaler_enabled: bool = False
+    autoscaler_min_actors: int = 1
+    autoscaler_max_actors: int = 1_024
+    autoscaler_cooldown_s: float = 30.0
     seed: int = 0
     num_devices: int = 0
 

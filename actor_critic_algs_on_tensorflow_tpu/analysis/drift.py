@@ -53,6 +53,7 @@ from actor_critic_algs_on_tensorflow_tpu.analysis.core import (
 # bare "shards" count — but NOT a lone "shard" (a common kwarg name).
 _FAMILY_RE = re.compile(
     r"^(transport_|pipeline_|serve_|device_|replay_"
+    r"|elastic_|autoscaler_"
     r"|shard[0-9*]|shard_|shards$)"
     r"[A-Za-z0-9_*]*$"
 )
@@ -67,13 +68,17 @@ _SUMMARY_SUFFIXES = ("count", "mean_ms", "p50_ms", "p99_ms", "max_ms")
 _CONFIG_REL = "actor_critic_algs_on_tensorflow_tpu/algos/impala.py"
 # Off-policy trainer configs: every field must be --set-coercible
 # (DRIFT001); the distributed replay tier's operational knobs
-# (``per_*``/``replay_*``) additionally need README rows (DRIFT005).
+# (``per_*``/``replay_*``, and the elastic fleet's
+# ``elastic_*``/``autoscaler_*``) additionally need README rows
+# (DRIFT005).
 _OFFPOLICY_CONFIGS = {
     "actor_critic_algs_on_tensorflow_tpu/algos/ddpg.py": "DDPGConfig",
     "actor_critic_algs_on_tensorflow_tpu/algos/td3.py": "TD3Config",
     "actor_critic_algs_on_tensorflow_tpu/algos/sac.py": "SACConfig",
 }
-_OFFPOLICY_DOC_RE = re.compile(r"^(per_|replay_)")
+_OFFPOLICY_DOC_RE = re.compile(
+    r"^(per_|replay_|elastic_|autoscaler_)"
+)
 _REGISTRY_REL = "actor_critic_algs_on_tensorflow_tpu/utils/metric_names.py"
 # Files whose family-prefixed strings are metric uses. Tests are
 # excluded (they assert against literals on purpose); the analysis

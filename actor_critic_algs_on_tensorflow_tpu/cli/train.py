@@ -426,9 +426,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "per_alpha= per_beta= per_eps= replay_codec=")
     p.add_argument("--replay-actors", type=int, default=None, metavar="M",
                    help="with --replay-servers: env-stepper actor "
-                        "process count, default 2 (must divide evenly "
-                        "across the replay shards; each actor runs "
-                        "num_envs envs)")
+                        "process count, default 2 (any fleet size — "
+                        "ShardPlan.balanced() spreads the remainder "
+                        "across shards; each actor runs num_envs envs)")
+    p.add_argument("--autoscale", default=None, metavar="MIN:MAX",
+                   help="with --replay-servers: enable the elastic "
+                        "actor-fleet autoscaler — a threshold policy "
+                        "over the learner's metrics stream resizes the "
+                        "supervised fleet between MIN and "
+                        "min(MAX, --replay-actors) (double up on "
+                        "starvation, halve down on backlog; cooldown "
+                        "via --set autoscaler_cooldown_s=)")
     p.add_argument("--replay-ports", default=None, metavar="P0,P1,..",
                    help="with --replay-servers: pin each replay "
                         "shard's bind port (default: ephemeral). "
@@ -1194,12 +1202,28 @@ def _run(args, algo, cfg, writer) -> int:
             raise SystemExit(
                 "--replay-servers/--replay-actors must be >= 1"
             )
-        if args.replay_actors % args.replay_servers:
-            raise SystemExit(
-                f"--replay-actors {args.replay_actors} must divide "
-                f"evenly across --replay-servers "
-                f"{args.replay_servers} (ShardPlan's contiguous "
-                f"actor->shard slices)"
+        # No divisibility requirement between actors and shards:
+        # ShardPlan.balanced() spreads the remainder, so any fleet
+        # size maps onto any shard count (the elastic-fleet
+        # precondition — an autoscaler-ramped fleet cannot promise
+        # divisibility).
+        if args.autoscale is not None:
+            try:
+                lo_s, _, hi_s = args.autoscale.partition(":")
+                lo, hi = int(lo_s), int(hi_s)
+            except ValueError:
+                raise SystemExit(
+                    f"--autoscale: want MIN:MAX, got {args.autoscale!r}"
+                )
+            if not 1 <= lo <= hi:
+                raise SystemExit(
+                    f"--autoscale: need 1 <= MIN <= MAX, got {lo}:{hi}"
+                )
+            cfg = dataclasses.replace(
+                cfg,
+                autoscaler_enabled=True,
+                autoscaler_min_actors=lo,
+                autoscaler_max_actors=hi,
             )
         if args.resume and not args.checkpoint_dir:
             raise SystemExit("--resume requires --checkpoint-dir")
@@ -1231,6 +1255,11 @@ def _run(args, algo, cfg, writer) -> int:
         raise SystemExit(
             "--actor-param-endpoints requires --replay-servers (it "
             "configures the spawned env-stepper fleet)"
+        )
+    elif args.autoscale is not None:
+        raise SystemExit(
+            "--autoscale requires --replay-servers (it resizes the "
+            "spawned env-stepper fleet)"
         )
     if args.standby and not (algo == "impala" or offpolicy_standby):
         raise SystemExit(

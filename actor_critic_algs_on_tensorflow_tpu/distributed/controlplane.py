@@ -96,6 +96,7 @@ __all__ = [
     "Redirector",
     "ShardDesync",
     "StandbyElection",
+    "repoint_fleet",
 ]
 
 
@@ -192,6 +193,60 @@ class Redirector(ChaosProxy):
             return self.reset_all() if reset_existing else 0
         self.set_target(host, port)
         return self.reset_all() if reset_existing else 0
+
+
+def repoint_fleet(
+    redirectors,
+    targets,
+    *,
+    epoch: int,
+    rank: int = 0,
+    reset_existing: bool = True,
+    log: "Callable[[str], None] | None" = None,
+) -> int:
+    """Re-point a redirector tier at a resharded topology under ONE
+    fencing epoch — the actor-facing half of an elastic replan.
+
+    ``targets`` maps redirector ``i`` to its new upstream: either one
+    ``(host, port)`` applied to every redirector, or a sequence as
+    long as ``redirectors``. Every redirect carries the same
+    ``epoch``/``rank``, so a replan races cleanly against failover
+    re-points: whichever reign is newer wins each redirector, and a
+    deposed coordinator's late replan is refused per-redirector by
+    the existing fence. Returns how many redirectors accepted;
+    refusals are logged (a partial re-point under a LOSING epoch is
+    fine — the winning reign already owns those redirectors)."""
+    redirectors = list(redirectors)
+    if not redirectors:
+        return 0
+    if isinstance(targets, tuple) and len(targets) == 2 and isinstance(
+        targets[0], str
+    ):
+        targets = [targets] * len(redirectors)
+    targets = list(targets)
+    if len(targets) != len(redirectors):
+        raise ValueError(
+            f"{len(targets)} targets for {len(redirectors)} "
+            f"redirectors"
+        )
+    emit = log if log is not None else (
+        lambda msg: print(f"[repoint] {msg}", flush=True)
+    )
+    accepted = 0
+    for i, (rd, (host, port)) in enumerate(zip(redirectors, targets)):
+        got = rd.redirect(
+            host, int(port),
+            reset_existing=reset_existing, epoch=int(epoch),
+            rank=int(rank),
+        )
+        if got < 0:
+            emit(
+                f"redirector {i} refused epoch {epoch} re-point to "
+                f"{host}:{port} (a newer reign owns it)"
+            )
+        else:
+            accepted += 1
+    return accepted
 
 
 class PrimaryMonitor(threading.Thread):

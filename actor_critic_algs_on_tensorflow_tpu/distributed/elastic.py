@@ -1,0 +1,861 @@
+"""Elastic fleet layer: live membership, minimal-move rebalancing,
+epoch-fenced resharding, and a metric-driven autoscaler.
+
+Every plane used to be statically sharded: ``ShardPlan`` fixed the
+actor->shard map at launch and a fleet-size change meant a restart.
+IMPALA's decoupled actors exist precisely so the fleet can churn
+without stalling learning (Espeholt et al. 2018), and Ape-X assumes
+workers come and go around a durable replay tier (Horgan et al. 2018).
+This module makes join/leave, rebalance, and reshard runtime events:
+
+  - ``MembershipView`` tracks the live fleet over the transport tier's
+    hello/generation registry (``LearnerServer.connections()``): joins,
+    leaves, and generation-bumped rejoins, with a version counter that
+    bumps on every fleet change.
+  - ``rebalance`` recomputes actor->shard assignment on fleet change
+    while MOVING as few actors as possible — surviving actors keep
+    their shard unless it is over capacity, so a single join or leave
+    never reshuffles the fleet (contrast ``ShardPlan.shard_of_actor``,
+    where one fleet-size change re-slices everyone).
+  - ``ReshardPlan``/``PlanStore`` stage a shard-count change through
+    the checkpoint discipline: a plan is STAGED (atomic temp+replace),
+    the data moves happen, then the plan is COMMITTED (one atomic
+    rename). A SIGKILL anywhere in between leaves either the old
+    committed plan or the new one on disk — never a torn hybrid — so
+    a standby resumes a consistent topology. The fencing-epoch bump IS
+    the resharding event: the committed plan's epoch fences every
+    stale peer through the existing reign machinery.
+  - ``reshard_rings`` splits/merges ``PrioritizedReplayShard`` rings
+    into a new shard count by dealing the resident rows of the old
+    rings (in global stream order) round-robin into synthetic FULL
+    snapshot cuts — the same layout ``snapshot_cut`` produces — which
+    new servers restore through the ordinary snapshot path. The
+    function is a pure deterministic transform: same rings in, byte-
+    identical cuts out, so a replan interrupted and re-executed lands
+    bit-exactly on the same state. This retires the "one logical ring
+    across servers" residual: rings now re-split instead of resetting.
+  - ``Autoscaler`` + ``ThresholdPolicy`` turn metrics the pipeline
+    already emits (queue depth, stall time, ``serve_act`` p99, replay
+    ingest) into scale-up/down targets with hysteresis (cooldown +
+    double/halve steps), feeding the replan.
+
+Pure host-side: numpy + stdlib, no jax — importable from bench
+subprocesses and the chaos drill without dragging in a runtime.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+    ROLE_ACTOR,
+)
+from actor_critic_algs_on_tensorflow_tpu.utils.metric_names import (
+    AUTOSCALER,
+    ELASTIC,
+)
+
+__all__ = [
+    "Autoscaler",
+    "ElasticCoordinator",
+    "MembershipView",
+    "PlanStore",
+    "ReshardPlan",
+    "ThresholdPolicy",
+    "rebalance",
+    "reshard_rings",
+    "write_ring_snapshot",
+]
+
+
+# --------------------------------------------------------------------
+# Live membership
+# --------------------------------------------------------------------
+
+
+class MembershipView:
+    """The learner tier's view of the live actor fleet, derived from
+    the hello/generation registry the transport layer already keeps
+    (``LearnerServer.connections()`` rows carry ``actor_id``,
+    ``generation`` and ``role`` from each peer's hello).
+
+    ``refresh()`` diffs the current connection table against the last
+    view: a previously-unseen actor id is a JOIN, a vanished id is a
+    LEAVE, and a known id reappearing under a HIGHER generation is a
+    REJOIN (the respawn discipline bumps the generation, so a flapping
+    worker is distinguishable from two workers sharing an id). The
+    view version bumps on any change — rebalance triggers key on it.
+    """
+
+    def __init__(self, server: Any = None, *, role: int = ROLE_ACTOR):
+        self._server = server
+        self._role = int(role)
+        self._lock = threading.Lock()
+        self._members: Dict[int, int] = {}  # actor_id -> generation
+        self.version = 0
+        self.joins = 0
+        self.leaves = 0
+        self.rejoins = 0
+
+    def refresh(
+        self, rows: Optional[Sequence[dict]] = None
+    ) -> Tuple[List[int], List[int]]:
+        """Re-derive the live set; returns (joined, left) actor ids.
+        ``rows`` defaults to ``server.connections()``."""
+        if rows is None:
+            rows = self._server.connections() if self._server else []
+        live: Dict[int, int] = {}
+        for row in rows:
+            aid = int(row.get("actor_id", -1))
+            if aid < 0 or int(row.get("role", ROLE_ACTOR)) != self._role:
+                continue
+            gen = int(row.get("generation", 0))
+            live[aid] = max(gen, live.get(aid, gen))
+        with self._lock:
+            joined = sorted(a for a in live if a not in self._members)
+            left = sorted(a for a in self._members if a not in live)
+            rejoined = sum(
+                1
+                for a, g in live.items()
+                if a in self._members and g > self._members[a]
+            )
+            changed = bool(joined or left or rejoined)
+            self.joins += len(joined)
+            self.leaves += len(left)
+            self.rejoins += rejoined
+            self._members = live
+            if changed:
+                self.version += 1
+            return joined, left
+
+    def live(self) -> List[int]:
+        with self._lock:
+            return sorted(self._members)
+
+    def generation_of(self, actor_id: int) -> Optional[int]:
+        with self._lock:
+            return self._members.get(int(actor_id))
+
+    def metrics(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                ELASTIC + "fleet": len(self._members),
+                ELASTIC + "joins": self.joins,
+                ELASTIC + "leaves": self.leaves,
+                ELASTIC + "rejoins": self.rejoins,
+                ELASTIC + "membership_version": self.version,
+            }
+
+
+# --------------------------------------------------------------------
+# Minimal-move rebalancing
+# --------------------------------------------------------------------
+
+
+def rebalance(
+    live_actors: Sequence[int],
+    shard_count: int,
+    *,
+    prev: Optional[Dict[int, int]] = None,
+    capacity: Optional[int] = None,
+) -> Dict[int, int]:
+    """Assign every live actor to exactly one shard, moving as few
+    actors as possible relative to ``prev``.
+
+    Capacity defaults to ``ceil(len(live) / shard_count)`` — the
+    tightest bound that always admits a balanced placement. Surviving
+    actors KEEP their previous shard; a shard over capacity evicts its
+    highest actor ids (deterministic), and evicted plus new actors are
+    placed ascending-id onto the least-loaded shard (ties -> lowest
+    shard index). The moved-actor count therefore equals exactly the
+    per-shard overflow — the minimum any capacity-respecting
+    assignment must move — so a single join moves nobody and a single
+    leave moves at most the actors its departure strands over a
+    shrunken capacity (usually none).
+    """
+    if shard_count < 1:
+        raise ValueError(f"shard_count must be >= 1, got {shard_count}")
+    live = sorted(set(int(a) for a in live_actors))
+    if not live:
+        return {}
+    cap = (
+        int(capacity)
+        if capacity is not None
+        else math.ceil(len(live) / shard_count)
+    )
+    if cap * shard_count < len(live):
+        raise ValueError(
+            f"capacity {cap} x {shard_count} shards cannot hold "
+            f"{len(live)} actors"
+        )
+    prev = prev or {}
+    kept: List[List[int]] = [[] for _ in range(shard_count)]
+    unplaced: List[int] = []
+    for a in live:
+        s = prev.get(a)
+        if s is not None and 0 <= int(s) < shard_count:
+            kept[int(s)].append(a)
+        else:
+            unplaced.append(a)
+    for s in range(shard_count):
+        if len(kept[s]) > cap:
+            # Evict the HIGHEST ids: deterministic, and it biases
+            # long-lived low-id actors toward never moving.
+            kept[s].sort()
+            unplaced.extend(kept[s][cap:])
+            kept[s] = kept[s][:cap]
+    assignment = {a: s for s in range(shard_count) for a in kept[s]}
+    loads = [len(kept[s]) for s in range(shard_count)]
+    for a in sorted(unplaced):
+        s = min(range(shard_count), key=lambda k: (loads[k], k))
+        assignment[a] = s
+        loads[s] += 1
+    return assignment
+
+
+def moved_actors(
+    prev: Dict[int, int], new: Dict[int, int]
+) -> int:
+    """Actors present in both assignments whose shard changed."""
+    return sum(
+        1 for a, s in new.items() if a in prev and prev[a] != s
+    )
+
+
+# --------------------------------------------------------------------
+# Epoch-fenced reshard plans (staged through checkpoint discipline)
+# --------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ReshardPlan:
+    """One committed topology: the fencing epoch that enthroned it,
+    the replay/learner shard count, the shard endpoints, and the
+    actor->shard assignment. The epoch is the plan's identity — a
+    reshard IS an epoch bump, and every plan a ``PlanStore`` accepts
+    carries a strictly larger epoch than its predecessor."""
+
+    epoch: int
+    shard_count: int
+    endpoints: Tuple[Tuple[str, int], ...]
+    assignment: Dict[int, int]
+
+    def __post_init__(self):
+        if self.epoch < 0:
+            raise ValueError(f"epoch must be >= 0, got {self.epoch}")
+        if self.shard_count < 1:
+            raise ValueError(
+                f"shard_count must be >= 1, got {self.shard_count}"
+            )
+        for a, s in self.assignment.items():
+            if not 0 <= int(s) < self.shard_count:
+                raise ValueError(
+                    f"actor {a} assigned to shard {s} outside "
+                    f"[0, {self.shard_count})"
+                )
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "epoch": int(self.epoch),
+                "shard_count": int(self.shard_count),
+                "endpoints": [[h, int(p)] for h, p in self.endpoints],
+                "assignment": {
+                    str(a): int(s) for a, s in self.assignment.items()
+                },
+            },
+            sort_keys=True,
+        )
+
+    @classmethod
+    def from_json(cls, text: str) -> "ReshardPlan":
+        data = json.loads(text)
+        return cls(
+            epoch=int(data["epoch"]),
+            shard_count=int(data["shard_count"]),
+            endpoints=tuple(
+                (str(h), int(p)) for h, p in data["endpoints"]
+            ),
+            assignment={
+                int(a): int(s) for a, s in data["assignment"].items()
+            },
+        )
+
+
+_PLAN_NAME = "plan-{epoch:08d}.json"
+_STAGED_NAME = "plan-{epoch:08d}.staged.json"
+
+
+class PlanStore:
+    """Durable reshard plans under the checkpoint discipline.
+
+    A reshard runs in two durable steps: ``stage(plan)`` writes
+    ``plan-<epoch>.staged.json`` (temp name + ``os.replace`` + fsync,
+    so the staged file itself is never torn), the coordinator then
+    performs the data moves (ring re-split, redirector re-point), and
+    ``commit(plan)`` atomically renames the staged file to
+    ``plan-<epoch>.json`` — ONE rename is the commit point. ``load()``
+    returns only the newest COMMITTED plan, so a SIGKILL at any moment
+    resumes either the old plan (commit rename never happened; the
+    staged dropping is inert) or the new one — never a hybrid. Epochs
+    are enforced strictly monotonic across both stage and commit."""
+
+    def __init__(self, directory: str):
+        self.directory = os.path.abspath(os.fspath(directory))
+        os.makedirs(self.directory, exist_ok=True)
+
+    def _scan(self, suffix: str) -> List[Tuple[int, str]]:
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        for name in names:
+            if not (name.startswith("plan-") and name.endswith(suffix)):
+                continue
+            stem = name[len("plan-"):-len(suffix)]
+            if stem.isdigit():
+                out.append(
+                    (int(stem), os.path.join(self.directory, name))
+                )
+        return sorted(out)
+
+    def epochs(self) -> List[int]:
+        """Committed plan epochs, oldest first (the reshard ledger the
+        monotonicity test walks)."""
+        return [
+            e for e, p in self._scan(".json")
+            if not p.endswith(".staged.json")
+        ]
+
+    def _latest_committed_epoch(self) -> int:
+        eps = self.epochs()
+        return eps[-1] if eps else -1
+
+    def _write_atomic(self, path: str, text: str) -> None:
+        tmp = os.path.join(
+            self.directory, ".tmp-" + os.path.basename(path)
+        )
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(text)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def stage(self, plan: ReshardPlan) -> str:
+        """Durably stage ``plan`` (not yet authoritative); returns the
+        staged path. Loud on a non-monotonic epoch."""
+        latest = self._latest_committed_epoch()
+        if plan.epoch <= latest:
+            raise ValueError(
+                f"staged epoch {plan.epoch} not beyond committed "
+                f"epoch {latest} — reshard epochs never regress"
+            )
+        path = os.path.join(
+            self.directory, _STAGED_NAME.format(epoch=plan.epoch)
+        )
+        self._write_atomic(path, plan.to_json())
+        return path
+
+    def commit(self, plan: ReshardPlan) -> str:
+        """Make ``plan`` authoritative: one atomic rename of its
+        staged file (or a direct atomic write when staging was
+        skipped). Returns the committed path."""
+        latest = self._latest_committed_epoch()
+        if plan.epoch <= latest:
+            raise ValueError(
+                f"commit epoch {plan.epoch} not beyond committed "
+                f"epoch {latest} — reshard epochs never regress"
+            )
+        staged = os.path.join(
+            self.directory, _STAGED_NAME.format(epoch=plan.epoch)
+        )
+        path = os.path.join(
+            self.directory, _PLAN_NAME.format(epoch=plan.epoch)
+        )
+        if os.path.exists(staged):
+            os.replace(staged, path)
+        else:
+            self._write_atomic(path, plan.to_json())
+        return path
+
+    def staged(self) -> Optional[ReshardPlan]:
+        """The newest staged-but-uncommitted plan, if any (a resuming
+        coordinator may re-execute its data moves — they are
+        deterministic — or discard it)."""
+        entries = self._scan(".staged.json")
+        if not entries:
+            return None
+        _, path = entries[-1]
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                return ReshardPlan.from_json(f.read())
+        except (OSError, ValueError, KeyError):
+            return None
+
+    def discard_staged(self) -> int:
+        """Drop staged droppings (resume chose the old plan)."""
+        n = 0
+        for _, path in self._scan(".staged.json"):
+            try:
+                os.remove(path)
+                n += 1
+            except OSError:
+                pass
+        return n
+
+    def load(self) -> Optional[ReshardPlan]:
+        """The newest COMMITTED plan — what a standby resumes. Walks
+        backward past unreadable files (a torn commit is impossible,
+        but a disk can still eat bytes)."""
+        for epoch, path in reversed([
+            (e, p) for e, p in self._scan(".json")
+            if not p.endswith(".staged.json")
+        ]):
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    return ReshardPlan.from_json(f.read())
+            except (OSError, ValueError, KeyError):
+                continue
+        return None
+
+
+# --------------------------------------------------------------------
+# Ring split/merge (bit-exact, via synthetic full snapshot cuts)
+# --------------------------------------------------------------------
+
+
+def _resident_rows(shard) -> Tuple[np.ndarray, np.ndarray, List[np.ndarray]]:
+    """(stream_ids, priorities, row_leaves) for a shard's resident
+    rows, extracted under its lock. Empty arrays when nothing was
+    ever ingested."""
+    with shard._lock:
+        if shard._storage is None:
+            return (
+                np.zeros(0, np.int64),
+                np.zeros(0, np.float64),
+                [],
+            )
+        pos = np.nonzero(shard._row_ids >= 0)[0]
+        ids = shard._row_ids[pos].copy()
+        pri = shard._tree.get(pos)
+        leaves = [buf[pos].copy() for buf in shard._storage]
+        return ids, pri, leaves
+
+
+def reshard_rings(
+    shards: Sequence[Any],
+    new_count: int,
+    *,
+    epoch: int,
+    base_seed: int,
+    new_capacity: Optional[int] = None,
+) -> List[Optional[Dict[str, np.ndarray]]]:
+    """Split or merge the resident rows of ``shards``
+    (``PrioritizedReplayShard``s, quiesced/drained) into ``new_count``
+    synthetic FULL snapshot cuts — the exact layout
+    ``PrioritizedReplayShard.snapshot_cut`` produces, so new servers
+    restore them through the ordinary snapshot path
+    (``write_ring_snapshot`` + ``ReplaySnapshotter.restore``).
+
+    Deterministic and bit-exact: rows are ordered globally by
+    ``(stream_id, old_shard_index)`` (oldest first) and dealt
+    round-robin; storage fills start from zeroed buffers; per-row
+    priorities are copied exactly; each new shard's rng is seeded
+    ``base_seed + 7919 * (k + 1)``. Re-running the transform on the
+    same rings yields byte-identical cuts, so a replan that dies
+    mid-move re-executes to the same state. Priorities, the global
+    ``inserted`` meter sum, episode stats, the max-priority watermark
+    and the fencing epoch (= ``epoch``, the reshard's own bump) all
+    survive the re-deal.
+
+    Returns one state dict per new shard (``None`` everywhere when no
+    old shard ever pinned a layout)."""
+    if new_count < 1:
+        raise ValueError(f"new_count must be >= 1, got {new_count}")
+    shards = list(shards)
+    if not shards:
+        raise ValueError("no source shards")
+    specs = None
+    caps = []
+    total_inserted = 0
+    total_overwritten = 0
+    ep_return_sum = 0.0
+    ep_count = 0
+    max_pri = 1.0
+    per_shard = []
+    for sh in shards:
+        ids, pri, leaves = _resident_rows(sh)
+        per_shard.append((ids, pri, leaves))
+        with sh._lock:
+            caps.append(sh.capacity)
+            total_inserted += sh.inserted
+            total_overwritten += sh.overwritten
+            ep_return_sum += sh.ep.return_sum
+            ep_count += sh.ep.count
+            max_pri = max(max_pri, sh._max_pri)
+            if sh._leaf_specs is not None:
+                if specs is None:
+                    specs = list(sh._leaf_specs)
+                elif list(sh._leaf_specs) != specs:
+                    raise ValueError(
+                        "source shards pinned different transition "
+                        "layouts — they are not one logical ring"
+                    )
+    if specs is None:
+        return [None] * new_count
+    cap = int(new_capacity) if new_capacity is not None else max(caps)
+    if cap < 1:
+        raise ValueError(f"new_capacity must be >= 1, got {cap}")
+
+    # Global stream order: oldest first, old-shard index tiebreak
+    # (per-shard ids are stream positions, so ids collide across
+    # shards; the tiebreak keeps the order total and deterministic).
+    all_ids = np.concatenate([ids for ids, _, _ in per_shard])
+    all_src = np.concatenate([
+        np.full(len(ids), si, np.int64)
+        for si, (ids, _, _) in enumerate(per_shard)
+    ])
+    order = np.lexsort((all_src, all_ids))
+    total_rows = int(order.size)
+    # Flat gathers for the vectorized deal below (indexable by the
+    # same global positions ``order`` ranges over). Empty shards
+    # contribute zero-row leaves so the per-leaf concatenation stays
+    # aligned with ``all_ids``.
+    all_pri = np.concatenate([pri for _, pri, _ in per_shard])
+    all_leaves = [
+        np.concatenate([
+            (
+                leaves[li]
+                if leaves
+                else np.zeros((0,) + spec, dtype)
+            )
+            for _, _, leaves in per_shard
+        ])
+        for li, (spec, dtype) in enumerate(specs)
+    ]
+
+    out: List[Optional[Dict[str, np.ndarray]]] = []
+    extra = total_inserted - total_rows  # rows ever ingested beyond
+    # the resident set; re-spread so the global meter sum holds.
+    base_extra, rem_extra = divmod(max(0, extra), new_count)
+    for k in range(new_count):
+        mine = order[k::new_count]  # round-robin deal, global order
+        m = int(mine.size)
+        storage = [
+            np.zeros((cap,) + spec, dtype) for spec, dtype in specs
+        ]
+        row_ids = np.full(cap, -1, np.int64)
+        pri = np.zeros(cap, np.float64)
+        # Ring placement mirrors a real shard after m inserts: new
+        # stream id j lands at position j % cap; ids below m - cap
+        # (overflow on a shrinking merge) are overwritten exactly as
+        # ring semantics would. The surviving ids are distinct mod
+        # cap, so one vectorized scatter per leaf is exact.
+        start = max(0, m - cap)
+        js = np.arange(start, m, dtype=np.int64)
+        g = mine[start:m]
+        posn = js % cap
+        row_ids[posn] = js
+        pri[posn] = all_pri[g]
+        for li in range(len(specs)):
+            storage[li][posn] = all_leaves[li][g]
+        size = min(m, cap)
+        inserted_k = m + base_extra + (1 if k < rem_extra else 0)
+        overwritten_k = (m - size) + (
+            total_overwritten if k == 0 else 0
+        )
+        rng_state = np.random.RandomState(
+            base_seed + 7919 * (k + 1)
+        ).get_state()
+        state: Dict[str, np.ndarray] = {
+            "meta_i": np.asarray(
+                [
+                    cap,
+                    len(specs),
+                    m % cap,
+                    size,
+                    m,
+                    inserted_k,
+                    overwritten_k,
+                    int(epoch),
+                    ep_count if k == 0 else 0,
+                    -1,
+                ],
+                np.int64,
+            ),
+            "meta_f": np.asarray(
+                [max_pri, ep_return_sum if k == 0 else 0.0],
+                np.float64,
+            ),
+            "row_ids": row_ids,
+            "pri": pri,
+            "rng_keys": np.asarray(rng_state[1], np.uint32),
+            "rng_meta": np.asarray(
+                [rng_state[2], rng_state[3]], np.int64
+            ),
+            "rng_gauss": np.asarray([rng_state[4]], np.float64),
+        }
+        for li in range(len(specs)):
+            state[f"leaf{li:02d}"] = storage[li]
+        out.append(state)
+    return out
+
+
+def write_ring_snapshot(
+    directory: str, state: Optional[Dict[str, np.ndarray]], *, seq: int = 1
+) -> Optional[str]:
+    """Persist one synthetic full cut as ``snap-<seq>-full.npz`` under
+    ``directory`` (a FRESH per-shard snapshot dir), with the
+    temp-name + ``os.replace`` + fsync discipline — a kill mid-write
+    leaves a ``.tmp-`` dropping, never a half snapshot. A new replay
+    server pointed at the directory restores it through its normal
+    boot path. ``state=None`` (an empty fleet-wide ring) just creates
+    the directory."""
+    directory = os.path.abspath(os.fspath(directory))
+    os.makedirs(directory, exist_ok=True)
+    if state is None:
+        return None
+    path = os.path.join(directory, f"snap-{int(seq):08d}-full.npz")
+    tmp = os.path.join(directory, f".tmp-snap-{int(seq):08d}.npz")
+    with open(tmp, "wb") as f:
+        np.savez(f, **state)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+# --------------------------------------------------------------------
+# Autoscaler
+# --------------------------------------------------------------------
+
+
+class ThresholdPolicy:
+    """Turn pipeline metrics into a scale direction.
+
+    Signals (all keys the tree already emits): a STARVED learner —
+    high stall share or replay ingest below the low watermark — wants
+    more actors (+1); an OVERFED one — deep ready queue or a saturated
+    serving tier (``serve_act_p99_ms`` past the bound) — wants fewer
+    (-1). Starvation wins ties: an idle learner is the costlier
+    failure. Returns 0 (hold) when nothing trips."""
+
+    def __init__(
+        self,
+        *,
+        queue_depth_high: float = 64.0,
+        stall_share_high: float = 0.25,
+        act_p99_high_ms: float = 250.0,
+        ingest_low_tps: float = 0.0,
+    ):
+        self.queue_depth_high = float(queue_depth_high)
+        self.stall_share_high = float(stall_share_high)
+        self.act_p99_high_ms = float(act_p99_high_ms)
+        self.ingest_low_tps = float(ingest_low_tps)
+
+    def decide(self, metrics: Dict[str, float]) -> int:
+        depth = float(metrics.get("pipeline_depth", 0.0))
+        stall = float(metrics.get("pipeline_stall_s", 0.0))
+        busy = stall + float(metrics.get("pipeline_compute_s", 0.0))
+        stall_share = stall / busy if busy > 0 else 0.0
+        p99 = float(metrics.get("serve_act_p99_ms", 0.0))
+        ingest = float(metrics.get("replay_ingest_tps", -1.0))
+        if stall_share > self.stall_share_high:
+            return 1
+        if 0.0 <= ingest < self.ingest_low_tps:
+            return 1
+        if depth > self.queue_depth_high:
+            return -1
+        if p99 > self.act_p99_high_ms:
+            return -1
+        return 0
+
+
+class Autoscaler:
+    """Fleet-size controller: evaluates a policy against the latest
+    metrics and proposes a new actor target, with hysteresis so the
+    fleet ramps geometrically (double up, halve down — 4 -> 8 -> 16 ->
+    32 on sustained starvation, 32 -> 16 -> 8 back) instead of
+    thrashing one worker at a time, and a cooldown so one decision
+    settles before the next is taken."""
+
+    def __init__(
+        self,
+        policy: ThresholdPolicy,
+        *,
+        min_actors: int,
+        max_actors: int,
+        cooldown_s: float = 30.0,
+        clock=time.monotonic,
+    ):
+        if min_actors < 1 or max_actors < min_actors:
+            raise ValueError(
+                f"need 1 <= min_actors <= max_actors, got "
+                f"[{min_actors}, {max_actors}]"
+            )
+        self.policy = policy
+        self.min_actors = int(min_actors)
+        self.max_actors = int(max_actors)
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._last_decision_t: Optional[float] = None
+        self.target: Optional[int] = None
+        self.decisions = 0
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.holds = 0
+
+    def _clamp(self, n: int) -> int:
+        return max(self.min_actors, min(self.max_actors, int(n)))
+
+    def evaluate(
+        self, current_actors: int, metrics: Dict[str, float]
+    ) -> Optional[int]:
+        """One policy tick. Returns the NEW actor target when a
+        resize is warranted (and off cooldown), else ``None``."""
+        now = self._clock()
+        self.decisions += 1
+        if (
+            self._last_decision_t is not None
+            and now - self._last_decision_t < self.cooldown_s
+        ):
+            self.holds += 1
+            return None
+        direction = self.policy.decide(metrics)
+        if direction == 0:
+            self.holds += 1
+            return None
+        current = int(current_actors)
+        target = self._clamp(
+            current * 2 if direction > 0 else current // 2
+        )
+        if target == current:
+            self.holds += 1
+            return None
+        if direction > 0:
+            self.scale_ups += 1
+        else:
+            self.scale_downs += 1
+        self._last_decision_t = now
+        self.target = target
+        return target
+
+    def cooling(self) -> bool:
+        return (
+            self._last_decision_t is not None
+            and self._clock() - self._last_decision_t < self.cooldown_s
+        )
+
+    def metrics(self) -> Dict[str, float]:
+        return {
+            AUTOSCALER + "decisions": self.decisions,
+            AUTOSCALER + "scale_ups": self.scale_ups,
+            AUTOSCALER + "scale_downs": self.scale_downs,
+            AUTOSCALER + "holds": self.holds,
+            AUTOSCALER + "target_actors": (
+                self.target if self.target is not None else -1
+            ),
+            AUTOSCALER + "cooldown_active": 1 if self.cooling() else 0,
+        }
+
+
+# --------------------------------------------------------------------
+# Coordinator: membership + plans + (optional) autoscaler, one facade
+# --------------------------------------------------------------------
+
+
+class ElasticCoordinator:
+    """One object a learner loop (or the chaos drill) holds: the
+    membership view, the durable plan store, reshard bookkeeping, and
+    an optional autoscaler — with a merged ``metrics()`` for the log
+    line.
+
+    ``propose(shard_count, endpoints, epoch)`` builds the next
+    ``ReshardPlan`` by rebalancing the CURRENT live fleet over the new
+    topology (minimal moves vs the committed assignment) and stages
+    it; ``commit(plan)`` makes it authoritative after the data moves.
+    Epoch monotonicity is enforced by the store; this facade just
+    keeps the moved-actor and reshard counters honest."""
+
+    def __init__(
+        self,
+        *,
+        membership: MembershipView,
+        store: PlanStore,
+        autoscaler: Optional[Autoscaler] = None,
+    ):
+        self.membership = membership
+        self.store = store
+        self.autoscaler = autoscaler
+        self.reshards = 0
+        self.last_moved = 0
+        committed = store.load()
+        self._assignment: Dict[int, int] = (
+            dict(committed.assignment) if committed else {}
+        )
+        self._epoch = committed.epoch if committed else 0
+
+    @property
+    def plan_epoch(self) -> int:
+        return self._epoch
+
+    def assignment(self) -> Dict[int, int]:
+        return dict(self._assignment)
+
+    def refresh_assignment(self, shard_count: int) -> Dict[int, int]:
+        """Fold membership churn into the CURRENT topology (no epoch
+        bump — same shards, fewer/more actors)."""
+        self.membership.refresh()
+        new = rebalance(
+            self.membership.live(), shard_count, prev=self._assignment
+        )
+        self.last_moved = moved_actors(self._assignment, new)
+        self._assignment = new
+        return dict(new)
+
+    def propose(
+        self,
+        shard_count: int,
+        endpoints: Sequence[Tuple[str, int]],
+        *,
+        epoch: int,
+    ) -> ReshardPlan:
+        self.membership.refresh()
+        new = rebalance(
+            self.membership.live(), shard_count, prev=self._assignment
+        )
+        plan = ReshardPlan(
+            epoch=int(epoch),
+            shard_count=int(shard_count),
+            endpoints=tuple((str(h), int(p)) for h, p in endpoints),
+            assignment=new,
+        )
+        self.store.stage(plan)
+        return plan
+
+    def commit(self, plan: ReshardPlan) -> None:
+        self.store.commit(plan)
+        self.last_moved = moved_actors(
+            self._assignment, plan.assignment
+        )
+        self._assignment = dict(plan.assignment)
+        self._epoch = plan.epoch
+        self.reshards += 1
+
+    def metrics(self) -> Dict[str, float]:
+        out = dict(self.membership.metrics())
+        out[ELASTIC + "reshards"] = self.reshards
+        out[ELASTIC + "moved_actors"] = self.last_moved
+        out[ELASTIC + "plan_epoch"] = self._epoch
+        if self.autoscaler is not None:
+            out.update(self.autoscaler.metrics())
+        return out

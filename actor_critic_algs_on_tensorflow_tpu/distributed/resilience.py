@@ -496,6 +496,11 @@ class _Link:
         self.truncate_after = truncate_after  # upstream bytes before RST
         self.lock = threading.Lock()
         self.closed = False
+        # Link-flap gate: while set, the pumps stop READING (both
+        # directions) without touching the sockets — bytes pile into
+        # kernel buffers and the peers see a slow-but-alive link, not
+        # a teardown. ``resume`` drains whatever queued.
+        self.paused = threading.Event()
 
     def reset(self) -> None:
         with self.lock:
@@ -632,9 +637,46 @@ class ChaosProxy:
             link.reset()
         return len(links)
 
+    def pause(self, link: _Link | None = None) -> int:
+        """Link-flap: freeze forwarding on ``link`` (or EVERY live
+        link) WITHOUT tearing the connection down — the worker behind
+        it is slow-but-alive, the failure mode churn drills need that
+        ``reset_all`` cannot model. Peers keep their sockets; writes
+        back up into kernel buffers until ``resume``. Sequence with
+        ``wait_links`` as usual ("fleet connected" before "flap").
+        Returns how many links were paused."""
+        with self._lock:
+            links = (
+                [link] if link is not None
+                else [l for l in self._links if not l.closed]
+            )
+        for l in links:
+            l.paused.set()
+        return len(links)
+
+    def resume(self, link: _Link | None = None) -> int:
+        """Unfreeze a paused link (or all of them); queued bytes
+        drain in order. Returns how many links were resumed."""
+        with self._lock:
+            links = (
+                [link] if link is not None
+                else [l for l in self._links if not l.closed]
+            )
+        n = 0
+        for l in links:
+            if l.paused.is_set():
+                l.paused.clear()
+                n += 1
+        return n
+
     def live_links(self) -> int:
         with self._lock:
             return sum(1 for l in self._links if not l.closed)
+
+    def links(self) -> List[_Link]:
+        """Live link handles (for targeted ``pause``/``resume``)."""
+        with self._lock:
+            return [l for l in self._links if not l.closed]
 
     def wait_links(self, n: int, timeout: float = 5.0) -> bool:
         """Block until at least ``n`` links are live (or ``timeout``).
@@ -712,6 +754,12 @@ class ChaosProxy:
               upstream: bool) -> None:
         try:
             while not link.closed:
+                if link.paused.is_set():
+                    # Flapped: stop reading, keep the sockets. The
+                    # sender's TCP window closes naturally once the
+                    # kernel buffers fill — slow-but-alive.
+                    time.sleep(0.02)
+                    continue
                 # Gate the read so ``link.closed`` is honored within
                 # the poll interval instead of only when bytes arrive
                 # — a silent peer no longer pins the pump thread.

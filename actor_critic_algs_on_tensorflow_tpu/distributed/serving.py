@@ -276,6 +276,7 @@ class InferenceServer:
         self._segments = 0
         self._reply_failures = 0
         self._param_swaps = 0
+        self._lane_retires = 0
         self._act_lat = LatencyStats()
         self._tick = threading.Thread(
             target=self._tick_loop, name="inference-server-tick", daemon=True
@@ -512,6 +513,23 @@ class InferenceServer:
         the percentiles)."""
         self._act_lat.reset()
 
+    def retire_lane(self, actor_id: int) -> bool:
+        """Drop a departed shim's lane (elastic leave): its builder's
+        partial segment is discarded — the actor announced an orderly
+        goodbye, so no further steps will ever complete it — and an
+        in-flight request is forgotten (its reply closure fails
+        harmlessly against the closed connection). Wired to the
+        transport goodbye hook so a scale-down does not leave ghost
+        lanes pinning ``serve_lanes`` (and builder memory) for the
+        rest of the run. A later REJOIN under a fresh generation would
+        have reset the lane anyway; retirement just reclaims it
+        eagerly. Returns whether a lane existed."""
+        with self._lock:
+            lane = self._lanes.pop(int(actor_id), None)
+            if lane is not None:
+                self._lane_retires += 1
+        return lane is not None
+
     def metrics(self) -> dict:
         with self._lock:
             m = {
@@ -527,6 +545,7 @@ class InferenceServer:
                 "serve_reply_failures": self._reply_failures,
                 "serve_param_swaps": self._param_swaps,
                 "serve_lanes": len(self._lanes),
+                "serve_lane_retires": self._lane_retires,
             }
         m.update(self._act_lat.summary(metric_names.SERVE_ACT))
         return m

@@ -55,6 +55,7 @@ import jax
 import numpy as np
 
 __all__ = [
+    "BalancedShardPlan",
     "ShardCheckpointer",
     "ShardPlan",
     "ShardedIngest",
@@ -141,6 +142,18 @@ class ShardPlan:
             )
         return actor_id // (num_actors // self.shard_count)
 
+    @classmethod
+    def balanced(
+        cls, shard_count: int, shard_id: Optional[int] = None
+    ) -> "BalancedShardPlan":
+        """An elasticity-friendly plan: actor slices spread remainders
+        instead of demanding divisibility (``BalancedShardPlan``).
+        Batch and device splits keep the loud divisibility checks —
+        those feed fixed compiled shapes — but the ACTOR fleet is a
+        runtime quantity, and "fleet size must divide shard count" is
+        exactly the footgun that blocks join/leave elasticity."""
+        return BalancedShardPlan(shard_count, shard_id)
+
     def device_slice(self, mesh, shard: int) -> List[Any]:
         """The contiguous block of data-axis mesh devices shard
         ``shard`` feeds (in-process shape). Contiguity matters: the
@@ -154,6 +167,42 @@ class ShardPlan:
             )
         per = len(devices) // self.shard_count
         return devices[shard * per : (shard + 1) * per]
+
+
+@dataclasses.dataclass(frozen=True)
+class BalancedShardPlan(ShardPlan):
+    """``ShardPlan`` minus the actor-fleet divisibility requirement:
+    ``num_actors`` splits into contiguous slices whose sizes differ by
+    at most one (the first ``num_actors % shard_count`` shards take
+    the extra actor). Everything compiled-shape-facing
+    (``local_parts``, ``device_slice``) keeps the parent's loud
+    validation — only the actor fleet, a runtime quantity under
+    elasticity, relaxes. A shard may own an EMPTY slice when the
+    fleet shrinks below the shard count; callers see ``range(x, x)``
+    rather than an error, matching a drained-but-live ingest stack."""
+
+    def actor_slice(self, num_actors: int, shard: int) -> range:
+        if num_actors < 0:
+            raise ValueError(f"num_actors must be >= 0, {num_actors}")
+        if not 0 <= shard < self.shard_count:
+            raise ValueError(
+                f"shard {shard} outside [0, {self.shard_count})"
+            )
+        per, rem = divmod(num_actors, self.shard_count)
+        start = shard * per + min(shard, rem)
+        return range(start, start + per + (1 if shard < rem else 0))
+
+    def shard_of_actor(self, num_actors: int, actor_id: int) -> int:
+        if not 0 <= actor_id < num_actors:
+            raise ValueError(
+                f"actor_id {actor_id} outside [0, {num_actors})"
+            )
+        per, rem = divmod(num_actors, self.shard_count)
+        # The first ``rem`` shards hold ``per + 1`` actors.
+        boundary = rem * (per + 1)
+        if actor_id < boundary:
+            return actor_id // (per + 1)
+        return rem + (actor_id - boundary) // per
 
 
 def device_slice_transfer(

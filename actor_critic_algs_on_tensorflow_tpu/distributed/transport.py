@@ -127,6 +127,24 @@ KIND_PRIO_UPDATE = 21    # learner -> replay server: tag = n rows,
 #                          a lost update costs sampling sharpness, not
 #                          correctness — so the hot path pays no extra
 #                          round trip (routed to the replay handler)
+KIND_MEMBER_REQ = 22     # peer -> learner: tag = request sequence —
+#                          "send me the live membership view" (the
+#                          elastic-fleet control plane; answered from
+#                          the hello/generation registry, no handler
+#                          needed)
+KIND_MEMBER_VIEW = 23    # learner -> peer: tag = the request sequence
+#                          echoed back, arrays = [int64 [n, 5] rows of
+#                          (actor_id, generation, role, caps, epoch),
+#                          int64 [hellos, fence_epoch] meta] — the
+#                          registry rows MembershipView diffs
+KIND_RESHARD = 24        # coordinator -> peer: tag = the NEW fencing
+#                          epoch (the epoch bump IS the reshard),
+#                          arrays = [int64 [epoch, shard_count], uint8
+#                          JSON plan bytes (ReshardPlan.to_json)].
+#                          One-way replan notice: peers re-point
+#                          through the redirector tier and re-hello
+#                          under the new epoch (routed to the reshard
+#                          handler, see set_reshard_handler)
 
 # KIND_OBS_REQ tag flag bit: the request's arrays are one coded
 # trajectory-codec frame ([meta] + wire leaves — the PR-6 byte-plane
@@ -520,6 +538,10 @@ class LearnerServer:
         # replay tier uses it to turn the learner's goodbye into a
         # final ring snapshot + clean drain.
         self._goodbye = None
+        # Reshard-notice handler (distributed.elastic): when set,
+        # KIND_RESHARD frames are routed to it instead of being a
+        # protocol error. handler(peer, epoch, shard_count, plan_json).
+        self._reshard = None
         self._idle_timeout = idle_timeout_s
         # Param wire codec (distributed.codec): keep a small ring of
         # recent published versions' wire leaves and serve an XOR-delta
@@ -592,6 +614,10 @@ class LearnerServer:
         self._sample_batches = 0
         self._sample_bytes_out = 0
         self._prio_updates = 0
+        # Elastic-fleet control plane: membership view requests
+        # answered from the registry, reshard replan notices received.
+        self._member_reqs = 0
+        self._reshards_in = 0
         # Param-staleness-at-fetch accounting (actors only, excluding
         # the first fetch): how many publishes behind a fetching actor
         # was when it asked. The mid-rollout-fetch A/B reads this as
@@ -655,6 +681,19 @@ class LearnerServer:
         client pointed at a non-replay learner fails loudly instead of
         hanging."""
         self._replay = handler
+
+    def set_reshard_handler(self, handler) -> None:
+        """Install the elastic-fleet replan hook
+        (``distributed.elastic``). Called as ``handler(peer, epoch,
+        shard_count, plan_json)`` on the connection's thread when a
+        coordinator announces a ``KIND_RESHARD`` replan (one-way;
+        ``plan_json`` is the committed ``ReshardPlan`` serialization,
+        empty string when the notice shipped bare). Without a handler
+        the frame is a protocol error — a replan aimed at a peer that
+        cannot re-point fails loudly instead of desyncing silently.
+        ``KIND_MEMBER_REQ`` needs no handler: the server answers it
+        from the hello/generation registry directly."""
+        self._reshard = handler
 
     def set_goodbye_handler(self, handler) -> None:
         """Install a hook called with a peer's ``PeerInfo`` when it
@@ -851,6 +890,10 @@ class LearnerServer:
                     self._sample_bytes_out / 1e6, 6
                 ),
                 "transport_prio_updates": self._prio_updates,
+                # Elastic-fleet control plane (KIND_MEMBER_REQ /
+                # KIND_RESHARD).
+                "transport_member_reqs": self._member_reqs,
+                "transport_reshard_notices": self._reshards_in,
                 # Mean publishes-behind at actor param fetches (first
                 # fetches excluded — "behind" is undefined before a
                 # version is held).
@@ -1220,6 +1263,56 @@ class LearnerServer:
                         else None
                     )
                     handler(peer, kind, tag, arrays, reply)
+                elif kind == KIND_MEMBER_REQ:
+                    # Answered straight from the hello/generation
+                    # registry — no handler to install, every learner
+                    # can serve its membership view.
+                    with self._reg_lock:
+                        self._member_reqs += 1
+                        rows = np.asarray(
+                            [
+                                [
+                                    cc.actor_id, cc.generation,
+                                    cc.role, cc.caps, cc.epoch,
+                                ]
+                                for cc in self._conns.values()
+                            ],
+                            np.int64,
+                        ).reshape(-1, 5)
+                        meta = np.asarray(
+                            [self._hellos, self._epoch], np.int64
+                        )
+                    self._send(c, KIND_MEMBER_VIEW, tag, (rows, meta))
+                elif kind == KIND_RESHARD:
+                    handler = self._reshard
+                    if handler is None:
+                        # A replan aimed at a peer that cannot
+                        # re-point must fail loudly, not desync.
+                        raise ConnectionError(
+                            "reshard notice (kind "
+                            f"{kind}) but no reshard handler is "
+                            "installed on this server"
+                        )
+                    with self._reg_lock:
+                        self._reshards_in += 1
+                        peer = PeerInfo(
+                            c.cid, c.actor_id, c.generation, c.role,
+                            c.caps, c.epoch,
+                        )
+                    rmeta = (
+                        np.asarray(arrays[0], np.int64).reshape(-1)
+                        if arrays else np.zeros(2, np.int64)
+                    )
+                    plan_json = (
+                        bytes(
+                            np.asarray(arrays[1], np.uint8)
+                        ).decode("utf-8")
+                        if len(arrays) > 1 and arrays[1].size
+                        else ""
+                    )
+                    handler(
+                        peer, int(rmeta[0]), int(rmeta[1]), plan_json
+                    )
                 elif kind == KIND_GET_PARAMS:
                     # tag = the version the client already holds (0 =
                     # none / legacy client): ring hit -> delta frame.
@@ -1699,6 +1792,55 @@ class ActorClient:
         arrays = [np.asarray(a) for a in arrays]
         n = int(arrays[0].shape[0]) if arrays else 0
         self._send(KIND_PRIO_UPDATE, (int(epoch) << EPOCH_SHIFT) | n, arrays)
+
+    def membership_request(
+        self, seq: int = 0
+    ) -> Tuple[List[Tuple[int, int, int, int, int]], int, int]:
+        """Ask the learner for its live membership view (answered from
+        the hello/generation registry; no server-side handler needed).
+        Returns ``(rows, hellos, epoch)`` where each row is
+        ``(actor_id, generation, role, caps, epoch)`` — the raw
+        material ``elastic.MembershipView.refresh`` diffs on a
+        coordinator that is not co-resident with the learner."""
+        self._send(KIND_MEMBER_REQ, seq)
+        kind, rtag, out = self._await_reply()
+        if kind != KIND_MEMBER_VIEW:
+            raise ConnectionError(
+                f"expected MEMBER_VIEW, got kind {kind}"
+            )
+        if rtag != seq:
+            raise ConnectionError(
+                f"membership reply for seq {rtag}, expected {seq}"
+            )
+        rows = (
+            np.asarray(out[0], np.int64).reshape(-1, 5)
+            if out and out[0].size else np.zeros((0, 5), np.int64)
+        )
+        meta = (
+            np.asarray(out[1], np.int64).reshape(-1)
+            if len(out) > 1 else np.zeros(2, np.int64)
+        )
+        return (
+            [tuple(int(v) for v in row) for row in rows],
+            int(meta[0]),
+            int(meta[1]),
+        )
+
+    def announce_reshard(
+        self, epoch: int, shard_count: int, plan_json: str = ""
+    ) -> None:
+        """One-way replan notice: the fencing-epoch bump that IS the
+        reshard, plus the new shard count and (optionally) the full
+        committed ``ReshardPlan`` JSON. No reply — the peer's re-point
+        through the redirector tier is the observable effect, and a
+        send failure surfaces as ``ConnectionError`` so the resilient
+        wrapper reconnects (re-announcing a committed plan is
+        idempotent: epochs only move forward)."""
+        meta = np.asarray([int(epoch), int(shard_count)], np.int64)
+        blob = np.frombuffer(
+            plan_json.encode("utf-8"), np.uint8
+        ).copy()
+        self._send(KIND_RESHARD, int(epoch), (meta, blob))
 
     def fetch_params(self) -> Tuple[int, List[np.ndarray]]:
         """Fetch the newest published params, reporting the version

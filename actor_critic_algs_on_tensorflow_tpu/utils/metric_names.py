@@ -31,10 +31,15 @@ SERVE = "serve_"
 DEVICE = "device_"
 SHARD = "shard"          # shard{N}_* dynamic keys + shard_* statics
 REPLAY = "replay_"       # prioritized replay tier (distributed/replay.py)
+ELASTIC = "elastic_"     # live membership / resharding (distributed/elastic.py)
+AUTOSCALER = "autoscaler_"   # fleet-scale policy (distributed/elastic.py)
 SERVE_ACT = SERVE + "act_"   # LatencyStats.summary prefix (serving tier)
 REPLAY_SAMPLE = REPLAY + "sample_"  # LatencyStats.summary prefix (draws)
 
-FAMILY_PREFIXES = (TRANSPORT, PIPELINE, SERVE, DEVICE, SHARD, REPLAY)
+FAMILY_PREFIXES = (
+    TRANSPORT, PIPELINE, SERVE, DEVICE, SHARD, REPLAY, ELASTIC,
+    AUTOSCALER,
+)
 
 # --- registry: family key -> one-line provenance ---------------------
 # ``*`` covers runtime-formatted segments (shard indices). Keep keys
@@ -62,6 +67,8 @@ METRIC_NAMES: dict = {
     TRANSPORT + "sample_batches": "replay-tier prioritized batches out",
     TRANSPORT + "sample_mb_out": "replay-tier batch payload MB out",
     TRANSPORT + "prio_updates": "replay-tier priority updates received",
+    TRANSPORT + "member_reqs": "membership-view requests answered",
+    TRANSPORT + "reshard_notices": "elastic replan notices received",
     TRANSPORT + "param_staleness_mean": "mean publishes-behind at fetch",
     TRANSPORT + "pings": "heartbeat probes received",
     TRANSPORT + "hellos": "identity announcements received",
@@ -102,6 +109,8 @@ METRIC_NAMES: dict = {
     SERVE + "reply_failures": "replies to already-gone connections",
     SERVE + "param_swaps": "in-process serving weight swaps",
     SERVE + "lanes": "live per-actor lanes",
+    SERVE + "lane_retires": "lanes retired on actor goodbyes "
+                            "(elastic leave)",
     SERVE_ACT + "count": "act latency samples",
     SERVE_ACT + "mean_ms": "act latency mean",
     SERVE_ACT + "p50_ms": "act latency p50",
@@ -144,6 +153,8 @@ METRIC_NAMES: dict = {
     REPLAY + "actor_respawns": "env-stepper actor processes respawned",
     REPLAY + "batch_rejects": "sampled batches off the expected layout",
     REPLAY + "shards": "replay shard count (log attribution)",
+    REPLAY + "ingest_tps": "replay ingest throughput (autoscaler "
+                           "low-watermark input; bench ledger column)",
     # -- replay_* durability / failover (PR 14: ring snapshots,
     # learner checkpoint/resume, warm-standby fencing)
     REPLAY + "snapshots": "ring snapshots a shard wrote to disk",
@@ -162,6 +173,29 @@ METRIC_NAMES: dict = {
                             "takeover/resume)",
     REPLAY + "shards_restoring": "shards currently loading a ring "
                                  "snapshot",
+    # -- elastic_*: live membership + epoch-fenced resharding
+    # (distributed/elastic.py MembershipView / ReshardCoordinator,
+    # surfaced through the off-policy learner loop)
+    ELASTIC + "fleet": "live actors in the membership view",
+    ELASTIC + "joins": "actors that joined the fleet at runtime",
+    ELASTIC + "leaves": "actors that left (or were lost) at runtime",
+    ELASTIC + "rejoins": "actors that rejoined under a newer "
+                         "generation",
+    ELASTIC + "membership_version": "membership view version (bumps "
+                                    "per fleet change)",
+    ELASTIC + "reshards": "epoch-fenced reshard events completed",
+    ELASTIC + "moved_actors": "actors moved by the last rebalance",
+    ELASTIC + "plan_epoch": "fencing epoch of the committed shard "
+                            "plan",
+    # -- autoscaler_*: threshold policy decisions
+    # (distributed/elastic.py Autoscaler)
+    AUTOSCALER + "decisions": "policy evaluations taken",
+    AUTOSCALER + "scale_ups": "scale-up decisions issued",
+    AUTOSCALER + "scale_downs": "scale-down decisions issued",
+    AUTOSCALER + "holds": "evaluations that held the fleet size",
+    AUTOSCALER + "target_actors": "current fleet-size target",
+    AUTOSCALER + "cooldown_active": "1 while the post-decision "
+                                    "cooldown holds",
     REPLAY_SAMPLE + "count": "sample-draw latency samples",
     REPLAY_SAMPLE + "mean_ms": "sample-draw latency mean",
     REPLAY_SAMPLE + "p50_ms": "sample-draw latency p50",

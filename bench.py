@@ -86,6 +86,14 @@ single-process end-to-end steps/sec comparison. Merged under
 ``"replay"`` with the required key set pinned by
 ``analysis/bench_schema.py`` (scripts/replay_bench.py owns the
 helpers; ``BENCH_REPLAY_E2E=0`` skips the heavy e2e leg).
+
+Optional elastic-fleet leg (``BENCH_ELASTIC=1``): a subprocess runs
+the chaos-ramp drill — actor fleet ramped 4->32->8 by the autoscaler
+while the replay tier is resharded twice under epoch fencing, with a
+mid-run ChaosProxy link flap and exact row accounting. Merged under
+``"elastic"`` with the required key set pinned by
+``analysis/bench_schema.py`` (scripts/elastic_bench.py owns the
+drill).
 """
 
 from __future__ import annotations
@@ -534,6 +542,20 @@ def measure_replay() -> dict:
     )
 
 
+def measure_elastic() -> dict:
+    """Elastic-fleet leg (scripts/elastic_bench.py owns the drill):
+    autoscaler chaos ramp 4->32->8 with two epoch-fenced reshards,
+    a ChaosProxy link flap, and exact row accounting — returns the
+    drill's verdict dict (desyncs, epochs_monotonic, dip, ...)."""
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts"),
+    )
+    import elastic_bench as elb
+
+    return elb.bench()
+
+
 def _notify_latencies_ms(cpb, versions) -> list:
     """publish() -> fetch-complete latencies (ms); the harness itself
     lives in controlplane_bench (single source of truth)."""
@@ -610,6 +632,15 @@ def main() -> int:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         try:
             print(json.dumps(measure_replay()))
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+            return 1
+        return 0
+
+    if len(sys.argv) > 1 and sys.argv[1] == "--measure-elastic":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        try:
+            print(json.dumps(measure_elastic()))
         except Exception:
             traceback.print_exc(file=sys.stderr)
             return 1
@@ -849,6 +880,27 @@ def main() -> int:
             sys.stderr.write(
                 "[bench] replay leg failed\n"
                 + (rchild.stderr[-2000:] if rchild is not None else "")
+            )
+    if os.environ.get("BENCH_ELASTIC"):
+        echild = None
+        try:
+            echild = subprocess.run(
+                [
+                    sys.executable, os.path.abspath(__file__),
+                    "--measure-elastic",
+                ],
+                capture_output=True,
+                text=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                timeout=int(os.environ.get("BENCH_CHILD_TIMEOUT", 900)),
+            )
+            payload["elastic"] = json.loads(
+                echild.stdout.strip().splitlines()[-1]
+            )
+        except Exception:
+            sys.stderr.write(
+                "[bench] elastic leg failed\n"
+                + (echild.stderr[-2000:] if echild is not None else "")
             )
     if os.environ.get("BENCH_SERVE"):
         schild = None

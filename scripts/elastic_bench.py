@@ -1,0 +1,565 @@
+"""Elastic-fleet chaos-ramp bench: ramp the actor fleet 4 -> 32 -> 8
+mid-run with epoch-fenced reshards along the way, and report the
+drill's verdicts as the ``BENCH_ELASTIC`` ledger leg.
+
+One leg, four overlapping stresses on a REAL wire fleet (in-process
+threads, production ``LearnerServer`` + ``ReplayShardService`` path):
+
+  - ``ramp``: the ``Autoscaler`` drives the fleet geometrically
+    (4 -> 8 -> 16 -> 32 on synthetic starvation, 32 -> 16 -> 8 back on
+    backlog) while every join/leave flows through ``MembershipView``
+    and ``rebalance`` — surviving actors must not move on a pure
+    fleet-size change (``moved_actors`` reports the total).
+  - ``reshard`` (twice: 2 -> 3 at peak fleet, 3 -> 2 after the
+    scale-down, so the committed-epoch ledger actually exercises
+    monotonicity): plan staged in the ``PlanStore``, pushes quiesced,
+    rings re-dealt with ``reshard_rings`` (checked BYTE-IDENTICAL
+    across two invocations, with a pinned stratified draw compared
+    across two independent re-applications), new servers brought up
+    from the synthetic cuts, plan committed. The SIGKILL window is
+    probed between stage and commit: a fresh ``PlanStore`` must still
+    resolve the OLD plan.
+  - ``flap``: one link is paused/resumed through ``ChaosProxy``
+    mid-stream (no teardown) — every row pushed through the flap must
+    still land (TCP backpressure, not loss).
+  - ``accounting``: at the end, the surviving shards' ``inserted``
+    meters must sum to exactly the rows the fleet pushed — any gap is
+    a desync.
+
+``desyncs`` counts every violated invariant (0 is the only passing
+value); ``epochs_monotonic`` walks the plan store's committed ledger;
+``throughput_dip_frac`` compares ingest in the reshard-spanning window
+against the steady window just before it. ``cpu_limited`` flags hosts
+where the fleet timeshares too few cores for the dip bound to mean
+anything (BENCH_SHARD discipline).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import numpy as np
+
+
+def _cpu_budget() -> int:
+    try:
+        return len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        return os.cpu_count() or 1
+
+
+def _transition_rows(rng, rows: int, obs_dim: int, action_dim: int):
+    return [
+        rng.standard_normal((rows, obs_dim)).astype(np.float32),
+        rng.standard_normal((rows, action_dim)).astype(np.float32),
+        rng.standard_normal(rows).astype(np.float32),
+        rng.standard_normal((rows, obs_dim)).astype(np.float32),
+        (rng.random(rows) < 0.01).astype(np.float32),
+    ]
+
+
+def _serve_shard(shard):
+    """Put an existing ``PrioritizedReplayShard`` behind a real
+    ``LearnerServer`` (the production ingest + replay wire path)."""
+    from actor_critic_algs_on_tensorflow_tpu.distributed.replay import (
+        ReplayShardService,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+        LearnerServer,
+    )
+
+    service = ReplayShardService(shard, log=lambda m: None)
+    server = LearnerServer(
+        service.ingest, param_delta=False, log=lambda m: None
+    )
+    server.set_replay_handler(service.handle)
+    return server
+
+
+def chaos_ramp_leg(
+    *,
+    ramp=(4, 32, 8),
+    shards_before: int = 2,
+    shards_mid: int = 3,
+    shards_after: int = 2,
+    rows_per_push: int = 128,
+    obs_dim: int = 16,
+    action_dim: int = 4,
+    capacity: int = 400_000,
+    settle_s: float = 0.25,
+    window_s: float = 0.4,
+    push_interval_s: float = 0.002,
+    plan_dir=None,
+    seed: int = 0,
+) -> dict:
+    import tempfile
+
+    from actor_critic_algs_on_tensorflow_tpu.distributed.elastic import (
+        Autoscaler,
+        ElasticCoordinator,
+        MembershipView,
+        PlanStore,
+        ThresholdPolicy,
+        reshard_rings,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.distributed.replay import (
+        PrioritizedReplayShard,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.distributed.resilience import (
+        ChaosProxy,
+        ResilientActorClient,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+        CAP_REPLAY,
+        LearnerServer,
+        ROLE_ACTOR,
+    )
+
+    lo, peak, down = (int(n) for n in ramp)
+    desyncs = 0
+    notes = []
+    tmp = None
+    if plan_dir is None:
+        tmp = tempfile.TemporaryDirectory(prefix="elastic-bench-")
+        plan_dir = tmp.name
+
+    # Membership plane: actors hello here; the view diffs the registry.
+    member_server = LearnerServer(
+        lambda traj, ep, peer: False, param_delta=False,
+        log=lambda m: None,
+    )
+    membership = MembershipView(member_server)
+    store = PlanStore(plan_dir)
+    # A synthetic clock the drill advances past the cooldown between
+    # policy ticks — the ramp is geometric, not wall-clock-bound.
+    clock_now = [0.0]
+    scaler = Autoscaler(
+        ThresholdPolicy(),
+        min_actors=lo,
+        max_actors=peak,
+        cooldown_s=1.0,
+        clock=lambda: clock_now[0],
+    )
+    coord = ElasticCoordinator(
+        membership=membership, store=store, autoscaler=scaler
+    )
+
+    shard_objs = [
+        PrioritizedReplayShard(
+            capacity, alpha=0.6, seed=seed + 7919 * (k + 1)
+        )
+        for k in range(shards_before)
+    ]
+    servers = [_serve_shard(sh) for sh in shard_objs]
+
+    # Mutable fleet topology the actor threads re-read every push.
+    lock = threading.Lock()
+    topo = {
+        "gen": 0,
+        "assignment": {},
+        "endpoints": [("127.0.0.1", s.port) for s in servers],
+    }
+    gate = threading.Event()
+    gate.set()
+    stops = {}
+    counts = {}
+    threads = {}
+    frames = _transition_rows(
+        np.random.default_rng(seed), rows_per_push, obs_dim, action_dim
+    )
+
+    def actor_main(i: int):
+        mclient = ResilientActorClient(
+            "127.0.0.1", member_server.port, hello=(i, 0, ROLE_ACTOR)
+        )
+        client = None
+        local_gen = -1
+        try:
+            while not stops[i].is_set():
+                gate.wait(timeout=1.0)
+                if not gate.is_set():
+                    continue
+                with lock:
+                    gen = topo["gen"]
+                    asn = topo["assignment"].get(i)
+                    eps = list(topo["endpoints"])
+                if asn is None:
+                    time.sleep(0.002)
+                    continue
+                if gen != local_gen:
+                    if client is not None:
+                        try:
+                            client.close()
+                        except Exception:
+                            pass
+                    h, p = eps[asn]
+                    client = ResilientActorClient(
+                        h, p, hello=(i, 0, ROLE_ACTOR, CAP_REPLAY)
+                    )
+                    local_gen = gen
+                client.push_trajectory(frames, [])
+                counts[i] += rows_per_push
+                if push_interval_s > 0:
+                    time.sleep(push_interval_s)
+        finally:
+            for c in (client, mclient):
+                if c is not None:
+                    try:
+                        c.close()
+                    except Exception:
+                        pass
+
+    def spawn(i: int):
+        stops[i] = threading.Event()
+        counts[i] = 0
+        t = threading.Thread(target=actor_main, args=(i,), daemon=True)
+        threads[i] = t
+        t.start()
+
+    def retire(i: int):
+        stops[i].set()
+
+    extra_rows = [0]  # rows pushed outside the fleet (the flap leg)
+
+    def total_pushed() -> int:
+        return sum(counts.values()) + extra_rows[0]
+
+    def fleet_size() -> int:
+        return sum(1 for i in threads if not stops[i].is_set())
+
+    def wait_membership(n: int, deadline_s: float = 5.0) -> bool:
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < deadline_s:
+            membership.refresh()
+            if len(membership.live()) == n:
+                return True
+            time.sleep(0.01)
+        return False
+
+    def resize_to(target: int, shard_count: int) -> None:
+        cur = fleet_size()
+        if target > cur:
+            for i in range(cur, target):
+                spawn(i)
+        else:
+            # Highest ids retire first — mirrors the learner loop's
+            # scale-down and keeps the rebalance move count minimal.
+            for i in sorted(
+                (j for j in threads if not stops[j].is_set()),
+                reverse=True,
+            )[: cur - target]:
+                retire(i)
+        wait_membership(target)
+        with lock:
+            topo["assignment"] = coord.refresh_assignment(shard_count)
+            topo["gen"] += 1
+
+    def do_reshard(n_new: int) -> float:
+        """Epoch-fenced shard-count change under live ingest; returns
+        the quiesce-to-resume gap in seconds. Mutates ``shard_objs``
+        and ``servers`` in place; bumps ``desyncs`` on any violated
+        invariant."""
+        nonlocal shard_objs, servers, desyncs
+        epoch0 = coord.plan_epoch
+        epoch1 = epoch0 + 1
+        t0 = time.perf_counter()
+        gate.clear()  # quiesce pushes
+        # Drain: every in-flight push lands before the rings are cut.
+        drain_deadline = time.perf_counter() + 5.0
+        while time.perf_counter() < drain_deadline:
+            if sum(sh.inserted for sh in shard_objs) == total_pushed():
+                break
+            time.sleep(0.01)
+        else:
+            desyncs += 1
+            notes.append(
+                f"reshard->{n_new}: drain did not converge "
+                f"(inserted={sum(sh.inserted for sh in shard_objs)} "
+                f"pushed={total_pushed()})"
+            )
+        new_objs = [
+            PrioritizedReplayShard(
+                capacity, alpha=0.6,
+                seed=seed + 104729 * (epoch1 * 10 + k + 1),
+            )
+            for k in range(n_new)
+        ]
+        new_servers = [_serve_shard(sh) for sh in new_objs]
+        plan = coord.propose(
+            n_new,
+            [("127.0.0.1", s.port) for s in new_servers],
+            epoch=epoch1,
+        )
+        # SIGKILL window probe: between stage and commit, a fresh
+        # store (the restarting coordinator) must resolve the OLD
+        # plan and see the staged one as re-executable — never a
+        # hybrid.
+        probe = PlanStore(plan_dir)
+        loaded = probe.load()
+        if (loaded.epoch if loaded else 0) != epoch0:
+            desyncs += 1
+            notes.append(
+                f"reshard->{n_new}: mid-reshard store loaded "
+                f"{loaded.epoch if loaded else None}, want {epoch0}"
+            )
+        staged = probe.staged()
+        if staged is None or staged.epoch != epoch1:
+            desyncs += 1
+            notes.append(
+                f"reshard->{n_new}: staged plan missing or wrong epoch"
+            )
+        # The re-deal, twice: the transform must be byte-identical (a
+        # coordinator that died mid-move re-executes to the same
+        # rings).
+        states = reshard_rings(
+            shard_objs, n_new, epoch=epoch1, base_seed=seed + 17
+        )
+        states2 = reshard_rings(
+            shard_objs, n_new, epoch=epoch1, base_seed=seed + 17
+        )
+        for a, b in zip(states, states2):
+            keys = sorted(a) if a is not None else []
+            if (a is None) != (b is None) or any(
+                not np.array_equal(a[k], b[k]) for k in keys
+            ):
+                desyncs += 1
+                notes.append(
+                    f"reshard->{n_new}: re-deal not byte-identical"
+                )
+        pre_rows = sum(
+            int(np.count_nonzero(sh._row_ids >= 0)) for sh in shard_objs
+        )
+        pre_inserted = sum(sh.inserted for sh in shard_objs)
+        for sh, st in zip(new_objs, states):
+            sh.apply_snapshot([st])
+        if sum(sh.size for sh in new_objs) != pre_rows:
+            desyncs += 1
+            notes.append(
+                f"reshard->{n_new}: resident rows "
+                f"{sum(sh.size for sh in new_objs)} != {pre_rows}"
+            )
+        if sum(sh.inserted for sh in new_objs) != pre_inserted:
+            desyncs += 1
+            notes.append(
+                f"reshard->{n_new}: inserted meter sum "
+                f"{sum(sh.inserted for sh in new_objs)} != "
+                f"{pre_inserted}"
+            )
+        if any(sh.fence_epoch != epoch1 for sh in new_objs):
+            desyncs += 1
+            notes.append(
+                f"reshard->{n_new}: fence epoch not stamped {epoch1}"
+            )
+        # Pinned stratified draw: an independent second application
+        # must serve the identical prioritized batch (ids AND
+        # priorities) — the bit-exactness the resumable-replan
+        # contract rests on.
+        for k, st in enumerate(states):
+            twin = PrioritizedReplayShard(capacity, alpha=0.6, seed=1)
+            twin.apply_snapshot([st])
+            got = new_objs[k].sample(32, 0.4)
+            want = twin.sample(32, 0.4)
+            if (got is None) != (want is None):
+                desyncs += 1
+                notes.append(
+                    f"reshard->{n_new}: shard {k} pinned draw "
+                    f"served vs refused"
+                )
+            elif got is not None and (
+                not np.array_equal(got[1], want[1])
+                or not np.array_equal(got[2], want[2])
+            ):
+                desyncs += 1
+                notes.append(
+                    f"reshard->{n_new}: shard {k} pinned stratified "
+                    f"draw diverged"
+                )
+        coord.commit(plan)
+        for srv in servers:
+            srv.close()
+        with lock:
+            topo["endpoints"] = [
+                ("127.0.0.1", s.port) for s in new_servers
+            ]
+            topo["assignment"] = dict(plan.assignment)
+            topo["gen"] += 1
+        gate.set()
+        shard_objs, servers = new_objs, new_servers
+        return time.perf_counter() - t0
+
+    moved_total = 0
+    STARVED = {"pipeline_stall_s": 10.0, "pipeline_compute_s": 1.0}
+    BACKLOG = {"pipeline_depth": 1e6}
+
+    # --- phase A: floor fleet, steady ingest --------------------------
+    resize_to(lo, shards_before)
+    time.sleep(settle_s)
+
+    # --- phase B: autoscaler ramps up to the peak ---------------------
+    up_steps = []
+    while fleet_size() < peak:
+        clock_now[0] += 2.0
+        target = scaler.evaluate(fleet_size(), STARVED)
+        if target is None:
+            desyncs += 1  # a starved fleet must keep scaling
+            notes.append("autoscaler held on starvation signals")
+            break
+        up_steps.append(target)
+        resize_to(target, shards_before)
+        moved_total += coord.last_moved
+        time.sleep(settle_s / 2)
+
+    # Steady window right before the reshard: the dip baseline.
+    c0 = total_pushed()
+    time.sleep(window_s)
+    c1 = total_pushed()
+    steady_tps = (c1 - c0) / window_s
+
+    # --- phase B': epoch-fenced reshard at peak fleet -----------------
+    gap_s = do_reshard(shards_mid)
+    moved_total += coord.last_moved
+
+    # Reshard-spanning window vs the steady baseline: the dip.
+    span = max(window_s, gap_s + 0.05)
+    time.sleep(max(0.0, span - gap_s))
+    c2 = total_pushed()
+    span_tps = (c2 - c1) / span
+    dip_frac = (
+        max(0.0, 1.0 - span_tps / steady_tps) if steady_tps > 0 else 1.0
+    )
+
+    # --- link flap (ChaosProxy pause/resume, no teardown) -------------
+    link_flaps = 0
+    proxy = ChaosProxy("127.0.0.1", servers[0].port)
+    flap_client = ResilientActorClient(
+        "127.0.0.1", proxy.port, hello=(9_999, 0, ROLE_ACTOR, CAP_REPLAY)
+    )
+    flap_client.push_trajectory(frames, [])
+    flap_pushes = 1
+    base = shard_objs[0].inserted
+    proxy.pause()
+    done = threading.Event()
+
+    def flap_push():
+        flap_client.push_trajectory(frames, [])
+        done.set()
+
+    ft = threading.Thread(target=flap_push, daemon=True)
+    ft.start()
+    time.sleep(0.1)
+    proxy.resume()
+    link_flaps += 1
+    ft.join(timeout=5.0)
+    flap_pushes += 1 if done.is_set() else 0
+    extra_rows[0] += flap_pushes * rows_per_push
+    deadline = time.perf_counter() + 5.0
+    while (
+        shard_objs[0].inserted < base + rows_per_push
+        and time.perf_counter() < deadline
+    ):
+        time.sleep(0.01)
+    if not done.is_set() or shard_objs[0].inserted < base + rows_per_push:
+        desyncs += 1  # a paused link must delay rows, never lose them
+        notes.append("link flap lost or wedged a push")
+    flap_client.close()
+    proxy.close()
+
+    # --- phase C: autoscaler ramps back down --------------------------
+    down_steps = []
+    while fleet_size() > down:
+        clock_now[0] += 2.0
+        target = scaler.evaluate(fleet_size(), BACKLOG)
+        if target is None:
+            desyncs += 1
+            notes.append("autoscaler held on backlog signals")
+            break
+        target = max(target, down)
+        down_steps.append(target)
+        resize_to(target, shards_mid)
+        moved_total += coord.last_moved
+        time.sleep(settle_s / 2)
+
+    # --- second reshard at the shrunken fleet (merge 3 -> 2): the
+    # committed-epoch ledger now has two entries to be monotonic over.
+    do_reshard(shards_after)
+    moved_total += coord.last_moved
+    time.sleep(settle_s)
+
+    # --- teardown + final accounting ----------------------------------
+    for i in threads:
+        stops[i].set()
+    gate.set()
+    for t in threads.values():
+        t.join(timeout=10.0)
+    pushed = total_pushed()
+    deadline = time.perf_counter() + 5.0
+    while (
+        sum(sh.inserted for sh in shard_objs) != pushed
+        and time.perf_counter() < deadline
+    ):
+        time.sleep(0.01)
+    landed = sum(sh.inserted for sh in shard_objs)
+    if landed != pushed:
+        desyncs += 1
+        notes.append(f"final accounting: landed {landed} != pushed {pushed}")
+    epochs = store.epochs()
+    monotonic = bool(epochs) and all(
+        a < b for a, b in zip(epochs, epochs[1:])
+    )
+    if len(epochs) != coord.reshards:
+        desyncs += 1
+        notes.append(
+            f"committed ledger {epochs} vs {coord.reshards} reshards"
+        )
+    scaler_m = scaler.metrics()
+    for srv in servers:
+        srv.close()
+    member_server.close()
+    if tmp is not None:
+        tmp.cleanup()
+    return {
+        "ramp": f"{lo}->{peak}->{down}",
+        "reshards": int(coord.reshards),
+        "epochs_monotonic": monotonic,
+        "desyncs": int(desyncs),
+        "moved_actors": int(moved_total),
+        "throughput_dip_frac": round(float(dip_frac), 4),
+        "steady_tps": round(float(steady_tps), 1),
+        "reshard_gap_s": round(float(gap_s), 4),
+        "up_steps": up_steps,
+        "down_steps": down_steps,
+        "link_flaps": link_flaps,
+        "rows_pushed": int(pushed),
+        "rows_landed": int(landed),
+        "autoscaler_decisions": int(scaler_m["autoscaler_decisions"]),
+        "desync_notes": notes,
+    }
+
+
+def bench(*, ramp_kwargs=None) -> dict:
+    """The BENCH_ELASTIC payload (key set pinned by
+    ``analysis/bench_schema.py:ELASTIC_REQUIRED``)."""
+    out = chaos_ramp_leg(**(ramp_kwargs or {}))
+    # Threads, not processes — but the drill still wants a core per
+    # ~8 pushers plus the shard servers for the dip bound to be a
+    # scheduling-free measurement.
+    out["cpu_limited"] = _cpu_budget() < 4
+    return out
+
+
+def main() -> int:
+    import json
+
+    print(json.dumps(bench(), indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    sys.exit(main())

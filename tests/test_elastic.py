@@ -1,0 +1,596 @@
+"""Elastic fleet layer: membership, minimal-move rebalancing,
+epoch-fenced reshard plans, bit-exact ring re-splits, and the
+autoscaler (distributed/elastic.py + ShardPlan.balanced()).
+
+The heavy chaos-ramp drill (scripts/elastic_bench.py) gets one
+``slow``-marked end-to-end run at reduced scale; everything else is
+tier-1 fast and pins the pieces the drill composes.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from actor_critic_algs_on_tensorflow_tpu.distributed.elastic import (
+    Autoscaler,
+    ElasticCoordinator,
+    MembershipView,
+    PlanStore,
+    ReshardPlan,
+    ThresholdPolicy,
+    moved_actors,
+    rebalance,
+    reshard_rings,
+    write_ring_snapshot,
+)
+from actor_critic_algs_on_tensorflow_tpu.distributed.replay import (
+    PrioritizedReplayShard,
+    ReplaySnapshotter,
+)
+from actor_critic_algs_on_tensorflow_tpu.distributed.sharding import (
+    BalancedShardPlan,
+    ShardPlan,
+)
+from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+    ActorClient,
+    LearnerServer,
+    ROLE_ACTOR,
+    ROLE_LEARNER,
+)
+
+pytestmark = pytest.mark.elastic
+
+
+# --------------------------------------------------------------------
+# ShardPlan.balanced(): remainder-spread actor slices
+# --------------------------------------------------------------------
+
+
+def test_balanced_plan_slices_partition_the_fleet():
+    for shards, n in itertools.product((1, 2, 3, 5, 7), range(0, 23)):
+        plan = ShardPlan.balanced(shards)
+        assert isinstance(plan, BalancedShardPlan)
+        slices = [plan.actor_slice(n, s) for s in range(shards)]
+        # Disjoint, contiguous, covering [0, n) in order.
+        flat = [a for sl in slices for a in sl]
+        assert flat == list(range(n))
+        sizes = [len(sl) for sl in slices]
+        assert max(sizes) - min(sizes) <= 1
+        # The first n % shards slices take the extra actor.
+        if n % shards:
+            assert sizes == sorted(sizes, reverse=True)
+
+
+def test_balanced_plan_shard_of_actor_inverts_slices():
+    for shards, n in itertools.product((1, 3, 4), (1, 5, 9, 16)):
+        plan = ShardPlan.balanced(shards)
+        for a in range(n):
+            s = plan.shard_of_actor(n, a)
+            assert a in plan.actor_slice(n, s)
+
+
+def test_balanced_plan_allows_empty_slices_but_keeps_loud_batches():
+    plan = ShardPlan.balanced(4)
+    # Fleet below shard count: trailing shards own empty slices.
+    assert len(plan.actor_slice(2, 3)) == 0
+    with pytest.raises(ValueError):
+        plan.shard_of_actor(2, 2)  # id outside the fleet stays loud
+    # Compiled-shape-facing splits keep the divisibility check.
+    with pytest.raises(ValueError):
+        plan.local_parts(6)
+
+
+# --------------------------------------------------------------------
+# rebalance(): minimal-move properties
+# --------------------------------------------------------------------
+
+
+def _assert_valid(assignment, live, shards, cap):
+    assert sorted(assignment) == sorted(set(live))
+    loads = [0] * shards
+    for a, s in assignment.items():
+        assert 0 <= s < shards
+        loads[s] += 1
+    assert max(loads, default=0) <= cap
+
+
+def test_rebalance_places_every_actor_within_capacity():
+    rng = np.random.RandomState(0)
+    for _ in range(50):
+        shards = int(rng.randint(1, 6))
+        n = int(rng.randint(0, 24))
+        live = rng.choice(100, size=n, replace=False).tolist()
+        cap = -(-max(n, 1) // shards)  # ceil
+        a = rebalance(live, shards)
+        _assert_valid(a, live, shards, cap)
+
+
+def test_rebalance_single_join_moves_nobody():
+    live = list(range(8))
+    prev = rebalance(live, 4)
+    after = rebalance(live + [99], 4, prev=prev)
+    assert moved_actors(prev, after) == 0
+    assert all(after[a] == prev[a] for a in live)
+
+
+def test_rebalance_single_leave_moves_at_most_the_overflow():
+    rng = np.random.RandomState(1)
+    for _ in range(50):
+        shards = int(rng.randint(1, 5))
+        n = int(rng.randint(shards + 1, 20))
+        live = list(range(n))
+        prev = rebalance(live, shards)
+        gone = int(rng.choice(live))
+        remaining = [a for a in live if a != gone]
+        after = rebalance(remaining, shards, prev=prev)
+        cap = -(-len(remaining) // shards)
+        _assert_valid(after, remaining, shards, cap)
+        # Moves happen only to drain shards the shrunken capacity
+        # strands over the line — per-shard overflow is the floor any
+        # capacity-respecting assignment must pay.
+        overflow = sum(
+            max(0, sum(1 for a in remaining if prev[a] == s) - cap)
+            for s in range(shards)
+        )
+        assert moved_actors(prev, after) == overflow
+
+
+def test_rebalance_is_deterministic_and_keeps_survivors():
+    live = [3, 1, 4, 1, 5, 9, 2, 6]
+    a1 = rebalance(live, 3)
+    a2 = rebalance(live, 3)
+    assert a1 == a2
+    # Survivors keep their shard across a topology-preserving call.
+    again = rebalance(live, 3, prev=a1)
+    assert again == a1
+    with pytest.raises(ValueError):
+        rebalance(live, 0)
+    with pytest.raises(ValueError):
+        rebalance(live, 2, capacity=1)  # 2 shards x 1 < 7 actors
+
+
+# --------------------------------------------------------------------
+# MembershipView: joins / leaves / generation-bumped rejoins
+# --------------------------------------------------------------------
+
+
+def _row(aid, gen=0, role=ROLE_ACTOR):
+    return {"actor_id": aid, "generation": gen, "role": role}
+
+
+def test_membership_join_leave_rejoin_and_version():
+    view = MembershipView()
+    joined, left = view.refresh(rows=[_row(0), _row(1)])
+    assert (joined, left) == ([0, 1], [])
+    assert view.live() == [0, 1] and view.version == 1
+    # No change: version holds.
+    view.refresh(rows=[_row(0), _row(1)])
+    assert view.version == 1
+    # Leave.
+    joined, left = view.refresh(rows=[_row(0)])
+    assert (joined, left) == ([], [1]) and view.version == 2
+    # Generation-bumped rejoin of a KNOWN id is a rejoin, not a join.
+    view.refresh(rows=[_row(0, gen=3)])
+    assert view.rejoins == 1 and view.version == 3
+    assert view.generation_of(0) == 3
+    m = view.metrics()
+    assert m["elastic_fleet"] == 1
+    assert m["elastic_joins"] == 2
+    assert m["elastic_leaves"] == 1
+    assert m["elastic_rejoins"] == 1
+    assert m["elastic_membership_version"] == 3
+
+
+def test_membership_filters_other_roles():
+    view = MembershipView()
+    view.refresh(rows=[_row(0), _row(7, role=ROLE_LEARNER)])
+    assert view.live() == [0]
+
+
+# --------------------------------------------------------------------
+# ReshardPlan + PlanStore: stage/commit, SIGKILL resume, monotonicity
+# --------------------------------------------------------------------
+
+
+def _plan(epoch, shards=2, actors=4):
+    assignment = rebalance(list(range(actors)), shards)
+    endpoints = tuple(("127.0.0.1", 9000 + s) for s in range(shards))
+    return ReshardPlan(
+        epoch=epoch, shard_count=shards,
+        endpoints=endpoints, assignment=assignment,
+    )
+
+
+def test_reshard_plan_json_round_trip():
+    plan = _plan(5, shards=3, actors=7)
+    again = ReshardPlan.from_json(plan.to_json())
+    assert again == plan
+    with pytest.raises(ValueError):
+        ReshardPlan(epoch=-1, shard_count=1, endpoints=(), assignment={})
+    with pytest.raises(ValueError):
+        ReshardPlan(
+            epoch=0, shard_count=2, endpoints=(), assignment={0: 2}
+        )
+
+
+def test_plan_store_commit_is_the_single_durable_step(tmp_path):
+    store = PlanStore(str(tmp_path))
+    assert store.load() is None
+    p1 = _plan(1)
+    store.stage(p1)
+    # SIGKILL window: staged but never committed. A fresh store (the
+    # respawned coordinator) sees NO committed plan — the old topology
+    # — while the staged plan is visible for deterministic re-execute.
+    resumed = PlanStore(str(tmp_path))
+    assert resumed.load() is None
+    assert resumed.staged() == p1
+    store.commit(p1)
+    assert PlanStore(str(tmp_path)).load() == p1
+    assert store.staged() is None  # commit consumed the staged file
+    # Second reshard, killed after stage: resume still loads plan 1.
+    p2 = _plan(2, shards=3)
+    store.stage(p2)
+    resumed = PlanStore(str(tmp_path))
+    assert resumed.load() == p1
+    assert resumed.staged() == p2
+    # Resume may also choose the old plan and drop the droppings.
+    assert resumed.discard_staged() == 1
+    assert resumed.staged() is None
+    assert resumed.load() == p1
+
+
+def test_plan_store_epochs_never_regress(tmp_path):
+    store = PlanStore(str(tmp_path))
+    for e in (1, 2, 5):
+        store.commit(_plan(e))
+    assert store.epochs() == [1, 2, 5]
+    for bad in (0, 2, 5):
+        with pytest.raises(ValueError):
+            store.stage(_plan(bad))
+        with pytest.raises(ValueError):
+            store.commit(_plan(bad))
+    # Strictly monotonic across the whole ledger.
+    eps = store.epochs()
+    assert all(a < b for a, b in zip(eps, eps[1:]))
+
+
+# --------------------------------------------------------------------
+# reshard_rings: bit-exact split/merge through snapshot cuts
+# --------------------------------------------------------------------
+
+
+def _filled_shard(rows, capacity=256, seed=0, pri_base=1.0):
+    shard = PrioritizedReplayShard(capacity, seed=seed)
+    rng = np.random.RandomState(seed + 100)
+    obs = rng.standard_normal((rows, 4)).astype(np.float32)
+    act = rng.standard_normal((rows, 2)).astype(np.float32)
+    shard.add([obs, act])
+    # Distinct per-row priorities so the re-deal is distinguishable
+    # from a max-priority reset.
+    idx = np.arange(rows) % capacity
+    ids = shard._row_ids[idx]
+    shard.update_priorities(
+        idx, ids, pri_base + rng.uniform(size=rows)
+    )
+    return shard
+
+
+def _apply(states, capacity):
+    out = []
+    for st in states:
+        sh = PrioritizedReplayShard(capacity)
+        if st is not None:
+            sh.apply_snapshot([st])
+        out.append(sh)
+    return out
+
+
+def _canon(states):
+    return [
+        {k: v.tobytes() for k, v in sorted(st.items())}
+        for st in states
+    ]
+
+
+def test_reshard_rings_split_is_bit_exact_and_preserves_rows():
+    src = [_filled_shard(120, seed=7)]
+    cuts1 = reshard_rings(src, 3, epoch=4, base_seed=11)
+    cuts2 = reshard_rings(src, 3, epoch=4, base_seed=11)
+    assert _canon(cuts1) == _canon(cuts2)  # pure transform
+
+    new = _apply(cuts1, 256)
+    # Every resident row survives exactly once (the deal renumbers
+    # stream ids 0..m_k-1 PER new shard, as if each ring had ingested
+    # its rows natively), and the priority multiset is preserved.
+    src_ids, src_pri, _ = _resident(src[0])
+    all_pri = []
+    total = 0
+    for sh in new:
+        ids, pri, _ = _resident(sh)
+        assert sorted(ids.tolist()) == list(range(len(ids)))
+        total += len(ids)
+        all_pri.extend(pri.tolist())
+    assert total == len(src_ids)
+    assert np.allclose(
+        np.sort(all_pri), np.sort(src_pri), rtol=0, atol=0
+    )
+    # Meters: inserted-sum preserved; fencing epoch is the reshard's.
+    assert sum(sh.inserted for sh in new) == src[0].inserted
+    assert all(sh.fence_epoch == 4 for sh in new)
+
+
+def test_reshard_rings_merge_then_pinned_draw_matches_twin():
+    src = [_filled_shard(64, seed=1), _filled_shard(80, seed=2)]
+    cuts = reshard_rings(src, 2, epoch=9, base_seed=3)
+    a = _apply(cuts, 256)
+    b = _apply(cuts, 256)
+    # Twin applications of the same cuts draw identically: the rng in
+    # the cut pins the stratified stream (the drill's desync probe).
+    for sa, sb in zip(a, b):
+        for _ in range(3):
+            da = sa.sample(16, beta=0.4)
+            db = sb.sample(16, beta=0.4)
+            assert da is not None and db is not None
+            np.testing.assert_array_equal(da[1], db[1])  # ids
+            np.testing.assert_array_equal(da[2], db[2])  # priorities
+
+
+def test_reshard_rings_overflow_merge_keeps_newest_rows():
+    # Merging 150 resident rows into capacity-100 rings: ring
+    # semantics keep the NEWEST rows per new shard, exactly as if the
+    # stream had been inserted normally.
+    src = [_filled_shard(150, capacity=256, seed=5)]
+    cuts = reshard_rings(src, 1, epoch=2, base_seed=1, new_capacity=100)
+    (sh,) = _apply(cuts, 100)
+    ids, _, _ = _resident(sh)
+    assert sorted(ids.tolist()) == list(range(50, 150))
+    assert sh.inserted == src[0].inserted
+
+
+def test_reshard_rings_rejects_mismatched_layouts_and_empty_fleet():
+    a = PrioritizedReplayShard(8)
+    a.add([np.zeros((2, 3), np.float32)])
+    b = PrioritizedReplayShard(8)
+    b.add([np.zeros((2, 5), np.float32)])
+    with pytest.raises(ValueError):
+        reshard_rings([a, b], 2, epoch=1, base_seed=0)
+    with pytest.raises(ValueError):
+        reshard_rings([], 2, epoch=1, base_seed=0)
+    # Never-ingested fleet: no layout to carry — all None.
+    assert reshard_rings(
+        [PrioritizedReplayShard(8)], 3, epoch=1, base_seed=0
+    ) == [None, None, None]
+
+
+def _resident(shard):
+    with shard._lock:
+        pos = np.nonzero(shard._row_ids >= 0)[0]
+        return (
+            shard._row_ids[pos].copy(),
+            shard._tree.get(pos),
+            pos,
+        )
+
+
+def test_write_ring_snapshot_restores_through_normal_boot(tmp_path):
+    src = [_filled_shard(48, seed=3)]
+    (cut,) = reshard_rings(src, 1, epoch=6, base_seed=2)
+    d = str(tmp_path / "shard0")
+    path = write_ring_snapshot(d, cut)
+    assert path is not None and os.path.exists(path)
+    # The ordinary server boot path: ReplaySnapshotter.restore.
+    fresh = PrioritizedReplayShard(256)
+    snap = ReplaySnapshotter(d, log=lambda m: None)
+    assert snap.available()
+    assert snap.restore(fresh) > 0
+    ids, pri, _ = _resident(fresh)
+    src_ids, src_pri, _ = _resident(src[0])
+    assert len(ids) == len(src_ids)
+    assert np.allclose(np.sort(pri), np.sort(src_pri))
+    assert fresh.fence_epoch == 6
+    # state=None (empty fleet-wide ring) just creates the directory.
+    assert write_ring_snapshot(str(tmp_path / "empty"), None) is None
+    assert os.path.isdir(str(tmp_path / "empty"))
+
+
+# --------------------------------------------------------------------
+# ThresholdPolicy + Autoscaler: geometric ramp with hysteresis
+# --------------------------------------------------------------------
+
+
+def test_threshold_policy_directions():
+    pol = ThresholdPolicy(ingest_low_tps=100.0)
+    starved = {"pipeline_stall_s": 10.0, "pipeline_compute_s": 1.0}
+    overfed = {"pipeline_depth": 1e6}
+    slow_serve = {"serve_act_p99_ms": 1e4}
+    low_ingest = {"replay_ingest_tps": 5.0}
+    assert pol.decide(starved) == 1
+    assert pol.decide(low_ingest) == 1
+    assert pol.decide(overfed) == -1
+    assert pol.decide(slow_serve) == -1
+    assert pol.decide({}) == 0
+    # Starvation wins ties: an idle learner is the costlier failure.
+    assert pol.decide({**starved, **overfed}) == 1
+
+
+def test_autoscaler_geometric_ramp_with_cooldown():
+    clock = [0.0]
+    asc = Autoscaler(
+        ThresholdPolicy(), min_actors=4, max_actors=32,
+        cooldown_s=10.0, clock=lambda: clock[0],
+    )
+    starved = {"pipeline_stall_s": 10.0, "pipeline_compute_s": 1.0}
+    backlog = {"pipeline_depth": 1e6}
+    # Up-ramp doubles: 4 -> 8 -> 16 -> 32, capped there.
+    cur, steps = 4, []
+    while cur < 32:
+        clock[0] += 11.0
+        t = asc.evaluate(cur, starved)
+        if t is not None:
+            steps.append(t)
+            cur = t
+    assert steps == [8, 16, 32]
+    # Within cooldown of the last step: hold even under pressure.
+    clock[0] += 1.0
+    assert asc.evaluate(cur, starved) is None
+    assert asc.cooling()
+    # Down-ramp halves back and clamps at min.
+    down = []
+    for _ in range(5):
+        clock[0] += 11.0
+        t = asc.evaluate(cur, backlog)
+        if t is not None:
+            down.append(t)
+            cur = t
+    assert down == [16, 8, 4]
+    m = asc.metrics()
+    assert m["autoscaler_scale_ups"] == 3
+    assert m["autoscaler_scale_downs"] == 3
+    assert m["autoscaler_target_actors"] == 4
+    with pytest.raises(ValueError):
+        Autoscaler(ThresholdPolicy(), min_actors=4, max_actors=2)
+
+
+# --------------------------------------------------------------------
+# ElasticCoordinator: the facade the learner loop / drill holds
+# --------------------------------------------------------------------
+
+
+class _FakeServer:
+    """connections() stand-in so the coordinator's internal refresh()
+    calls see a controllable fleet."""
+
+    def __init__(self):
+        self.rows = []
+
+    def connections(self):
+        return list(self.rows)
+
+
+def test_coordinator_reshard_cycle_and_resume(tmp_path):
+    srv = _FakeServer()
+    srv.rows = [_row(a) for a in range(6)]
+    view = MembershipView(srv)
+    coord = ElasticCoordinator(
+        membership=view, store=PlanStore(str(tmp_path))
+    )
+    assert coord.plan_epoch == 0
+    coord.refresh_assignment(2)
+    base = coord.assignment()
+    assert sorted(base) == list(range(6))
+    # Propose stages (not yet authoritative), commit flips the epoch.
+    eps = (("127.0.0.1", 9000), ("127.0.0.1", 9001), ("127.0.0.1", 9002))
+    plan = coord.propose(3, eps, epoch=1)
+    assert coord.plan_epoch == 0 and coord.reshards == 0
+    coord.commit(plan)
+    assert coord.plan_epoch == 1 and coord.reshards == 1
+    m = coord.metrics()
+    assert m["elastic_reshards"] == 1
+    assert m["elastic_plan_epoch"] == 1
+    # A respawned coordinator resumes the committed topology.
+    again = ElasticCoordinator(
+        membership=view, store=PlanStore(str(tmp_path))
+    )
+    assert again.plan_epoch == 1
+    assert again.assignment() == plan.assignment
+    # Membership churn without an epoch bump: refresh_assignment.
+    srv.rows = [_row(a) for a in range(5)]
+    again.refresh_assignment(3)
+    assert again.plan_epoch == 1
+    assert sorted(again.assignment()) == list(range(5))
+
+
+# --------------------------------------------------------------------
+# Wire kinds: membership view request, reshard replan notice
+# --------------------------------------------------------------------
+
+
+def test_membership_and_reshard_wire_kinds():
+    import time
+
+    server = LearnerServer(lambda traj, ep: None, log=lambda m: None)
+    try:
+        c0 = ActorClient(
+            "127.0.0.1", server.port, hello=(0, 1, ROLE_ACTOR)
+        )
+        c1 = ActorClient(
+            "127.0.0.1", server.port, hello=(3, 2, ROLE_ACTOR)
+        )
+        # Membership answered straight from the registry — no handler.
+        rows, hellos, epoch = c1.membership_request(seq=5)
+        seen = {(r[0], r[1]) for r in rows if r[0] >= 0}
+        assert {(0, 1), (3, 2)} <= seen
+        assert hellos >= 2 and epoch == 0
+        # The reply rows are exactly what MembershipView diffs.
+        view = MembershipView()
+        view.refresh(rows=[
+            {"actor_id": r[0], "generation": r[1], "role": r[2]}
+            for r in rows
+        ])
+        assert {0, 3} <= set(view.live())
+        # A replan notice routes to the installed handler with the
+        # committed plan intact.
+        got = []
+        server.set_reshard_handler(
+            lambda peer, ep, shards, plan_json: got.append(
+                (ep, shards, plan_json)
+            )
+        )
+        plan = _plan(7, shards=2)
+        c0.announce_reshard(7, 2, plan.to_json())
+        deadline = time.monotonic() + 5.0
+        while not got and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert got, "reshard notice never reached the handler"
+        ep, shards, plan_json = got[0]
+        assert (ep, shards) == (7, 2)
+        assert ReshardPlan.from_json(plan_json) == plan
+        m = server.metrics()
+        assert m["transport_member_reqs"] == 1
+        assert m["transport_reshard_notices"] == 1
+    finally:
+        server.close()
+
+
+# --------------------------------------------------------------------
+# The chaos-ramp drill end-to-end (reduced scale; slow leg)
+# --------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_ramp_drill_small(tmp_path):
+    sys.path.insert(
+        0,
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts",
+        ),
+    )
+    import elastic_bench as elb
+
+    out = elb.chaos_ramp_leg(
+        ramp=(2, 8, 4),
+        shards_before=1,
+        shards_mid=2,
+        shards_after=1,
+        rows_per_push=32,
+        capacity=50_000,
+        settle_s=0.1,
+        window_s=0.15,
+        plan_dir=str(tmp_path),
+        seed=1,
+    )
+    assert out["desyncs"] == 0, out["desync_notes"]
+    assert out["epochs_monotonic"] is True
+    assert out["reshards"] == 2
+    assert out["ramp"] == "2->8->4"
+    assert out["up_steps"] == [4, 8]
+    assert out["down_steps"] == [4]
+    assert out["rows_pushed"] == out["rows_landed"] > 0
+    assert out["link_flaps"] == 1

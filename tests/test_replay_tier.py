@@ -427,11 +427,19 @@ def test_cli_replay_flags_validate():
             parse(["--algo", "impala", "--replay-servers", "2"]),
             "impala", None, None,
         )
-    with pytest.raises(SystemExit, match="divide"):
+    # PR 16: non-divisible fleets are legal now (ShardPlan.balanced
+    # spreads the remainder) — the refusal that remains on the
+    # elastic path is autoscaling without a replay tier.
+    with pytest.raises(SystemExit, match="requires --replay-servers"):
+        cli._run(
+            parse(["--algo", "ddpg", "--autoscale", "2:8"]),
+            "ddpg", None, None,
+        )
+    with pytest.raises(SystemExit, match="--autoscale"):
         cli._run(
             parse([
                 "--algo", "ddpg", "--replay-servers", "2",
-                "--replay-actors", "3",
+                "--autoscale", "8:2",
             ]),
             "ddpg", None, None,
         )
