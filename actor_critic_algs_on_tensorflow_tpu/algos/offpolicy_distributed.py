@@ -304,11 +304,22 @@ def paced_update_target(
     return int(total_env_steps * update_ratio)
 
 
-def _build_wire_update(parts, accel):
+def _build_wire_update(parts, accel, donate: bool = False):
     """jit(shard_map) of one ``update_batch`` step over a 1-device
     mesh on the accelerator (the update math pmean's over the data
     axis, so it needs the mesh ctx — same shape as the host-async
-    loop's update program)."""
+    loop's update program).
+
+    ``donate=True`` is the pipelined loop's second compilation: the
+    carry (params, opt_state) and the consumed (batch, weights)
+    buffers are donated so XLA updates in place instead of holding
+    two generations live. Safe by construction — the health sentinel
+    snapshots/restores COPIES, the key is never donated, and the
+    metrics/td outputs are fresh buffers. Donation changes buffer
+    lifetimes only, never numerics, so the depth-1 bit-identity
+    contract holds across both compilations. (On the CPU backend a
+    transferred batch may alias arena host memory; XLA then refuses
+    that donation with a warning rather than corrupting the slot.)"""
     from jax.sharding import Mesh, PartitionSpec as P
 
     from actor_critic_algs_on_tensorflow_tpu.algos.common import (
@@ -341,7 +352,8 @@ def _build_wire_update(parts, accel):
             in_specs=(P(), P(), P(), P(), P()),
             out_specs=(P(), P(), P(), P()),
             check_vma=False,
-        )
+        ),
+        donate_argnums=(0, 1, 2, 3) if donate else (),
     )
 
 
@@ -477,6 +489,7 @@ def run_offpolicy_distributed(
     )
     from actor_critic_algs_on_tensorflow_tpu.utils.metrics import (
         LatencyStats,
+        device_get_metrics,
     )
 
     parts = fns.parts
@@ -729,9 +742,22 @@ def run_offpolicy_distributed(
             replay_procs, replay_ports, actor_procs, server, group,
         ))
 
+    # -- learner-side replay pipeline (PR 17) --------------------------
+    # ``replay_pipeline=False`` keeps the serial draw->update->write-
+    # back loop; the pipelined loop prefetches a bounded window of
+    # draws across all shards, overlaps batch N+1's device transfer
+    # under batch N's update, and coalesces priority write-backs. A
+    # warm ``update_program`` (standby takeover) is used as handed
+    # over — only a fresh compilation takes the donated second form.
+    use_pipeline = bool(getattr(cfg, "replay_pipeline", False))
+    prefetch_depth = max(
+        1, int(getattr(cfg, "replay_prefetch_depth", 2))
+    )
+    prio_coalesce = bool(getattr(cfg, "replay_prio_coalesce", True))
+
     update = (
         update_program if update_program is not None
-        else _build_wire_update(parts, accel)
+        else _build_wire_update(parts, accel, donate=use_pipeline)
     )
     # PR-3 sentinel on the wire-update loop: the update program
     # already emits the in-graph ``health_finite`` bit when
@@ -764,6 +790,39 @@ def run_offpolicy_distributed(
     update_ratio = cfg.updates_per_iter / float(
         max(1, cfg.num_envs * cfg.steps_per_iter)
     )
+
+    def _pace(outstanding: int) -> bool:
+        # Issue-time pacing gate, evaluated by the prefetch workers
+        # BEFORE drawing: with ``outstanding`` draws already in flight
+        # or staged, one more draw is only allowed if a paced update
+        # will consume it — so a warming-up or paced-out learner never
+        # makes a shard serve a batch that would be discarded.
+        ins = group.inserted_total()
+        if ins < cfg.warmup_env_steps:
+            return False
+        target = int(min(ins, total_env_steps) * update_ratio)
+        return updates_done + outstanding < target
+
+    pipeline = None
+    if use_pipeline:
+        from actor_critic_algs_on_tensorflow_tpu.data.replay_pipeline import (  # noqa: E501
+            ReplayPipeline,
+        )
+
+        pipeline = ReplayPipeline(
+            group,
+            batch_size=cfg.batch_size,
+            beta=cfg.per_beta,
+            pace=_pace,
+            depth=prefetch_depth,
+            coalesce=prio_coalesce,
+            device=accel,
+            validate=batch_ok,
+            part_specs=[
+                ((cfg.batch_size,) + shape, dtype)
+                for shape, dtype in leaf_specs
+            ],
+        )
     # Checkpoint pacing: step id = the GLOBAL transition meter, so the
     # learner checkpoints and the replay-ring snapshots (stamped with
     # the same meter via the per-shard ``inserted`` watermark) name
@@ -818,7 +877,10 @@ def run_offpolicy_distributed(
     actor_respawns = 0
     batch_rejects = 0
     history: list = []
-    m_host: Dict[str, float] = {}
+    # Device-side metrics of the newest update; materialized ONLY at
+    # log boundaries (one transfer for the whole dict) — the old
+    # per-update ``{k: float(v)}`` forced a host sync every iteration.
+    m_dev_last = None
     ep_returns_sum, ep_count = 0.0, 0
     t_last_log = time.perf_counter()
     inserted_last_log = 0
@@ -849,6 +911,14 @@ def run_offpolicy_distributed(
             # the respawn needs no port report, so it never blocks
             # the learner loop.
             replay_procs[k] = spawn_replay(k, bind_port=replay_ports[k])
+            if pipeline is not None:
+                # A prefetch worker may be blocked mid-draw against
+                # the dead process, riding out its retry deadline.
+                # Abort it NOW (lock-free): the worker drops the draw
+                # (no reply ever reached the meter reconciliation, so
+                # nothing is double-counted) and reissues against the
+                # respawn.
+                group.interrupt(k)
             # Drop this learner's half-open link to the dead process
             # NOW: left alone, the first post-restore draw would fault
             # on it, burn part of the short per-draw retry deadline,
@@ -970,62 +1040,131 @@ def run_offpolicy_distributed(
                 drain_tier = True
                 break
             did_work = False
-            for _ in range(max(1, cfg.updates_per_iter)):
-                # Gate BEFORE drawing: a warming-up or paced-out
-                # learner must not make a shard serve (and ship) a
-                # batch it will discard — the idle path refreshes its
-                # meters with the zero-row status probe instead.
+            if pipeline is not None:
+                # Pipelined burst: the prefetch workers own the draw
+                # gate (``_pace`` at issue time), so the runner only
+                # mirrors the serial gate to pick the idle path fast —
+                # gate-closed implies no draw is in flight (pacing
+                # capped them) and nothing is staged.
                 target_updates = int(
                     min(inserted, total_env_steps) * update_ratio
                 )
-                if (
-                    inserted < cfg.warmup_env_steps
-                    or updates_done >= target_updates
-                ):
-                    break
-                t0 = time.perf_counter()
-                batch = group.sample(cfg.batch_size, cfg.per_beta)
-                sample_lat.add_s(time.perf_counter() - t0)
-                inserted = group.inserted_total()
-                if batch is None:
-                    break
-                if not batch_ok(batch.leaves):
-                    batch_rejects += 1
-                    continue
-                b = jax.tree_util.tree_unflatten(
-                    tr_def,
-                    [jax.device_put(x, accel) for x in batch.leaves],
+                gate_open = (
+                    inserted >= cfg.warmup_env_steps
+                    and updates_done < target_updates
                 )
-                w = jax.device_put(batch.weights, accel)
-                ukey = parts.update_key_fn(
-                    jax.random.fold_in(k_updates, updates_done)
-                )
-                params, opt_state, m_dev, td = update(
-                    params, opt_state, b, w, ukey
-                )
-                if sentinel is not None:
-                    # Delayed mode checks the PREVIOUS update's (long
-                    # retired) guard bit — no stall on the dispatch
-                    # above; a trip rolls (params, opt_state) back and
-                    # the next publish re-points the fleet.
-                    carry = sentinel.after_step(
-                        updates_done, _Carry(params, opt_state), m_dev
-                    )
-                    params, opt_state = carry.params, carry.opt_state
-                group.update_priorities(
-                    batch.shard_idx,
-                    batch.ids,
-                    batch.indices,
-                    np.asarray(td),
-                )
-                m_host = {k: float(v) for k, v in m_dev.items()}
-                updates_done += 1
-                did_work = True
-            if did_work:
-                publish()
+                if gate_open:
+                    for _ in range(max(1, cfg.updates_per_iter)):
+                        t0 = time.perf_counter()
+                        pb = pipeline.get(timeout=0.25)
+                        sample_lat.add_s(time.perf_counter() - t0)
+                        if pb is None:
+                            break
+                        b = jax.tree_util.tree_unflatten(
+                            tr_def, pb.leaves
+                        )
+                        ukey = parts.update_key_fn(
+                            jax.random.fold_in(k_updates, updates_done)
+                        )
+                        params, opt_state, m_dev, td = update(
+                            params, opt_state, b, pb.weights, ukey
+                        )
+                        updates_done += 1
+                        # Counted first, THEN the credit frees: the
+                        # dispatch above is async, so the next draw
+                        # still overlaps this update's compute; the
+                        # slot itself stays pinned until a worker
+                        # blocks on m_dev (never donated).
+                        pipeline.mark_consumed(pb, m_dev)
+                        if sentinel is not None:
+                            carry = sentinel.after_step(
+                                updates_done - 1,
+                                _Carry(params, opt_state), m_dev,
+                            )
+                            params, opt_state = (
+                                carry.params, carry.opt_state
+                            )
+                        pipeline.write_back(pb.sampled, td)
+                        m_dev_last = m_dev
+                        did_work = True
+                    inserted = group.inserted_total()
+                if did_work:
+                    # One coalesced prio frame per shard per burst:
+                    # staleness is bounded by the burst length plus
+                    # the one-step TD token delay.
+                    pipeline.flush_priorities()
+                    publish()
+                else:
+                    group.poll_meters()
+                    time.sleep(0.02)
             else:
-                group.poll_meters()
-                time.sleep(0.02)
+                for _ in range(max(1, cfg.updates_per_iter)):
+                    # Gate BEFORE drawing: a warming-up or paced-out
+                    # learner must not make a shard serve (and ship) a
+                    # batch it will discard — the idle path refreshes
+                    # its meters with the zero-row status probe
+                    # instead.
+                    target_updates = int(
+                        min(inserted, total_env_steps) * update_ratio
+                    )
+                    if (
+                        inserted < cfg.warmup_env_steps
+                        or updates_done >= target_updates
+                    ):
+                        break
+                    t0 = time.perf_counter()
+                    batch = group.sample(cfg.batch_size, cfg.per_beta)
+                    sample_lat.add_s(time.perf_counter() - t0)
+                    inserted = group.inserted_total()
+                    if batch is None:
+                        break
+                    if not batch_ok(batch.leaves):
+                        batch_rejects += 1
+                        continue
+                    b = jax.tree_util.tree_unflatten(
+                        tr_def,
+                        [
+                            jax.device_put(x, accel)
+                            for x in batch.leaves
+                        ],
+                    )
+                    w = jax.device_put(batch.weights, accel)
+                    ukey = parts.update_key_fn(
+                        jax.random.fold_in(k_updates, updates_done)
+                    )
+                    params, opt_state, m_dev, td = update(
+                        params, opt_state, b, w, ukey
+                    )
+                    if sentinel is not None:
+                        # Delayed mode checks the PREVIOUS update's
+                        # (long retired) guard bit — no stall on the
+                        # dispatch above; a trip rolls (params,
+                        # opt_state) back and the next publish
+                        # re-points the fleet.
+                        carry = sentinel.after_step(
+                            updates_done, _Carry(params, opt_state),
+                            m_dev,
+                        )
+                        params, opt_state = (
+                            carry.params, carry.opt_state
+                        )
+                    group.update_priorities(
+                        batch.shard_idx,
+                        batch.ids,
+                        batch.indices,
+                        np.asarray(td),
+                    )
+                    # Metrics stay DEVICE-side until a log tick needs
+                    # them: per-update float() materialization was a
+                    # hidden host sync on every iteration.
+                    m_dev_last = m_dev
+                    updates_done += 1
+                    did_work = True
+                if did_work:
+                    publish()
+                else:
+                    group.poll_meters()
+                    time.sleep(0.02)
             inserted = group.inserted_total()
             if (
                 checkpoint_interval > 0
@@ -1099,14 +1238,21 @@ def run_offpolicy_distributed(
                     now - t_last_log, 1e-9
                 )
                 t_last_log, inserted_last_log = now, inserted
-                m = dict(m_host)
+                m = (
+                    device_get_metrics(m_dev_last)
+                    if m_dev_last is not None else {}
+                )
                 m.update(group.stats())
                 m.update(sample_lat.summary(REPLAY_SAMPLE))
                 m.update(server.metrics())
+                if pipeline is not None:
+                    m.update(pipeline.metrics())
                 m[REPLAY + "updates"] = updates_done
                 m[REPLAY + "server_restarts"] = server_restarts
                 m[REPLAY + "actor_respawns"] = actor_respawns
-                m[REPLAY + "batch_rejects"] = batch_rejects
+                m[REPLAY + "batch_rejects"] = batch_rejects + (
+                    pipeline.rejects if pipeline is not None else 0
+                )
                 m[REPLAY + "shards"] = n_replay_shards
                 m[REPLAY + "ckpt_saves"] = ckpt_saves
                 m[REPLAY + "fence_epoch"] = epoch
@@ -1127,6 +1273,19 @@ def run_offpolicy_distributed(
                 m["steps_per_sec"] = rate
                 emit_log(inserted, m, history, summary_writer, log_fn)
     finally:
+        if pipeline is not None:
+            # Stop the prefetchers before anything else touches the
+            # sample plane: an orderly exit (drain_tier) flushes the
+            # held TD tokens into final coalesced frames while the
+            # shards are alive; an abnormal exit ABORTS in-flight
+            # draws without goodbye frames — the takeover drain — so
+            # the tier stays up for the next reign to attach to.
+            try:
+                pipeline.close(flush=drain_tier)
+            except Exception as e:
+                log(
+                    f"pipeline close failed ({type(e).__name__}: {e})"
+                )
         # Final checkpoint first (the --preempt-save contract: a
         # stop_event exit must be resumable end-to-end), while every
         # shard is still up to answer the meter poll.

@@ -83,6 +83,18 @@ class SACConfig:
     autoscaler_min_actors: int = 1
     autoscaler_max_actors: int = 1_024
     autoscaler_cooldown_s: float = 30.0
+    # Learner-side replay pipeline (run_offpolicy_distributed): when
+    # replay_pipeline, prefetch workers keep up to
+    # replay_prefetch_depth prioritized draws in flight across all
+    # shards, overlap batch N+1's device transfer under batch N's
+    # update (donated second compilation), and — when
+    # replay_prio_coalesce — write priorities back asynchronously as
+    # ONE coalesced multi-entry frame per shard per burst (the TD
+    # fetch rides a one-step-delayed token). depth 1 with coalescing
+    # off reproduces the serial loop bit-identically at a fixed seed.
+    replay_pipeline: bool = True
+    replay_prefetch_depth: int = 2
+    replay_prio_coalesce: bool = True
     seed: int = 0
     num_devices: int = 0
 

@@ -950,7 +950,11 @@ class ReplayShardService:
             idx, ids, pri, weights, batch = out
             reply([meta, idx, ids, pri, weights, *batch])
         elif kind == transport.KIND_PRIO_UPDATE:
-            if len(arrays) != 3:
+            # One frame carries >= 1 (ids, indices, td) triples: the
+            # pipelined learner coalesces a tick's write-backs into
+            # one multi-entry frame per shard (the serial learner's
+            # single triple is the degenerate case).
+            if not arrays or len(arrays) % 3 != 0:
                 self._log(
                     f"malformed priority update ({len(arrays)} arrays)"
                 )
@@ -960,16 +964,19 @@ class ReplayShardService:
                 max(peer_epoch, sender_epoch)
             )
             if sender_epoch < fence:
+                # One tag fences the WHOLE coalesced frame: every
+                # entry is from the same deposed reign.
                 self.shard.note_fenced()
                 return
-            try:
-                self.shard.update_priorities(
-                    np.asarray(arrays[1], np.int64),
-                    np.asarray(arrays[0], np.int64),
-                    np.asarray(arrays[2], np.float64),
-                )
-            except ValueError as e:
-                self._log(f"rejected priority update: {e}")
+            for i in range(0, len(arrays), 3):
+                try:
+                    self.shard.update_priorities(
+                        np.asarray(arrays[i + 1], np.int64),
+                        np.asarray(arrays[i], np.int64),
+                        np.asarray(arrays[i + 2], np.float64),
+                    )
+                except ValueError as e:
+                    self._log(f"rejected priority update: {e}")
 
     def metrics(self) -> Dict[str, float]:
         return self.shard.metrics()
@@ -1244,6 +1251,15 @@ class ReplayClientGroup:
         self._clients: List[Any] = [None] * len(self._endpoints)
         self._rr = 0
         self._seq = 0
+        # Pipelined prefetch runs one drawing thread PER SHARD
+        # concurrently with the runner's meter polls: seq allocation
+        # and the meter/counter state each get a lock. Per-shard draw
+        # seqs (instead of the shared rotation seq) keep a shard's
+        # in-flight draw tags monotonic per connection, so a reissued
+        # draw after an interrupt can never match a stale echo.
+        self._seq_lock = threading.Lock()
+        self._meter_lock = threading.Lock()
+        self._shard_seqs = [0] * len(self._endpoints)
         self.draws = 0
         self.refills = 0
         self.sample_failovers = 0
@@ -1280,6 +1296,28 @@ class ReplayClientGroup:
         if not arrays:
             raise ConnectionError("empty sample reply")
         meta = np.asarray(arrays[0], np.float64).reshape(-1)
+        with self._meter_lock:
+            self._apply_meta(shard_idx, meta)
+        if len(arrays) == 1:
+            return None  # shard refilling
+        if len(arrays) < 6:
+            raise ConnectionError(
+                f"sample reply carries {len(arrays)} arrays"
+            )
+        return SampledBatch(
+            shard_idx,
+            np.asarray(arrays[1], np.int64),
+            np.asarray(arrays[2], np.int64),
+            np.asarray(arrays[3], np.float64),
+            np.asarray(arrays[4], np.float32),
+            [np.asarray(a) for a in arrays[5:]],
+        )
+
+    def _apply_meta(self, shard_idx: int, meta: np.ndarray) -> None:
+        """Fold one sample-reply meta into the per-shard meter view.
+        Caller holds ``_meter_lock``: concurrent prefetch workers fold
+        replies from different shards, and the reconciliation below is
+        read-modify-write on the cumulative meters."""
         if meta.size >= 4:
             self.shard_rows[shard_idx] = float(meta[0])
             restored = self._shard_ring_restored[shard_idx]
@@ -1316,20 +1354,6 @@ class ReplayClientGroup:
                 self.shard_inserted_last[shard_idx] = v
                 self._ep_return_sum += float(meta[2])
                 self._ep_count += int(meta[3])
-        if len(arrays) == 1:
-            return None  # shard refilling
-        if len(arrays) < 6:
-            raise ConnectionError(
-                f"sample reply carries {len(arrays)} arrays"
-            )
-        return SampledBatch(
-            shard_idx,
-            np.asarray(arrays[1], np.int64),
-            np.asarray(arrays[2], np.int64),
-            np.asarray(arrays[3], np.float64),
-            np.asarray(arrays[4], np.float32),
-            [np.asarray(a) for a in arrays[5:]],
-        )
 
     def sample(
         self, batch_size: int, beta: float
@@ -1345,29 +1369,70 @@ class ReplayClientGroup:
         n = len(self._clients)
         for k in range(n):
             shard_idx = (self._rr + k) % n
-            self._seq = (self._seq + 1) & ((1 << EPOCH_SHIFT) - 1)
+            with self._seq_lock:
+                self._seq = (self._seq + 1) & ((1 << EPOCH_SHIFT) - 1)
+                seq = self._seq
             # The tag's high bits carry this learner's fencing reign
             # (the server echoes the tag verbatim, so the seq match
             # still holds); the low 48 bits stay the per-draw seq.
-            wire_seq = (self.epoch << EPOCH_SHIFT) | self._seq
+            wire_seq = (self.epoch << EPOCH_SHIFT) | seq
             try:
                 reply = self._client(shard_idx).sample_request(
                     wire_seq, req
                 )
             except (ConnectionError, OSError):
-                self.sample_failovers += 1
+                with self._meter_lock:
+                    self.sample_failovers += 1
                 continue
             batch = self._parse(shard_idx, reply)
             if batch is None:
-                self.refills += 1
+                with self._meter_lock:
+                    self.refills += 1
                 continue
-            self.draws += 1
+            with self._meter_lock:
+                self.draws += 1
             # NEXT draw starts one past the shard that just served, so
             # the rotation spreads draws evenly across live shards.
             self._rr = (shard_idx + 1) % n
             return batch
         self._rr = (self._rr + 1) % n
         return None
+
+    def sample_shard(
+        self, shard_idx: int, batch_size: int, beta: float
+    ) -> Optional[SampledBatch]:
+        """One prioritized draw against ONE shard — the pipelined
+        prefetcher's primitive (one worker thread per shard, each
+        calling this concurrently; ``sample`` above is the serial
+        rotation). No failover walk: a dead shard RAISES
+        (``ConnectionError``/``OSError``, including the deliberate
+        ``OperationInterrupted``) and the worker decides whether to
+        reissue. ``None`` means the shard is refilling."""
+        req = [
+            np.asarray([int(batch_size)], np.int64),
+            np.asarray([float(beta)], np.float64),
+        ]
+        with self._seq_lock:
+            self._shard_seqs[shard_idx] = (
+                self._shard_seqs[shard_idx] + 1
+            ) & ((1 << EPOCH_SHIFT) - 1)
+            seq = self._shard_seqs[shard_idx]
+        wire_seq = (self.epoch << EPOCH_SHIFT) | seq
+        try:
+            reply = self._client(shard_idx).sample_request(
+                wire_seq, req
+            )
+        except (ConnectionError, OSError):
+            with self._meter_lock:
+                self.sample_failovers += 1
+            raise
+        batch = self._parse(shard_idx, reply)
+        with self._meter_lock:
+            if batch is None:
+                self.refills += 1
+            else:
+                self.draws += 1
+        return batch
 
     def poll_meters(self) -> None:
         """Meter-refresh probe: a zero-row sample request, answered
@@ -1380,10 +1445,12 @@ class ReplayClientGroup:
         (the next real draw pays the failover accounting)."""
         k = self._rr
         self._rr = (self._rr + 1) % len(self._clients)
-        self._seq = (self._seq + 1) & ((1 << EPOCH_SHIFT) - 1)
+        with self._seq_lock:
+            self._seq = (self._seq + 1) & ((1 << EPOCH_SHIFT) - 1)
+            seq = self._seq
         try:
             reply = self._client(k).sample_request(
-                (self.epoch << EPOCH_SHIFT) | self._seq,
+                (self.epoch << EPOCH_SHIFT) | seq,
                 [np.asarray([0], np.int64), np.asarray([0.0])],
             )
         except (ConnectionError, OSError):
@@ -1407,7 +1474,62 @@ class ReplayClientGroup:
                 epoch=self.epoch,
             )
         except (ConnectionError, OSError):
-            self.prio_failures += 1
+            with self._meter_lock:
+                self.prio_failures += 1
+
+    def update_priorities_multi(
+        self,
+        shard_idx: int,
+        entries: Sequence[
+            Tuple[np.ndarray, np.ndarray, np.ndarray]
+        ],
+    ) -> None:
+        """Coalesced write-back: one ``KIND_PRIO_UPDATE`` frame
+        carrying every ``(ids, indices, td_abs)`` triple a tick
+        produced for this shard. One frame == one epoch tag == one
+        fence decision shard-side (all entries are from the same
+        reign by construction). Best-effort like the single-entry
+        path: a dead shard costs ``prio_failures`` and the stale
+        priorities age out."""
+        if not entries:
+            return
+        arrays: List[np.ndarray] = []
+        for ids, indices, td_abs in entries:
+            arrays.append(np.asarray(ids, np.int64))
+            arrays.append(np.asarray(indices, np.int64))
+            arrays.append(np.asarray(td_abs, np.float64))
+        try:
+            self._client(shard_idx).prio_update(
+                arrays, epoch=self.epoch
+            )
+        except (ConnectionError, OSError):
+            with self._meter_lock:
+                self.prio_failures += 1
+
+    def interrupt(self, shard_idx: Optional[int] = None) -> int:
+        """Abort in-flight operations on one shard's client (or all
+        of them) WITHOUT taking client locks: sets each client's
+        interrupt flag and hard-closes its socket so a prefetch
+        worker blocked in ``recv`` faults promptly with
+        ``OperationInterrupted`` instead of riding out the retry
+        deadline against a process that is gone (failover) or must
+        not be drawn from any more (takeover drain). The aborted
+        draw produced no reply, so the meter reconciliation never
+        saw it — nothing to un-count. Returns how many clients had
+        a live link to abort."""
+        idxs = (
+            range(len(self._clients))
+            if shard_idx is None else [int(shard_idx)]
+        )
+        n = 0
+        for k in idxs:
+            c = self._clients[k]
+            if c is None:
+                continue
+            intr = getattr(c, "interrupt", None)
+            if intr is not None and intr():
+                n += 1
+        return n
 
     def rehome(self, shard_idx: Optional[int] = None) -> int:
         """Reset the (stale) link state of a shard the runner just

@@ -116,6 +116,13 @@ class RetryPolicy:
                 sleep(delay)
 
 
+class OperationInterrupted(ConnectionError):
+    """An in-flight operation was aborted by ``interrupt()`` — a
+    deliberate cancellation, not a network fault. Never retried by the
+    policy: the caller (the replay pipeline's prefetch worker, a
+    takeover draining its draws) decides whether to reissue."""
+
+
 def endpoint_list(host, port):
     """Normalize the actor process mains' address contract: ``port``
     may be a plain port or an ordered ``(host, port)`` endpoint list
@@ -191,6 +198,7 @@ class ResilientActorClient:
         self._rng = rng if rng is not None else random.Random()
         self._lock = threading.Lock()
         self._client: ActorClient | None = None
+        self._interrupted = threading.Event()
         self._ever_connected = False
         self.reconnects = 0   # successful re-establishments after a drop
         self.retries = 0      # operations re-issued after a fault
@@ -246,17 +254,31 @@ class ResilientActorClient:
                 return fn(client)
             except LearnerShutdown:
                 raise  # orderly shutdown: terminal, not a fault
-            except (ConnectionError, OSError):
+            except (ConnectionError, OSError) as err:
                 self._drop()
                 if on_fault is not None:
                     on_fault()
+                if self._interrupted.is_set():
+                    # The fault was manufactured by ``interrupt()``
+                    # (another thread aborted our socket): surface the
+                    # cancellation instead of burning the backoff
+                    # budget reconnecting to do work nobody wants.
+                    self._interrupted.clear()
+                    raise OperationInterrupted(
+                        f"operation interrupted: {err}"
+                    ) from err
                 raise
 
         def note_retry(attempt_no, delay, err):
             self.retries += 1
 
+        # A fresh operation is never the target of an earlier
+        # interrupt: the flag aims at the op in flight WHEN interrupt()
+        # ran, and that op has since raised or returned.
+        self._interrupted.clear()
         return self._retry.execute(
-            attempt, rng=self._rng, on_retry=note_retry
+            attempt, rng=self._rng, on_retry=note_retry,
+            no_retry=(LearnerShutdown, OperationInterrupted),
         )
 
     # -- public API (mirrors ActorClient) ------------------------------
@@ -438,6 +460,26 @@ class ResilientActorClient:
             if self._client is not None:
                 self._drop()
                 return True
+        return False
+
+    def interrupt(self) -> bool:
+        """Abort the IN-FLIGHT operation from another thread — the
+        prefetch-aware failover primitive. Deliberately does NOT take
+        the serializing lock (unblocking its holder is the whole
+        point): closing the current socket makes the blocked recv
+        fault promptly, and the interrupt flag turns that fault into
+        ``OperationInterrupted`` (never retried) instead of a backoff
+        walk. The runner calls this for a shard it is about to respawn
+        (a pipeline worker may be mid-draw against the dead process,
+        holding the lock for the full retry deadline) and the pipeline
+        calls it at close/takeover so in-flight draws are dropped, not
+        waited out. No goodbye frame is sent — same contract as
+        ``reset()``. Returns True when a live link was aborted."""
+        self._interrupted.set()
+        client = self._client
+        if client is not None:
+            client.abort()
+            return True
         return False
 
     def rehome(self) -> bool:

@@ -120,13 +120,19 @@ KIND_SAMPLE_BATCH = 20   # replay server -> learner: tag = the request
 #                          [meta] + batch leaves — meta alone when the
 #                          shard cannot fill a batch yet (refill), see
 #                          distributed.replay for the meta layout
-KIND_PRIO_UPDATE = 21    # learner -> replay server: tag = n rows,
-#                          arrays = [row ids, row indices, absolute TD
-#                          errors] from the learner step. One-way
-#                          (no reply): priority updates are advisory —
-#                          a lost update costs sampling sharpness, not
-#                          correctness — so the hot path pays no extra
-#                          round trip (routed to the replay handler)
+KIND_PRIO_UPDATE = 21    # learner -> replay server: tag = TOTAL rows
+#                          across entries, arrays = one or more
+#                          (row ids, row indices, absolute TD errors)
+#                          TRIPLES from learner steps — len(arrays)
+#                          must be a positive multiple of 3 (the
+#                          pipelined learner COALESCES several updates'
+#                          write-backs into one frame per shard per
+#                          tick; a single triple is the serial form).
+#                          One-way (no reply): priority updates are
+#                          advisory — a lost update costs sampling
+#                          sharpness, not correctness — so the hot
+#                          path pays no extra round trip (routed to
+#                          the replay handler)
 KIND_MEMBER_REQ = 22     # peer -> learner: tag = request sequence —
 #                          "send me the live membership view" (the
 #                          elastic-fleet control plane; answered from
@@ -1780,17 +1786,22 @@ class ActorClient:
     def prio_update(
         self, arrays: Sequence[np.ndarray], *, epoch: int = 0
     ) -> None:
-        """One-way priority update (``[row ids, row indices, absolute
-        TD errors]``). No reply — a priority refresh is advisory, and
-        the next sample request's reply confirms the stream is
+        """One-way priority update: one or more ``(row ids, row
+        indices, absolute TD errors)`` triples in a single frame —
+        ``len(arrays)`` must be a positive multiple of 3. A single
+        triple is the serial learner's form; the pipelined learner
+        coalesces a tick's worth of write-backs into one multi-entry
+        frame per shard. No reply — a priority refresh is advisory,
+        and the next sample request's reply confirms the stream is
         healthy. A send failure still surfaces as ``ConnectionError``
         so the resilient wrapper reconnects (and may re-send: applying
         absolute priorities twice is idempotent). ``epoch`` rides the
-        tag's high bits (row count stays in the low bits) so a replay
-        shard can fence a DEPOSED learner's late updates after a
-        standby takeover bumps the reign."""
+        tag's high bits (the TOTAL row count across entries stays in
+        the low bits) so a replay shard can fence a DEPOSED learner's
+        late updates after a standby takeover bumps the reign — one
+        tag fences the whole coalesced frame."""
         arrays = [np.asarray(a) for a in arrays]
-        n = int(arrays[0].shape[0]) if arrays else 0
+        n = sum(int(a.shape[0]) for a in arrays[::3])
         self._send(KIND_PRIO_UPDATE, (int(epoch) << EPOCH_SHIFT) | n, arrays)
 
     def membership_request(
@@ -1900,7 +1911,14 @@ class ActorClient:
         self._sock.close()
 
     def abort(self) -> None:
-        """Close without the goodbye frame (connection already broken)."""
+        """Close without the goodbye frame (connection already broken,
+        or a cross-thread interrupt wants the in-flight recv to fault
+        NOW). ``shutdown`` first: closing an fd does not wake a peer
+        thread blocked in ``recv`` on it — shutdown does, with EOF."""
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:

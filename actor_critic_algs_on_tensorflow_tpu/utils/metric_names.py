@@ -35,10 +35,12 @@ ELASTIC = "elastic_"     # live membership / resharding (distributed/elastic.py)
 AUTOSCALER = "autoscaler_"   # fleet-scale policy (distributed/elastic.py)
 SERVE_ACT = SERVE + "act_"   # LatencyStats.summary prefix (serving tier)
 REPLAY_SAMPLE = REPLAY + "sample_"  # LatencyStats.summary prefix (draws)
+REPLAY_PIPELINE = REPLAY + "pipeline_"  # learner-side replay pipeline
+                                        # (data/replay_pipeline.py)
 
 FAMILY_PREFIXES = (
     TRANSPORT, PIPELINE, SERVE, DEVICE, SHARD, REPLAY, ELASTIC,
-    AUTOSCALER,
+    AUTOSCALER, REPLAY_PIPELINE,
 )
 
 # --- registry: family key -> one-line provenance ---------------------
@@ -173,6 +175,35 @@ METRIC_NAMES: dict = {
                             "takeover/resume)",
     REPLAY + "shards_restoring": "shards currently loading a ring "
                                  "snapshot",
+    # -- replay_pipeline_*: learner-side replay pipeline (PR 17:
+    # data/replay_pipeline.py TimeSplit buckets + counters, surfaced
+    # through the off-policy learner loop's log tick)
+    REPLAY_PIPELINE + "sample_wait_s": "prefetch workers blocked in "
+                                       "sample RPCs",
+    REPLAY_PIPELINE + "slot_wait_s": "workers waiting on a free arena "
+                                     "slot (token-gated reuse)",
+    REPLAY_PIPELINE + "assemble_s": "decode into arena slots",
+    REPLAY_PIPELINE + "transfer_s": "host->device transfer of staged "
+                                    "batches",
+    REPLAY_PIPELINE + "stall_s": "learner blocked on an empty "
+                                 "prefetch window",
+    REPLAY_PIPELINE + "batches": "batches staged through the window",
+    REPLAY_PIPELINE + "depth": "configured prefetch window depth",
+    REPLAY_PIPELINE + "inflight": "draws issued but not yet consumed",
+    REPLAY_PIPELINE + "rejects": "staged batches off the pinned "
+                                 "layout",
+    REPLAY_PIPELINE + "reissues": "draws reissued after an "
+                                  "interrupted/faulted in-flight draw",
+    REPLAY_PIPELINE + "prio_frames": "priority write-back frames sent",
+    REPLAY_PIPELINE + "prio_entries": "batch write-backs carried "
+                                      "across frames",
+    REPLAY_PIPELINE + "prio_frames_coalesced": "frames that coalesced "
+                                               "more than one batch",
+    REPLAY_PIPELINE + "overlap_frac": "staging hidden behind update "
+                                      "compute (0-1)",
+    REPLAY_PIPELINE + "sample_wait_share": "share of wall time the "
+                                           "learner waited on the "
+                                           "window",
     # -- elastic_*: live membership + epoch-fenced resharding
     # (distributed/elastic.py MembershipView / ReshardCoordinator,
     # surfaced through the off-policy learner loop)

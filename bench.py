@@ -511,8 +511,10 @@ def measure_shard() -> dict:
 def measure_replay() -> dict:
     """Prioritized-replay-tier leg (scripts/replay_bench.py owns the
     helpers): wire-path ingest transitions/sec, prioritized-draw
-    p50/p99, and end-to-end distributed-vs-single-process steps/sec
-    with ``cpu_limited`` discipline."""
+    p50/p99, and end-to-end steps/sec for the serial AND pipelined
+    (PR 17: prefetch + overlapped transfer + coalesced write-back)
+    learner loops vs single-process, with ``cpu_limited``
+    discipline."""
     sys.path.insert(
         0,
         os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts"),

@@ -309,16 +309,22 @@ def e2e_leg(
     n_actors: int = 2,
     env: str = "Pendulum-v1",
 ) -> dict:
-    """Distributed DDPG through the replay tier vs the single-process
-    fused iteration at the same config.
+    """Distributed DDPG through the replay tier — SERIAL learner loop
+    and PIPELINED learner loop (PR 17) — vs the single-process fused
+    iteration at the same config.
 
-    Rate = budget / wall-clock TO COMPLETION for both legs (each pays
-    its own compile; the distributed leg additionally pays process
+    Rate = budget / wall-clock TO COMPLETION for every leg (each pays
+    its own compile; the distributed legs additionally pay process
     spawn and the learner's paced update catch-up) — acting and
     learning are unsynchronized in the tier, so a windowed ingest
     rate would compare an actor burst against the fused loop's
-    steady state. On a core-starved host the ratio measures
-    timesharing, which ``cpu_limited`` flags."""
+    steady state. The pipelined leg also reports the pipeline's own
+    evidence (overlap_frac / sample_wait_share /
+    prio_frames_coalesced) from its final log record. On a
+    core-starved host the ratios measure timesharing, which
+    ``cpu_limited`` flags."""
+    import dataclasses
+
     from actor_critic_algs_on_tensorflow_tpu.algos import common
     from actor_critic_algs_on_tensorflow_tpu.algos.ddpg import (
         DDPGConfig,
@@ -326,6 +332,9 @@ def e2e_leg(
     )
     from actor_critic_algs_on_tensorflow_tpu.algos.offpolicy_distributed import (  # noqa: E501
         run_offpolicy_distributed,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.utils.metric_names import (
+        REPLAY_PIPELINE,
     )
 
     cfg = DDPGConfig(
@@ -339,11 +348,10 @@ def e2e_leg(
         total_env_steps=total_env_steps,
         num_devices=1,
     )
-    fns = make_ddpg(cfg)
 
     t0 = time.perf_counter()
     common.run_loop(
-        fns,
+        make_ddpg(cfg),
         total_env_steps=total_env_steps,
         seed=0,
         log_interval_iters=25,
@@ -352,29 +360,59 @@ def e2e_leg(
     single_wall = time.perf_counter() - t0
     single_rate = total_env_steps / max(single_wall, 1e-9)
 
-    t0 = time.perf_counter()
-    result, _ = run_offpolicy_distributed(
-        fns,
-        total_env_steps=total_env_steps,
-        seed=0,
-        n_replay_shards=n_replay_shards,
-        n_actors=n_actors,
-        log_interval=25,
-        log_fn=lambda s, m: None,
-    )
-    dist_wall = time.perf_counter() - t0
-    dist_rate = result.env_steps / max(dist_wall, 1e-9)
+    def dist_run(pipelined: bool):
+        run_cfg = dataclasses.replace(cfg, replay_pipeline=pipelined)
+        t0 = time.perf_counter()
+        result, history = run_offpolicy_distributed(
+            make_ddpg(run_cfg),
+            total_env_steps=total_env_steps,
+            seed=0,
+            n_replay_shards=n_replay_shards,
+            n_actors=n_actors,
+            log_interval=25,
+            log_fn=lambda s, m: None,
+        )
+        wall = time.perf_counter() - t0
+        return result, history, wall
+
+    serial_result, _, serial_wall = dist_run(False)
+    serial_rate = serial_result.env_steps / max(serial_wall, 1e-9)
+    pipe_result, pipe_history, pipe_wall = dist_run(True)
+    pipe_rate = pipe_result.env_steps / max(pipe_wall, 1e-9)
+
+    # Pipeline evidence from the run's last log record carrying the
+    # family (the counters/ratios are cumulative, so last wins).
+    pipe_m: dict = {}
+    for _, m in reversed(pipe_history):
+        if REPLAY_PIPELINE + "overlap_frac" in m:
+            pipe_m = m
+            break
     return {
         "total_env_steps": total_env_steps,
         "replay_shards": n_replay_shards,
         "actors": n_actors,
-        "updates": result.updates,
-        "e2e_steps_per_sec": round(dist_rate, 1),
-        "e2e_wall_s": round(dist_wall, 2),
+        "updates": serial_result.updates,
+        "pipelined_updates": pipe_result.updates,
+        "e2e_steps_per_sec": round(serial_rate, 1),
+        "e2e_wall_s": round(serial_wall, 2),
+        "e2e_pipelined_steps_per_sec": round(pipe_rate, 1),
+        "e2e_pipelined_wall_s": round(pipe_wall, 2),
         "single_steps_per_sec": round(single_rate, 1),
         "single_wall_s": round(single_wall, 2),
         "vs_single_process": round(
-            dist_rate / max(single_rate, 1e-9), 4
+            pipe_rate / max(single_rate, 1e-9), 4
+        ),
+        "vs_serial_loop": round(
+            pipe_rate / max(serial_rate, 1e-9), 4
+        ),
+        "overlap_frac": float(
+            pipe_m.get(REPLAY_PIPELINE + "overlap_frac", 0.0)
+        ),
+        "sample_wait_share": float(
+            pipe_m.get(REPLAY_PIPELINE + "sample_wait_share", 0.0)
+        ),
+        "prio_frames_coalesced": float(
+            pipe_m.get(REPLAY_PIPELINE + "prio_frames_coalesced", 0.0)
         ),
     }
 
@@ -406,9 +444,19 @@ def bench(
         out["e2e"] = e2e
         out["e2e_steps_per_sec"] = e2e["e2e_steps_per_sec"]
         out["vs_single_process"] = e2e["vs_single_process"]
+        out["e2e_pipelined_steps_per_sec"] = e2e[
+            "e2e_pipelined_steps_per_sec"
+        ]
+        out["overlap_frac"] = e2e["overlap_frac"]
+        out["sample_wait_share"] = e2e["sample_wait_share"]
+        out["prio_frames_coalesced"] = e2e["prio_frames_coalesced"]
     else:
         out["e2e_steps_per_sec"] = 0.0
         out["vs_single_process"] = 0.0
+        out["e2e_pipelined_steps_per_sec"] = 0.0
+        out["overlap_frac"] = 0.0
+        out["sample_wait_share"] = 0.0
+        out["prio_frames_coalesced"] = 0.0
     cpus = _cpu_budget()
     out["cpus"] = cpus
     # Fewer cores than learner + shards + actors: the e2e ratio

@@ -1,0 +1,760 @@
+"""Learner-side replay pipeline (ISSUE 17).
+
+Unit tier (scripted group): the issue-time pacing gate (a paced-out
+learner never makes a shard serve a discarded batch), the bounded
+prefetch window, token-gated arena-slot reuse with layout pinning,
+and the coalesced write-back's one-step TD-token delay. Wire tier:
+multi-entry ``KIND_PRIO_UPDATE`` roundtrip against a live shard and
+whole-frame fencing below a raised epoch; depth-1 lockstep
+bit-identity against a hand-rolled serial loop over identical
+preloaded shards; interrupt-mid-prefetch failover (reissued draw,
+meters never double-counted); standby-takeover drain (in-flight draws
+aborted without goodbye frames, the tier stays up for the next
+reign). Process tier (slow): SIGKILL one of two replay servers under
+a running pipeline.
+"""
+
+import multiprocessing as mp
+import os
+import signal
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from actor_critic_algs_on_tensorflow_tpu.data.replay_pipeline import (
+    ReplayPipeline,
+)
+from actor_critic_algs_on_tensorflow_tpu.distributed import transport
+from actor_critic_algs_on_tensorflow_tpu.distributed.replay import (
+    PrioritizedReplayShard,
+    ReplayClientGroup,
+    ReplayShardService,
+    SampledBatch,
+    replay_server_main,
+)
+from actor_critic_algs_on_tensorflow_tpu.distributed.resilience import (
+    ResilientActorClient,
+)
+from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+    CAP_REPLAY,
+    ROLE_ACTOR,
+    LearnerServer,
+)
+from actor_critic_algs_on_tensorflow_tpu.utils import metric_names
+from tests.helpers import PortReservation, time_limit
+
+pytestmark = pytest.mark.replay
+
+
+# --- harness ---------------------------------------------------------
+
+def _rows(lo, hi, obs_dim=3, action_dim=1):
+    """Flattened-Transition rows whose obs encode the stream position
+    (auditable content) — same layout DDPG-on-Pendulum uses."""
+    n = hi - lo
+    base = np.arange(lo, hi, dtype=np.float32)
+    return [
+        np.repeat(base[:, None], obs_dim, axis=1),          # obs
+        np.zeros((n, action_dim), np.float32),              # action
+        base.copy(),                                        # reward
+        np.repeat(base[:, None] + 0.5, obs_dim, axis=1),    # next_obs
+        np.zeros(n, np.float32),                            # terminated
+    ]
+
+
+def _start_service(capacity=4096, alpha=1.0, eps=0.0):
+    shard = PrioritizedReplayShard(capacity, alpha=alpha, eps=eps, seed=0)
+    service = ReplayShardService(shard, log=lambda m: None)
+    server = LearnerServer(
+        service.ingest, param_delta=False, log=lambda m: None
+    )
+    server.set_replay_handler(service.handle)
+    return shard, service, server
+
+
+def _push(port, rows, *, actor_id=0):
+    client = ResilientActorClient(
+        "127.0.0.1", port, hello=(actor_id, 0, ROLE_ACTOR, CAP_REPLAY)
+    )
+    try:
+        client.push_trajectory(rows, [])
+    finally:
+        client.close()
+
+
+def _mk_batch(shard_idx, tag, n=8, obs_dim=3, action_dim=1):
+    """A scripted draw whose obs carry ``tag`` (content audit across
+    slot reuse)."""
+    fill = float(tag)
+    leaves = [
+        np.full((n, obs_dim), fill, np.float32),
+        np.zeros((n, action_dim), np.float32),
+        np.full((n,), fill, np.float32),
+        np.full((n, obs_dim), fill + 0.5, np.float32),
+        np.zeros((n,), np.float32),
+    ]
+    return SampledBatch(
+        shard_idx,
+        np.arange(n, dtype=np.int64),
+        np.arange(n, dtype=np.int64) + tag * 100,
+        np.ones(n),
+        np.full(n, 0.5, np.float32),
+        leaves,
+    )
+
+
+_SPECS_8 = [
+    ((8, 3), np.float32), ((8, 1), np.float32), ((8,), np.float32),
+    ((8, 3), np.float32), ((8,), np.float32),
+]
+
+
+class _ScriptedGroup:
+    """In-memory ``ReplayClientGroup`` stand-in: serves a scripted
+    batch sequence per shard and records priority traffic, so the
+    pipeline's issue/stage/write-back mechanics are testable without
+    a wire."""
+
+    def __init__(self, batches_per_shard):
+        self._queues = [list(bs) for bs in batches_per_shard]
+        self._lock = threading.Lock()
+        self.sample_calls = 0
+        self.prio_single = []
+        self.prio_multi = []
+        self.interrupts = 0
+
+    def __len__(self):
+        return len(self._queues)
+
+    def sample_shard(self, shard_idx, batch_size, beta):
+        with self._lock:
+            self.sample_calls += 1
+            if self._queues[shard_idx]:
+                return self._queues[shard_idx].pop(0)
+        return None
+
+    def sample(self, batch_size, beta):
+        for k in range(len(self._queues)):
+            b = self.sample_shard(k, batch_size, beta)
+            if b is not None:
+                return b
+        return None
+
+    def update_priorities(self, shard_idx, ids, indices, td):
+        with self._lock:
+            self.prio_single.append(
+                (shard_idx, np.asarray(ids), np.asarray(indices),
+                 np.asarray(td))
+            )
+
+    def update_priorities_multi(self, shard_idx, entries):
+        with self._lock:
+            self.prio_multi.append((shard_idx, [
+                (np.asarray(i), np.asarray(x), np.asarray(t))
+                for i, x, t in entries
+            ]))
+
+    def interrupt(self, shard_idx=None):
+        with self._lock:
+            self.interrupts += 1
+        return 0
+
+
+def _poll(predicate, timeout=10.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.01)
+    assert predicate(), f"timed out waiting for {what}"
+
+
+# --- issue-time pacing + window --------------------------------------
+
+def test_pacing_gate_holds_draws_at_issue_time():
+    """A paced-out learner never makes a shard serve a batch it would
+    discard: the gate is honored BEFORE the draw is issued, and the
+    prefetch window caps in-flight draws at ``depth``."""
+    gate = threading.Event()
+    group = _ScriptedGroup([[_mk_batch(0, i) for i in range(6)]])
+    pipe = ReplayPipeline(
+        group, batch_size=8, beta=0.4,
+        pace=lambda outstanding: gate.is_set(),
+        depth=2, coalesce=True, part_specs=_SPECS_8,
+    )
+    try:
+        time.sleep(0.2)
+        assert group.sample_calls == 0  # gate closed: zero shard work
+        assert pipe.get(timeout=0.05) is None
+        gate.set()
+        a = pipe.get(timeout=10.0)
+        assert a is not None
+        np.testing.assert_array_equal(
+            np.asarray(a.leaves[0]), np.full((8, 3), 0.0, np.float32)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(a.weights), np.full(8, 0.5, np.float32)
+        )
+        # Window: with nothing consumed, at most ``depth`` draws are
+        # ever issued — the third waits on a freed credit.
+        b = pipe.get(timeout=10.0)
+        assert b is not None
+        time.sleep(0.2)
+        assert group.sample_calls == 2
+        pipe.mark_consumed(a, None)
+        _poll(lambda: group.sample_calls == 3, what="third draw")
+    finally:
+        pipe.close()
+
+
+def test_slot_reuse_is_deterministic_and_layout_pinned():
+    """Slots recycle through the free queue in token order; an
+    off-layout batch is rejected by the arena's first-layout-wins pin
+    (slot recycled, counted) and held batches survive later reuse."""
+    good = [_mk_batch(0, i) for i in range(4)]
+    bad = _mk_batch(0, 9, obs_dim=5)  # off-layout: wrong obs width
+    group = _ScriptedGroup([[good[0], good[1], bad, good[2], good[3]]])
+    pipe = ReplayPipeline(
+        group, batch_size=8, beta=0.4, pace=lambda o: True,
+        depth=2, coalesce=True, part_specs=_SPECS_8,
+    )
+    try:
+        a = pipe.get(timeout=10.0)
+        b = pipe.get(timeout=10.0)
+        assert a is not None and b is not None
+        assert (a.slot, b.slot) == (0, 1)  # seeded free-queue order
+        # Freeing a's credit lets the worker draw the BAD batch (slot
+        # 2, rejected+recycled) then good[2] — which reuses a's slot,
+        # token-gated on the jax array we hand back.
+        pipe.mark_consumed(a, a.weights)
+        c = pipe.get(timeout=10.0)
+        assert c is not None
+        assert pipe.rejects == 1
+        assert c.slot == a.slot
+        np.testing.assert_array_equal(
+            np.asarray(c.leaves[0]), np.full((8, 3), 2.0, np.float32)
+        )
+        # b, still pinned, was never clobbered by the reuse.
+        np.testing.assert_array_equal(
+            np.asarray(b.leaves[0]), np.full((8, 3), 1.0, np.float32)
+        )
+        pipe.mark_consumed(b, b.weights)
+        d = pipe.get(timeout=10.0)
+        assert d is not None and d.slot == 2
+        np.testing.assert_array_equal(
+            np.asarray(d.leaves[0]), np.full((8, 3), 3.0, np.float32)
+        )
+        assert pipe.batches == 4
+        m = pipe.metrics()
+        assert m[metric_names.REPLAY_PIPELINE + "rejects"] == 1
+        assert m[metric_names.REPLAY_PIPELINE + "batches"] == 4
+        # Every emitted key is a declared family member (the drift
+        # gate's contract, asserted here at runtime too).
+        for k in m:
+            assert k.startswith(metric_names.REPLAY_PIPELINE)
+            assert any(
+                k == n for n in metric_names.METRIC_NAMES
+            ), f"unregistered metric key {k}"
+    finally:
+        pipe.close()
+
+
+# --- coalesced write-back --------------------------------------------
+
+def test_write_back_coalesces_with_one_step_token_delay():
+    """Coalesce mode holds each batch's TD as a device token and only
+    materializes it one update later; ``flush_priorities`` drains the
+    held tokens into ONE multi-entry frame per shard."""
+    group = _ScriptedGroup([[], []])
+    pipe = ReplayPipeline(
+        group, batch_size=8, beta=0.4, pace=lambda o: False,
+        depth=2, coalesce=True, part_specs=_SPECS_8,
+    )
+    try:
+        b0, b1, b2 = (
+            _mk_batch(0, 0), _mk_batch(0, 1), _mk_batch(1, 2)
+        )
+        pipe.write_back(b0, jnp.full(8, 3.0))
+        pipe.write_back(b1, jnp.full(8, 5.0))
+        pipe.write_back(b2, jnp.full(8, 7.0))
+        assert not group.prio_multi  # nothing sent before the flush
+        pipe.flush_priorities()
+        by_shard = {k: entries for k, entries in group.prio_multi}
+        assert set(by_shard) == {0, 1}
+        # Shard 0 got BOTH its batches coalesced into one frame, in
+        # consumption order, TDs materialized intact.
+        assert len(by_shard[0]) == 2
+        np.testing.assert_array_equal(by_shard[0][0][2], np.full(8, 3.0))
+        np.testing.assert_array_equal(by_shard[0][1][2], np.full(8, 5.0))
+        np.testing.assert_array_equal(by_shard[0][0][0], b0.ids)
+        assert len(by_shard[1]) == 1
+        np.testing.assert_array_equal(by_shard[1][0][2], np.full(8, 7.0))
+        assert pipe.prio_frames == 2
+        assert pipe.prio_entries == 24
+        assert pipe.prio_frames_coalesced == 1  # only shard 0's
+    finally:
+        pipe.close()
+
+
+def test_write_back_sync_mode_sends_immediately():
+    """The bit-identity shape: ``coalesce=False`` materializes the TD
+    NOW and ships the single-entry frame before returning."""
+    group = _ScriptedGroup([[]])
+    pipe = ReplayPipeline(
+        group, batch_size=8, beta=0.4, pace=lambda o: False,
+        depth=1, coalesce=False, part_specs=_SPECS_8,
+    )
+    try:
+        b = _mk_batch(0, 4)
+        pipe.write_back(b, jnp.full(8, 2.0))
+        assert len(group.prio_single) == 1
+        shard_idx, ids, indices, td = group.prio_single[0]
+        assert shard_idx == 0
+        np.testing.assert_array_equal(ids, b.ids)
+        np.testing.assert_array_equal(td, np.full(8, 2.0))
+        assert pipe.prio_frames == 1 and pipe.prio_frames_coalesced == 0
+    finally:
+        pipe.close()
+
+
+def test_coalesced_prio_frame_roundtrip_and_whole_frame_fencing():
+    """Wire tier: one multi-entry ``KIND_PRIO_UPDATE`` frame applies
+    every triple on a live shard; a deposed learner's coalesced frame
+    is fenced WHOLE (one tag, one fence decision, zero applied)."""
+    with time_limit(60, "coalesced prio roundtrip"):
+        shard, _, server = _start_service(capacity=4096)
+        try:
+            _push(server.port, _rows(0, 256))
+            new_group = ReplayClientGroup(
+                [("127.0.0.1", server.port)], client_id=1, epoch=2,
+            )
+            old_group = ReplayClientGroup(
+                [("127.0.0.1", server.port)], client_id=2, epoch=1,
+            )
+            b1 = new_group.sample_shard(0, 16, 0.4)
+            b2 = new_group.sample_shard(0, 16, 0.4)
+            assert b1 is not None and b2 is not None
+            assert shard.fence_epoch == 2
+            new_group.update_priorities_multi(0, [
+                (b1.ids, b1.indices, np.full(16, 3.0)),
+                (b2.ids, b2.indices, np.full(16, 7.0)),
+            ])
+            _poll(
+                lambda: shard.prio_applied >= 32, timeout=10.0,
+                what="coalesced frame applied",
+            )
+            # Later entries win where draws overlapped (alpha=1,
+            # eps=0: priority == |td|).
+            np.testing.assert_array_equal(
+                shard.priority_of(b2.indices), np.full(16, 7.0)
+            )
+            only_b1 = np.setdiff1d(b1.indices, b2.indices)
+            np.testing.assert_array_equal(
+                shard.priority_of(only_b1), np.full(only_b1.size, 3.0)
+            )
+            # The deposed reign's coalesced frame: dropped whole.
+            before = shard.priority_of(b2.indices).copy()
+            old_group.update_priorities_multi(0, [
+                (b1.ids, b1.indices, np.full(16, 9.0)),
+                (b2.ids, b2.indices, np.full(16, 9.0)),
+            ])
+            _poll(
+                lambda: shard.prio_fenced >= 1, timeout=10.0,
+                what="fence drop",
+            )
+            assert shard.prio_fenced == 1  # ONE decision for the frame
+            np.testing.assert_array_equal(
+                shard.priority_of(b2.indices), before
+            )
+            new_group.close()
+            old_group.close()
+        finally:
+            server.close()
+
+
+# --- depth-1 lockstep bit-identity -----------------------------------
+
+def test_depth1_sync_pipeline_is_bit_identical_to_serial():
+    """The acceptance pin: prefetch depth 1 with synchronous
+    write-back reproduces the serial draw->update->write-back loop
+    BIT-IDENTICALLY at a fixed seed — same draws (seed-0 shards with
+    identical preloads), same update keys, same params after N
+    updates."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from actor_critic_algs_on_tensorflow_tpu.algos import offpolicy
+    from actor_critic_algs_on_tensorflow_tpu.algos.ddpg import (
+        DDPGConfig,
+        make_ddpg,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.parallel.mesh import shard_map
+
+    n_updates, bs = 6, 8
+    cfg = DDPGConfig(
+        env="Pendulum-v1", num_envs=4, steps_per_iter=2,
+        replay_capacity=64, batch_size=bs, num_devices=1,
+    )
+    parts = make_ddpg(cfg).parts
+    key = jax.random.PRNGKey(0)
+    params0, opt0 = jax.jit(parts.init_params)(key, jnp.zeros((1, 3)))
+    example = offpolicy.Transition(
+        obs=jnp.zeros(3), action=jnp.zeros(1), reward=jnp.zeros(()),
+        next_obs=jnp.zeros(3), terminated=jnp.zeros(()),
+    )
+    _, tr_def = jax.tree_util.tree_flatten(example)
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+    upd = jax.jit(shard_map(
+        lambda b, w, c, k: parts.update_batch(b, w, c, k),
+        mesh=mesh, in_specs=(P(),) * 4, out_specs=P(),
+        check_vma=False,
+    ))
+    k_updates = jax.random.PRNGKey(7)
+
+    with time_limit(180, "depth-1 bit-identity"):
+        # Two identical shards (same seed => same sampling RNG), one
+        # per loop, so each loop's write-backs shape its own tree.
+        shard_a, _, server_a = _start_service(capacity=64)
+        shard_b, _, server_b = _start_service(capacity=64)
+        try:
+            shard_a.add(_rows(0, 64))
+            shard_b.add(_rows(0, 64))
+
+            # Serial replica: draw -> update -> SYNC write-back, each
+            # write-back applied shard-side before the next descent.
+            group_a = ReplayClientGroup(
+                [("127.0.0.1", server_a.port)], client_id=1,
+            )
+            params_a, opt_a = params0, opt0
+            drawn_a = []
+            for i in range(n_updates):
+                batch = group_a.sample(bs, 0.4)
+                assert batch is not None
+                drawn_a.append(np.asarray(batch.indices).copy())
+                b = jax.tree_util.tree_unflatten(
+                    tr_def, [jnp.asarray(x) for x in batch.leaves]
+                )
+                (params_a, opt_a), _, td = upd(
+                    b, jnp.asarray(batch.weights), (params_a, opt_a),
+                    jax.random.fold_in(k_updates, i),
+                )
+                group_a.update_priorities(
+                    batch.shard_idx, batch.ids, batch.indices,
+                    np.asarray(td),
+                )
+                want = (i + 1) * bs
+                _poll(
+                    lambda want=want: shard_a.prio_applied >= want,
+                    what="serial write-back applied",
+                )
+
+            # Lockstep pipeline against the twin shard. The pace
+            # closure additionally holds the next draw until the
+            # previous write-back has LANDED shard-side — the same
+            # ordering the polling above pins for the serial loop.
+            group_b = ReplayClientGroup(
+                [("127.0.0.1", server_b.port)], client_id=1,
+            )
+            consumed = [0]
+            pipe = ReplayPipeline(
+                group_b, batch_size=bs, beta=0.4,
+                pace=lambda o: shard_b.prio_applied >= consumed[0] * bs,
+                depth=1, coalesce=False,
+                part_specs=[
+                    ((bs, 3), np.float32), ((bs, 1), np.float32),
+                    ((bs,), np.float32), ((bs, 3), np.float32),
+                    ((bs,), np.float32),
+                ],
+            )
+            params_b, opt_b = params0, opt0
+            drawn_b = []
+            try:
+                for i in range(n_updates):
+                    pb = None
+                    deadline = time.monotonic() + 30.0
+                    while pb is None and time.monotonic() < deadline:
+                        pb = pipe.get(timeout=0.25)
+                    assert pb is not None, f"update {i} never staged"
+                    drawn_b.append(
+                        np.asarray(pb.sampled.indices).copy()
+                    )
+                    b = jax.tree_util.tree_unflatten(tr_def, pb.leaves)
+                    (params_b, opt_b), m_dev, td = upd(
+                        b, pb.weights, (params_b, opt_b),
+                        jax.random.fold_in(k_updates, i),
+                    )
+                    consumed[0] += 1
+                    pipe.mark_consumed(pb, m_dev)
+                    pipe.write_back(pb.sampled, td)
+            finally:
+                pipe.close()
+
+            # Same draw sequence, bit-identical params + opt state.
+            for a, b in zip(drawn_a, drawn_b):
+                np.testing.assert_array_equal(a, b)
+            for a, b in zip(
+                jax.tree_util.tree_leaves((params_a, opt_a)),
+                jax.tree_util.tree_leaves((params_b, opt_b)),
+            ):
+                np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b)
+                )
+            group_a.close()
+            group_b.close()
+        finally:
+            server_a.close()
+            server_b.close()
+
+
+# --- failover: interrupt mid-prefetch --------------------------------
+
+def test_interrupt_aborts_blocked_draw_and_reissues_cleanly():
+    """The supervisor's failover move: ``group.interrupt(k)`` faults a
+    prefetch worker blocked mid-draw WITHOUT waiting out the retry
+    deadline; the worker counts a reissue and draws again, and the
+    aborted draw (no reply) never touches the meters."""
+    with time_limit(60, "interrupt mid-prefetch"):
+        shard, service, server = _start_service(capacity=256)
+        shard.add(_rows(0, 64))
+        seen = []
+        release = threading.Event()
+        orig = service.handle
+
+        def gated(peer, kind, tag, arrays, reply):
+            if kind == transport.KIND_SAMPLE_REQ and (
+                int(np.asarray(arrays[0]).reshape(-1)[0]) > 0
+            ):
+                n = len(seen)
+                seen.append(tag)
+                if n == 1:
+                    # Hold the SECOND real draw hostage: the worker
+                    # sits in recv until the interrupt faults it.
+                    release.wait(timeout=30.0)
+            orig(peer, kind, tag, arrays, reply)
+
+        server.set_replay_handler(gated)
+        group = ReplayClientGroup(
+            [("127.0.0.1", server.port)], client_id=1, retry_s=30.0,
+        )
+        pipe = ReplayPipeline(
+            group, batch_size=8, beta=0.4, pace=lambda o: True,
+            depth=1, coalesce=True, part_specs=_SPECS_8,
+        )
+        try:
+            a = pipe.get(timeout=10.0)
+            assert a is not None
+            pipe.mark_consumed(a, a.weights)
+            _poll(lambda: len(seen) >= 2, what="hostage draw issued")
+            t0 = time.monotonic()
+            assert group.interrupt(0) >= 1
+            _poll(lambda: pipe.reissues >= 1, what="reissue")
+            # Aborted in ~ms, not the 30 s retry deadline.
+            assert time.monotonic() - t0 < 10.0
+            release.set()  # let the hostage handler thread unwind
+            b = pipe.get(timeout=15.0)
+            assert b is not None
+            # Meters: both SERVED draws counted, the aborted one
+            # (which produced no reply) never was; ingest meter
+            # unchanged — nothing double-counted.
+            assert group.draws == 2
+            assert group.sample_failovers == 1
+            assert group.inserted_total() == 64
+            pipe.mark_consumed(b, b.weights)
+        finally:
+            pipe.close()
+            group.close()
+            server.close()
+
+
+# --- standby takeover drain ------------------------------------------
+
+def test_takeover_drain_aborts_inflight_without_goodbye():
+    """``close(flush=False)`` is the takeover drain: in-flight draws
+    abort promptly (no goodbye frames — a learner goodbye would tell
+    the shard the RUN is over), buffered priorities are dropped, and
+    the tier keeps serving the next reign."""
+    with time_limit(60, "takeover drain"):
+        shard, service, server = _start_service(capacity=256)
+        shard.add(_rows(0, 64))
+        seen = []
+        release = threading.Event()
+        orig = service.handle
+
+        def gated(peer, kind, tag, arrays, reply):
+            if kind == transport.KIND_SAMPLE_REQ and (
+                int(np.asarray(arrays[0]).reshape(-1)[0]) > 0
+            ):
+                seen.append(tag)
+                release.wait(timeout=30.0)
+            orig(peer, kind, tag, arrays, reply)
+
+        server.set_replay_handler(gated)
+        group = ReplayClientGroup(
+            [("127.0.0.1", server.port)], client_id=1, epoch=1,
+            retry_s=30.0,
+        )
+        pipe = ReplayPipeline(
+            group, batch_size=8, beta=0.4, pace=lambda o: True,
+            depth=2, coalesce=True, part_specs=_SPECS_8,
+        )
+        try:
+            # A draw is in flight (blocked server-side) and a
+            # write-back token is still held when the takeover hits.
+            _poll(lambda: len(seen) >= 1, what="in-flight draw")
+            pipe.write_back(_mk_batch(0, 1), np.full(8, 2.0))
+            t0 = time.monotonic()
+            pipe.close(flush=False)
+            drain_s = time.monotonic() - t0
+            assert drain_s < 10.0, f"drain took {drain_s:.1f}s"
+            # Dropped, not flushed: no frame left, nothing applied.
+            assert pipe.prio_frames == 0
+            assert shard.prio_applied == 0
+            release.set()
+            # No goodbye reached the shard and the server still
+            # serves: the NEW reign attaches, samples, and raises the
+            # fence — the takeover never cost the tier.
+            assert server.metrics()["transport_graceful_closes"] == 0
+            g2 = ReplayClientGroup(
+                [("127.0.0.1", server.port)], client_id=2, epoch=2,
+            )
+            batch = g2.sample(8, 0.4)
+            assert batch is not None
+            assert shard.fence_epoch == 2
+            g2.close()
+        finally:
+            group.close()
+            server.close()
+
+
+# --- process tier (slow): SIGKILL under a live pipeline --------------
+
+def _spawn_replay_proc(ctx, shard_id, port=0, **kw):
+    parent = child = None
+    if port == 0:
+        parent, child = ctx.Pipe()
+    kwargs = dict(
+        port=port, capacity=20_000, alpha=1.0, eps=0.0, validate=False,
+        report_interval_s=0.0,
+    )
+    kwargs.update(kw)
+    p = ctx.Process(
+        target=replay_server_main, args=(shard_id, child), kwargs=kwargs,
+        daemon=True,
+    )
+    p.start()
+    if child is not None:
+        child.close()
+    bound = port
+    if parent is not None:
+        assert parent.poll(120.0), "replay server never reported its port"
+        bound = int(parent.recv())
+        parent.close()
+    return p, bound
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_pipeline_sigkill_shard_mid_prefetch_reissues_cleanly():
+    """ISSUE 17 chaos drill: SIGKILL one of two replay servers while
+    the pipeline holds in-flight draws against it. The survivor keeps
+    feeding updates, the dead shard's draws are dropped and reissued
+    (never double-counted by the meter reconciliation), and the
+    respawned shard rejoins the window."""
+    ctx = mp.get_context("spawn")
+    with time_limit(300, "pipeline SIGKILL chaos"):
+        p0, port0 = _spawn_replay_proc(ctx, 0)
+        p1, port1 = _spawn_replay_proc(ctx, 1)
+        group = ReplayClientGroup(
+            [("127.0.0.1", port0), ("127.0.0.1", port1)],
+            client_id=1, retry_s=0.5, connect_timeout=0.5,
+        )
+        pipe = None
+        try:
+            _push(port0, _rows(0, 256, obs_dim=4))
+            _push(port1, _rows(0, 256, obs_dim=4), actor_id=1)
+            pipe = ReplayPipeline(
+                group, batch_size=32, beta=0.4, pace=lambda o: True,
+                depth=2, coalesce=True,
+            )
+
+            served = {0: 0, 1: 0}
+
+            # Both shards serving through the window before the fault.
+            def both_served():
+                return served[0] >= 2 and served[1] >= 2
+
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline and not both_served():
+                pb = pipe.get(timeout=0.25)
+                if pb is not None:
+                    served[pb.sampled.shard_idx] += 1
+                    pipe.mark_consumed(pb, pb.weights)
+                    pipe.write_back(pb.sampled, pb.weights)
+                    pipe.flush_priorities()
+            assert both_served()
+            assert group.inserted_total() == 512
+
+            os.kill(p0.pid, signal.SIGKILL)
+            p0.join(10)
+            hold = PortReservation.hold("127.0.0.1", port0)
+            # The supervisor's move: abort the in-flight draw against
+            # the corpse instead of riding out its retry deadline.
+            group.interrupt(0)
+
+            # The survivor keeps the learner fed through the outage,
+            # the dead shard's worker keeps reissuing, and the global
+            # ingest meter NEVER moves (no double-count).
+            survivor = [0]
+
+            def outage_ok():
+                return survivor[0] >= 3 and pipe.reissues >= 1
+
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline and not outage_ok():
+                pb = pipe.get(timeout=0.25)
+                if pb is not None:
+                    assert pb.sampled.shard_idx == 1
+                    survivor[0] += 1
+                    pipe.mark_consumed(pb, pb.weights)
+                    pipe.write_back(pb.sampled, pb.weights)
+                    pipe.flush_priorities()
+            assert outage_ok()
+            assert group.inserted_total() == 512
+            assert group.sample_failovers >= 1
+
+            # Respawn on the same port; re-home the stale link and
+            # refill; the shard rejoins the prefetch window and the
+            # meter reconciles the cold respawn as NEW ingest on top
+            # of the kept predecessor contribution. The refill is a
+            # DIFFERENT size (128, not 256): reset detection keys on
+            # the meter regressing below the old watermark.
+            hold.release()
+            p0b, _ = _spawn_replay_proc(ctx, 0, port=port0)
+            group.rehome(0)
+            _push(port0, _rows(0, 128, obs_dim=4))
+            rejoined = [False]
+
+            def back():
+                return rejoined[0] and group.inserted_total() >= 640
+
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline and not back():
+                pb = pipe.get(timeout=0.25)
+                if pb is not None:
+                    if pb.sampled.shard_idx == 0:
+                        rejoined[0] = True
+                    pipe.mark_consumed(pb, pb.weights)
+                    pipe.write_back(pb.sampled, pb.weights)
+                    pipe.flush_priorities()
+            assert rejoined[0], "respawned shard never rejoined"
+            assert group.inserted_total() == 640
+            assert group.prio_failures == 0
+            os.kill(p0b.pid, signal.SIGKILL)
+            os.kill(p1.pid, signal.SIGKILL)
+        finally:
+            if pipe is not None:
+                pipe.close()
+            group.close()
