@@ -244,6 +244,38 @@ class ImpalaConfig:
     # CartPole obs ride plain). Costs one zlib pass inside the act
     # round-trip, so it is opt-in for bandwidth-bound links.
     serve_obs_codec: bool = False
+    # --- continuous policy delivery (distributed/delivery.py) ---------
+    # Gate every publish behind the eval-gated promotion pipeline:
+    # publishes park as versioned candidates in the PolicyStore until
+    # an evaluator's signed PROMOTE verdict releases them to the fleet
+    # (the first publish auto-promotes — the fleet needs a baseline).
+    # Point an evaluator process (delivery.run_evaluator) at the
+    # learner to close the loop; without one, candidates quarantine on
+    # delivery_timeout_s and the fleet keeps serving the last-good
+    # version.
+    delivery: bool = False
+    # Fraction of serving lanes routed to a pending candidate's params
+    # (env_shim mode only; 0 = no canary, candidates are judged on
+    # eval score alone). Deterministic per-lane assignment — an actor
+    # sees one policy per candidate, not a per-tick coin flip.
+    delivery_canary_fraction: float = 0.0
+    # Shadow-score pending candidates against live traffic (the
+    # candidate acts on every live batch, same obs + PRNG key, but its
+    # actions are never served — divergence lands in
+    # serve_shadow_divergence).
+    delivery_shadow: bool = False
+    # Shared HMAC secret for verdict signing ("" = the dev default —
+    # configure a real one whenever the evaluator crosses a host
+    # boundary).
+    delivery_secret: str = ""
+    # Spill candidate snapshots here (npz + manifest) so an external
+    # evaluator or post-mortem can load exactly what was judged
+    # ("" = in-memory only).
+    delivery_store_dir: str = ""
+    # Quarantine a pending candidate nobody judged within this window
+    # (the SIGKILLed-evaluator case): serving is unaffected, the
+    # candidate never reaches the fleet.
+    delivery_timeout_s: float = 60.0
     # --- mid-rollout param fetch (classic actor mode) -----------------
     # Fetch-params actors normally re-fetch weights only at rollout
     # boundaries; with this knob the rollout runs as mid_rollout_chunks
@@ -2976,8 +3008,56 @@ def run_impala_distributed(
 
     publisher = AsyncParamPublisher(_publish_wire)
 
+    # Eval-gated continuous delivery (cfg.delivery): publishes become
+    # CANDIDATES in a versioned PolicyStore instead of hitting the
+    # fleet directly. An evaluator tier polls them over KIND_CANDIDATE,
+    # scores against the perf bar, and returns a signed verdict; only
+    # PROMOTE routes the weights through the exact swap+wire machinery
+    # a direct publish uses (the on_promote closure below). The FIRST
+    # publish auto-promotes so the fleet never blocks on version 0.
+    delivery_ctl = None
+    if cfg.delivery:
+        from actor_critic_algs_on_tensorflow_tpu.distributed.delivery import (
+            DeliveryController,
+            PolicyStore,
+        )
+
+        def _promote_publish(meta, leaves, tree):
+            if tree is not None:
+                if serving is not None:
+                    serving.set_params(tree)
+                if device_source is not None:
+                    device_source.set_params(tree)
+                publisher.submit(tree)
+            else:
+                # Store-reloaded candidate (host leaves only): skip
+                # the device swap, broadcast straight on the wire.
+                for s in servers:
+                    s.publish(leaves)
+
+        delivery_ctl = DeliveryController(
+            PolicyStore(cfg.delivery_store_dir or None),
+            server,
+            serving=serving,
+            secret=cfg.delivery_secret or None,
+            canary_fraction=cfg.delivery_canary_fraction,
+            shadow=cfg.delivery_shadow,
+            verdict_timeout_s=cfg.delivery_timeout_s,
+            on_promote=_promote_publish,
+        )
+        for s in servers:
+            s.set_delivery_handler(delivery_ctl.handle)
+
     def publish(params):
         p = programs.copy_params(params) if donate else params
+        if delivery_ctl is not None:
+            # Gated path: the weights park as a pending candidate
+            # (device->host fetch here, off the wire's critical path
+            # since nothing ships until a verdict); the evaluator's
+            # signed PROMOTE releases them through _promote_publish.
+            leaves = jax.tree_util.tree_leaves(jax.device_get(p))
+            delivery_ctl.submit(leaves, tree=p)
+            return
         if serving is not None:
             # Zero-staleness weight swap for central inference: the
             # very next act() tick uses the new device params — no
@@ -3061,6 +3141,14 @@ def run_impala_distributed(
             ]
         return out
 
+    def _delivery_metrics():
+        # The log tick doubles as the delivery watchdog: candidates
+        # nobody judged inside the verdict timeout are quarantined
+        # here (evaluator died mid-verdict — serving is unaffected,
+        # the candidate was never promoted).
+        delivery_ctl.check_timeouts()
+        return delivery_ctl.metrics()
+
     def extra_metrics():
         # Transport liveness rides the same log stream as the learning
         # metrics: disconnect/reconnect counts, per-actor liveness,
@@ -3093,6 +3181,7 @@ def run_impala_distributed(
             ),
             **publisher.metrics(),
             **(serving.metrics() if serving is not None else {}),
+            **(_delivery_metrics() if delivery_ctl is not None else {}),
             **(validator.metrics() if validator is not None else {}),
             **(_per_shard_metrics() if shard is not None else {}),
             **_membership_metrics(),

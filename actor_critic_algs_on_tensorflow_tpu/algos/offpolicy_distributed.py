@@ -435,6 +435,7 @@ def run_offpolicy_distributed(
     actor_param_endpoints: List[Tuple[str, int]] | None = None,
     server=None,
     update_program=None,
+    reshard_policy=None,
 ) -> Tuple[OffPolicyDistributedResult, list]:
     """Train off-policy through the distributed replay tier.
 
@@ -467,6 +468,16 @@ def run_offpolicy_distributed(
     (early) param-plane listener with the fleet already parked on it;
     ``update_program`` reuses a standby's warm-compiled update so the
     takeover pays no XLA compile.
+
+    Live resharding (``cfg.autoscale_reshard``): shard-count proposals
+    from a ``ThresholdPolicy`` over the learner's own metrics stream
+    (or from ``reshard_policy``, a test-injectable
+    ``(metrics, current_shards) -> Optional[int]``) are APPLIED in
+    place — the sample plane quiesces, every ring drains a final
+    snapshot, the rings are re-dealt bit-exactly across the new shard
+    count (``elastic.reshard_rings``), and the replay tier + actor
+    fleet respawn under a bumped fencing epoch with the plan committed
+    through the ``PlanStore`` stage/commit discipline.
     """
     import multiprocessing as mp
     import os as os_lib
@@ -475,10 +486,16 @@ def run_offpolicy_distributed(
     from actor_critic_algs_on_tensorflow_tpu.distributed.elastic import (
         Autoscaler,
         MembershipView,
+        PlanStore,
+        ReshardPlan,
         ThresholdPolicy,
+        reshard_rings,
+        write_ring_snapshot,
     )
     from actor_critic_algs_on_tensorflow_tpu.distributed.replay import (
+        PrioritizedReplayShard,
         ReplayClientGroup,
+        ReplaySnapshotter,
         replay_server_main,
     )
     from actor_critic_algs_on_tensorflow_tpu.distributed.sharding import (
@@ -521,6 +538,21 @@ def run_offpolicy_distributed(
     replay_ports: Dict[int, int] = {}
     replay_restarts = [0] * n_replay_shards
 
+    # Per-shard snapshot dirs are GENERATION-suffixed after the first
+    # live reshard (gen 0 keeps the legacy name so plain resumes find
+    # their old cuts): a re-dealt ring must restore from its OWN fresh
+    # cut, never a stale pre-reshard chain with the wrong row deal.
+    reshard_gen = 0
+
+    def _shard_snap_dir(k: int):
+        if not snap_root:
+            return None
+        name = (
+            f"shard-{k}" if reshard_gen == 0
+            else f"shard-{k}-g{reshard_gen}"
+        )
+        return os_lib.path.join(snap_root, name)
+
     def spawn_replay(k: int, bind_port: int = 0):
         parent = None
         child = None
@@ -536,10 +568,7 @@ def run_offpolicy_distributed(
                 alpha=cfg.per_alpha,
                 eps=cfg.per_eps,
                 seed=seed + 7919 * (k + 1),
-                snapshot_dir=(
-                    os_lib.path.join(snap_root, f"shard-{k}")
-                    if snap_root else None
-                ),
+                snapshot_dir=_shard_snap_dir(k),
                 snapshot_interval_s=getattr(
                     cfg, "replay_snapshot_interval_s", 30.0
                 ),
@@ -646,6 +675,30 @@ def run_offpolicy_distributed(
         )
     server.set_epoch(epoch)
 
+    # Eval-gated delivery (cfg.delivery): acting-slice publishes park
+    # as versioned candidates; an evaluator peer polls + scores them
+    # and only a signed PROMOTE reaches the fleet (the controller's
+    # default promote path IS ``server.publish`` — no serving tier
+    # here). The bootstrap publish below auto-promotes, so actors
+    # never block on version 0.
+    delivery_ctl = None
+    if getattr(cfg, "delivery", False):
+        from actor_critic_algs_on_tensorflow_tpu.distributed.delivery import (  # noqa: E501
+            DeliveryController,
+            PolicyStore,
+        )
+
+        delivery_ctl = DeliveryController(
+            PolicyStore(),
+            server,
+            secret=getattr(cfg, "delivery_secret", "") or None,
+            verdict_timeout_s=float(
+                getattr(cfg, "delivery_timeout_s", 60.0)
+            ),
+            log=log,
+        )
+        server.set_delivery_handler(delivery_ctl.handle)
+
     def publish():
         leaves = [
             np.asarray(x)
@@ -653,6 +706,9 @@ def run_offpolicy_distributed(
                 jax.device_get(parts.acting_slice(params))
             )
         ]
+        if delivery_ctl is not None:
+            delivery_ctl.submit(leaves, step=updates_done)
+            return
         server.publish(leaves, notify=True)
 
     publish()  # version 1: actors block on version 0 until this
@@ -1000,6 +1056,176 @@ def run_offpolicy_distributed(
                 live += 1
             log(f"autoscaler: scaled up to {live} actors")
 
+    # -- live resharding (cfg.autoscale_reshard) -----------------------
+    # ThresholdPolicy shard-count proposals APPLIED in place: quiesce
+    # the sample plane, drain every ring to a final snapshot, re-deal
+    # bit-exactly across the new count (elastic.reshard_rings), then
+    # respawn the replay tier + actor fleet under a bumped fencing
+    # epoch with the plan committed through the PlanStore stage/commit
+    # discipline. Requires ring snapshots (the rings travel via final
+    # cuts) and a self-spawned fleet (a takeover learner does not own
+    # the tier it attached to).
+    reshard_count = 0
+    resharder = reshard_policy
+    if getattr(cfg, "autoscale_reshard", False):
+        if not snap_root:
+            raise ValueError(
+                "autoscale_reshard needs replay-ring snapshots: set "
+                "cfg.replay_snapshot_dir or pass a checkpointer"
+            )
+        if external_replay_endpoints is not None or not spawn_actors:
+            raise ValueError(
+                "autoscale_reshard needs a self-spawned replay tier "
+                "and actor fleet (not the takeover topology)"
+            )
+        if resharder is None:
+            _reshard_pol = ThresholdPolicy()
+            _reshard_cool = float(
+                getattr(cfg, "autoscaler_cooldown_s", 30.0)
+            )
+            _reshard_last = [float("-inf")]
+            _reshard_max = max(1, n_actors, n_replay_shards)
+
+            def resharder(metrics, current):
+                now = time.monotonic()
+                if now - _reshard_last[0] < _reshard_cool:
+                    return None
+                d = _reshard_pol.decide(metrics)
+                if d == 0:
+                    return None
+                target = max(1, min(
+                    _reshard_max,
+                    current * 2 if d > 0 else current // 2,
+                ))
+                if target == current:
+                    return None
+                _reshard_last[0] = now
+                return target
+    elif resharder is not None and not snap_root:
+        raise ValueError(
+            "reshard_policy needs replay-ring snapshots: set "
+            "cfg.replay_snapshot_dir or pass a checkpointer"
+        )
+
+    plan_store = (
+        PlanStore(os_lib.path.join(snap_root, "plans"))
+        if resharder is not None and snap_root else None
+    )
+
+    def do_reshard(new_count: int) -> None:
+        nonlocal n_replay_shards, plan, shard_endpoints, group
+        nonlocal pipeline, epoch, reshard_gen, reshard_count
+        nonlocal replay_restarts, actor_respawns
+        old_count = n_replay_shards
+        log(
+            f"reshard: {old_count} -> {new_count} shards (quiescing "
+            f"the sample plane)"
+        )
+        # 1) Quiesce: flush held priority tokens while the shards are
+        #    alive, then the group's ROLE_LEARNER goodbye makes every
+        #    shard spill a final ring snapshot and drain.
+        if pipeline is not None:
+            pipeline.close(flush=True)
+        group.close()
+        deadline = time.monotonic() + 30.0
+        for k, p in list(replay_procs.items()):
+            p.join(timeout=max(0.1, deadline - time.monotonic()))
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=5.0)
+        replay_procs.clear()
+        # 2) Restore every old ring locally from its final cut and
+        #    re-deal under the NEW reign (the reshard IS the epoch
+        #    bump — deposed late priority frames are fenced).
+        old_shards = []
+        for k in range(old_count):
+            sh = PrioritizedReplayShard(
+                cfg.replay_capacity, alpha=cfg.per_alpha,
+                eps=cfg.per_eps, seed=seed + 7919 * (k + 1),
+            )
+            snap = ReplaySnapshotter(_shard_snap_dir(k), log=log)
+            if snap.available():
+                sh.begin_restore()
+                snap.restore(sh)
+                sh.end_restore()
+            old_shards.append(sh)
+        epoch += 1
+        reshard_gen += 1
+        states = reshard_rings(
+            old_shards, new_count, epoch=epoch,
+            base_seed=seed + 104_729 * reshard_gen,
+        )
+        for k, state in enumerate(states):
+            write_ring_snapshot(_shard_snap_dir(k), state)
+        # 3) Respawn the tier on the fresh generation dirs; the new
+        #    servers restore their re-dealt rings through the normal
+        #    snapshot boot path.
+        n_replay_shards = new_count
+        replay_restarts = [0] * new_count
+        plan = ShardPlan.balanced(new_count)
+        replay_ports.clear()
+        for k in range(new_count):
+            replay_procs[k] = spawn_replay(k)
+        shard_endpoints = [
+            ("127.0.0.1", replay_ports[k]) for k in range(new_count)
+        ]
+        # 4) Durable commit: stage -> commit so a SIGKILL at any point
+        #    resumes either the old topology or the new one, never a
+        #    hybrid.
+        if plan_store is not None:
+            rp = ReshardPlan(
+                epoch=epoch,
+                shard_count=new_count,
+                endpoints=tuple(shard_endpoints),
+                assignment={
+                    i: plan.shard_of_actor(n_actors, i)
+                    for i in range(n_actors)
+                },
+            )
+            plan_store.stage(rp)
+            plan_store.commit(rp)
+        # 5) Fence the param plane under the new reign, rebuild the
+        #    sample plane (fresh meters reconstruct the global
+        #    transition total from the restored cuts), and re-point
+        #    the actor fleet at the new endpoints.
+        server.set_epoch(epoch)
+        group = ReplayClientGroup(
+            shard_endpoints, client_id=10_000, retry_s=sample_retry_s,
+            epoch=epoch,
+        )
+        if use_pipeline:
+            pipeline = ReplayPipeline(
+                group,
+                batch_size=cfg.batch_size,
+                beta=cfg.per_beta,
+                pace=_pace,
+                depth=prefetch_depth,
+                coalesce=prio_coalesce,
+                device=accel,
+                validate=batch_ok,
+                part_specs=[
+                    ((cfg.batch_size,) + shape, dtype)
+                    for shape, dtype in leaf_specs
+                ],
+            )
+        for i, p in list(actor_procs.items()):
+            if p.is_alive():
+                p.terminate()
+            p.join(timeout=5.0)
+        for i in range(n_actors):
+            if i in retired_actors:
+                continue
+            actor_procs[i] = spawn_actor(
+                i, actor_restarts[i] + reshard_gen
+            )
+            actor_respawns += 1
+        publish()
+        reshard_count += 1
+        log(
+            f"reshard complete: {new_count} shards under fencing "
+            f"epoch {epoch}"
+        )
+
     # The run is done when the ingest budget is met AND the learner
     # has caught up to its paced update target. A shard SIGKILL can
     # leave the budget meter permanently short: transitions the dead
@@ -1265,6 +1491,18 @@ def run_offpolicy_distributed(
                 if autoscaler is not None:
                     apply_autoscale(m)
                     m.update(autoscaler.metrics())
+                if resharder is not None:
+                    target_shards = resharder(m, n_replay_shards)
+                    if target_shards:
+                        do_reshard(int(target_shards))
+                    m[REPLAY + "reshards"] = reshard_count
+                if delivery_ctl is not None:
+                    # The log tick doubles as the delivery watchdog:
+                    # judge-less candidates past the verdict timeout
+                    # are quarantined here (evaluator died mid-verdict
+                    # — the fleet keeps serving last-good).
+                    delivery_ctl.check_timeouts()
+                    m.update(delivery_ctl.metrics())
                 m["episodes"] = ep_count
                 m["avg_return"] = (
                     ep_returns_sum / ep_count if ep_count else 0.0

@@ -95,6 +95,23 @@ class SACConfig:
     replay_pipeline: bool = True
     replay_prefetch_depth: int = 2
     replay_prio_coalesce: bool = True
+    # Eval-gated continuous delivery (run_offpolicy_distributed): when
+    # delivery, acting-slice publishes park as versioned CANDIDATES in
+    # the learner's PolicyStore; an evaluator peer polls + scores them
+    # and only a signed PROMOTE verdict reaches the actor fleet. A
+    # candidate nobody judges within delivery_timeout_s is quarantined
+    # (serving unaffected). delivery_secret keys the HMAC verdict
+    # signatures ("" = the shared dev secret).
+    delivery: bool = False
+    delivery_secret: str = ""
+    delivery_timeout_s: float = 60.0
+    # Live resharding (run_offpolicy_distributed): when
+    # autoscale_reshard, the autoscaler's shard-count proposals are
+    # APPLIED — the learner quiesces draws, snapshots every ring,
+    # resplits them across the new shard count, respawns the replay
+    # tier and the actor fleet under a bumped fencing epoch. Off by
+    # default: a resize mid-run costs a quiesce window.
+    autoscale_reshard: bool = False
     seed: int = 0
     num_devices: int = 0
 

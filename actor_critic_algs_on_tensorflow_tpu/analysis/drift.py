@@ -53,7 +53,7 @@ from actor_critic_algs_on_tensorflow_tpu.analysis.core import (
 # bare "shards" count — but NOT a lone "shard" (a common kwarg name).
 _FAMILY_RE = re.compile(
     r"^(transport_|pipeline_|serve_|device_|replay_pipeline_|replay_"
-    r"|elastic_|autoscaler_"
+    r"|elastic_|autoscaler_|delivery_|promo_"
     r"|shard[0-9*]|shard_|shards$)"
     r"[A-Za-z0-9_*]*$"
 )

@@ -437,6 +437,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "min(MAX, --replay-actors) (double up on "
                         "starvation, halve down on backlog; cooldown "
                         "via --set autoscaler_cooldown_s=)")
+    p.add_argument("--evaluator", default=None, metavar="HOST:PORT",
+                   help="run as the policy-delivery EVALUATOR tier for "
+                        "the learner at HOST:PORT (a learner started "
+                        "with --set delivery=True): poll candidate "
+                        "weights over the wire, score them against the "
+                        "env's PERF.md bar, and return signed "
+                        "PROMOTE/REJECT verdicts. With "
+                        "--checkpoint-dir the score is a fresh greedy "
+                        "eval of the newest checkpoint (the PERF.md "
+                        "methodology); without, a cheap leaf-mean "
+                        "probe (tests/benches). Signing secret: --set "
+                        "delivery_secret= (must match the learner)")
     p.add_argument("--replay-ports", default=None, metavar="P0,P1,..",
                    help="with --replay-servers: pin each replay "
                         "shard's bind port (default: ephemeral). "
@@ -1165,9 +1177,56 @@ def _run_offpolicy_standby(args, fns, cfg, writer) -> int:
     return 0
 
 
+def _run_evaluator(args, algo, cfg) -> int:
+    """The delivery evaluator tier: poll, score, signed verdict."""
+    import numpy as np
+
+    from actor_critic_algs_on_tensorflow_tpu.distributed.delivery import (
+        bar_for,
+        greedy_checkpoint_scorer,
+        run_evaluator,
+    )
+
+    host, _, port_s = args.evaluator.rpartition(":")
+    try:
+        host, port = host or "127.0.0.1", int(port_s)
+    except ValueError:
+        raise SystemExit(
+            f"--evaluator: want HOST:PORT, got {args.evaluator!r}"
+        )
+    bar = bar_for(cfg.env)
+    if not np.isfinite(bar):
+        print(
+            f"[train] WARNING: no PERF.md bar for env {cfg.env!r} — "
+            f"every finite-scoring candidate will promote",
+            flush=True,
+        )
+    if args.checkpoint_dir:
+        score_fn = greedy_checkpoint_scorer(
+            algo, cfg, args.checkpoint_dir,
+            num_envs=args.eval_envs, max_steps=args.eval_steps,
+            stochastic=args.stochastic,
+        )
+    else:
+        def score_fn(meta, leaves):
+            leaf = np.asarray(leaves[0], np.float64)
+            return float(leaf.mean()) if leaf.size else float("nan")
+
+    verdicts = run_evaluator(
+        host, port,
+        score_fn=score_fn,
+        bar=bar,
+        secret=getattr(cfg, "delivery_secret", "") or None,
+    )
+    print(f"[train] evaluator exited after {verdicts} verdict(s)")
+    return 0
+
+
 def _run(args, algo, cfg, writer) -> int:
     if args.render_dir and not args.eval:
         raise SystemExit("--render-dir requires --eval")
+    if args.evaluator is not None:
+        return _run_evaluator(args, algo, cfg)
     if args.learner_bind and not (
         (algo == "impala" and (args.actor_processes or args.standby))
         or args.replay_servers

@@ -133,8 +133,11 @@ class Redirector(ChaosProxy):
     even then (the outranked winner just starves). The epoch check
     and the re-point are ONE atomic step under the lock, so a racing
     lower-reign redirect can never land its target after a
-    higher-reign one passed the check. Epoch-less redirects (legacy
-    callers, chaos tests) bypass the fence."""
+    higher-reign one passed the check. Epoch-less redirects (chaos
+    tooling) bypass the fence, but only with an explicit
+    ``force=True`` — a production caller that forgot its epoch gets a
+    loud ``ValueError`` instead of silently skipping the reign check
+    (forced bypasses are counted as ``redirect_forced``)."""
 
     # Fencing state (class defaults — ChaosProxy.__init__ is reused
     # untouched; instance writes shadow these). epoch_rank is the
@@ -143,6 +146,7 @@ class Redirector(ChaosProxy):
     epoch: int = 0
     epoch_rank: int = -1
     stale_redirects: int = 0
+    redirect_forced: int = 0
 
     def redirect(
         self,
@@ -152,12 +156,15 @@ class Redirector(ChaosProxy):
         reset_existing: bool = True,
         epoch: int | None = None,
         rank: int | None = None,
+        force: bool = False,
     ) -> int:
         """Point new connections at ``host:port``; returns how many
         live links were reset over to it, or ``-1`` when the redirect
         was REFUSED: ``epoch`` is below the reign this redirector is
         already pointed by — or equal to it from a HIGHER rank (the
-        dual-win tiebreak)."""
+        dual-win tiebreak). Without an ``epoch`` the call must carry
+        ``force=True`` (chaos tooling deliberately skipping the
+        fence); otherwise it raises."""
         if epoch is not None:
             with self._lock:
                 r = -1 if rank is None else int(rank)
@@ -191,6 +198,15 @@ class Redirector(ChaosProxy):
                 )
                 return -1
             return self.reset_all() if reset_existing else 0
+        if not force:
+            raise ValueError(
+                "epoch-less redirect without force=True: production "
+                "re-points must carry their fencing epoch (see "
+                "repoint_fleet / _fenced_redirect); chaos tooling "
+                "that MEANS to skip the reign fence passes force=True"
+            )
+        with self._lock:
+            self.redirect_forced += 1
         self.set_target(host, port)
         return self.reset_all() if reset_existing else 0
 

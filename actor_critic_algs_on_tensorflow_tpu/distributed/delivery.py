@@ -1,0 +1,696 @@
+"""Continuous policy delivery: eval-gated promotion, canary/shadow
+serving, one-knob epoch rollback.
+
+The platform's publish path was "newest weights win": every learner
+step's ``publish()`` reached the whole fleet with no gate in between,
+so one divergent update served everyone until a human noticed. This
+module decouples learner progress from the served policy, the way
+production SEED-style services do (Espeholt et al. 2018/2020):
+
+  - ``PolicyStore``: versioned candidate snapshots on the learner
+    tier, keyed by ``(version, step, epoch)``. The version reuses the
+    fencing-epoch layout (``epoch << EPOCH_SHIFT | seq``), so a
+    candidate's identity already names the reign that minted it. The
+    store optionally spills each candidate to disk (atomic npz +
+    manifest, the PlanStore write discipline) so an out-of-process
+    evaluator or a post-mortem can load exactly what was judged.
+  - ``run_evaluator``: the evaluator tier — a process (or thread)
+    that polls the learner for pending candidates over
+    ``KIND_CANDIDATE``, scores each against its bar (the PERF.md
+    greedy-eval bars by default, see ``bar_for``), and answers with a
+    SIGNED ``KIND_VERDICT``. Signing is HMAC-SHA256 over the
+    canonical verdict payload with a shared secret: a verdict the
+    learner cannot verify is counted and DROPPED, so a confused or
+    hostile peer cannot promote a policy.
+  - ``DeliveryController``: the learner-side brain. ``submit()``
+    replaces the direct publish — the first submit auto-promotes (the
+    fleet needs a baseline to act at all; actors block on version 0),
+    every later one parks as a pending candidate, staged on the
+    serving tier's canary/shadow lanes. A PROMOTE verdict publishes
+    the candidate through the existing param plane (wire broadcast +
+    in-process ``set_params``); a REJECT clears the candidate lanes
+    and the fleet never saw it. A candidate nobody judges within
+    ``verdict_timeout_s`` is QUARANTINED — the SIGKILLed-evaluator
+    chaos case: serving is unaffected because the candidate was never
+    promoted.
+  - ``rollback()``: the one knob. A fencing-epoch bump plus a
+    re-publish of the last-good version — nothing else. The bump
+    rides the machinery that already exists: ``LearnerServer
+    .set_epoch`` re-stamps the current version (actors re-fetch on
+    version CHANGE), ``ParamTailer``'s ``min_epoch`` and the
+    ``Redirector``'s reign fence drop a deposed candidate's late
+    frames, so rollback needs no new wire kinds at all.
+
+Metric families: ``delivery_*`` (store/verdict counters) and
+``promo_*`` (candidate-submitted -> promoted-and-serving latency).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import os
+import struct
+import tempfile
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+    CAP_DELIVERY,
+    EPOCH_SHIFT,
+    KIND_CANDIDATE,
+    KIND_VERDICT,
+    ROLE_EVALUATOR,
+    ActorClient,
+    LearnerShutdown,
+)
+from actor_critic_algs_on_tensorflow_tpu.utils import metric_names
+from actor_critic_algs_on_tensorflow_tpu.utils.metrics import LatencyStats
+
+# Candidate lifecycle states (PolicyStore).
+PENDING = "pending"
+PROMOTED = "promoted"
+REJECTED = "rejected"
+QUARANTINED = "quarantined"
+DEPOSED = "deposed"
+
+# Dev-mode shared secret: used when no secret is configured so the
+# single-process tests/benches work out of the box. Any deployment
+# that runs the evaluator on another host must configure its own
+# (cfg.delivery_secret) — the signature is only as private as this
+# constant otherwise.
+DEFAULT_SECRET = b"actor-critic-delivery-dev"
+
+# PERF.md greedy-eval bars: the promotion gate's defaults. A candidate
+# scoring BELOW its env's bar is rejected.
+PERF_BARS = {
+    "CartPole-v1": 150.0,
+    "Pendulum-v1": -400.0,
+}
+
+
+def bar_for(env: str, default: float = float("-inf")) -> float:
+    """The PERF.md promotion bar for ``env`` (``default`` when the env
+    has no pinned bar — gate on score finiteness only)."""
+    return float(PERF_BARS.get(env, default))
+
+
+def _canon_secret(secret) -> bytes:
+    if not secret:
+        return DEFAULT_SECRET
+    return secret.encode("utf-8") if isinstance(secret, str) else bytes(secret)
+
+
+def sign_verdict(
+    secret, version: int, step: int, epoch: int, promote: bool, score: float
+) -> np.ndarray:
+    """HMAC-SHA256 over the canonical verdict payload. The payload is
+    a fixed binary layout (not repr/json) so both sides agree
+    byte-for-byte; the score rides as its IEEE bits."""
+    payload = struct.pack(
+        ">qqqBd",
+        int(version), int(step), int(epoch), 1 if promote else 0,
+        float(score),
+    )
+    digest = hmac.new(_canon_secret(secret), payload, hashlib.sha256).digest()
+    return np.frombuffer(digest, np.uint8).copy()
+
+
+def verify_verdict(
+    secret,
+    version: int,
+    step: int,
+    epoch: int,
+    promote: bool,
+    score: float,
+    signature: np.ndarray,
+) -> bool:
+    expected = sign_verdict(secret, version, step, epoch, promote, score)
+    got = np.asarray(signature, np.uint8).reshape(-1)
+    if got.size != expected.size:
+        return False
+    return hmac.compare_digest(bytes(expected), bytes(got))
+
+
+class CandidateMeta:
+    """Identity + lifecycle of one candidate snapshot."""
+
+    __slots__ = (
+        "version", "step", "epoch", "status", "score", "submitted_at"
+    )
+
+    def __init__(self, version: int, step: int, epoch: int):
+        self.version = int(version)
+        self.step = int(step)
+        self.epoch = int(epoch)
+        self.status = PENDING
+        self.score: Optional[float] = None
+        self.submitted_at = time.monotonic()
+
+    def to_dict(self) -> dict:
+        return {
+            "version": self.version,
+            "step": self.step,
+            "epoch": self.epoch,
+            "status": self.status,
+            "score": self.score,
+        }
+
+
+class PolicyStore:
+    """Versioned candidate snapshots, keyed ``(version, step, epoch)``.
+
+    In-memory by default; with ``directory`` each candidate also
+    spills to ``cand-<version>.npz`` plus a ``manifest.json`` rewrite
+    (temp + replace + fsync — the PlanStore discipline), so the judged
+    artifact survives the learner process and an external evaluator
+    can double-check what it scored. The store keeps the last
+    ``keep`` candidates (FIFO eviction of non-pending entries) — the
+    delivery analog of the param-delta ring.
+    """
+
+    def __init__(self, directory: Optional[str] = None, *, keep: int = 8):
+        self._dir = directory
+        self._keep = max(2, int(keep))
+        self._lock = threading.Lock()
+        # version -> (meta, leaves, tree-or-None); insertion ordered.
+        self._cands: Dict[int, tuple] = {}
+        self._evictions = 0
+        if self._dir:
+            os.makedirs(self._dir, exist_ok=True)
+
+    def put(
+        self,
+        meta: CandidateMeta,
+        leaves: Sequence[np.ndarray],
+        tree=None,
+    ) -> None:
+        leaves = [np.asarray(a) for a in leaves]
+        with self._lock:
+            self._cands[meta.version] = (meta, leaves, tree)
+            # Evict oldest settled candidates beyond the keep window;
+            # pending ones are never evicted (they are still owed a
+            # verdict).
+            settled = [
+                v for v, (m, _l, _t) in self._cands.items()
+                if m.status != PENDING
+            ]
+            while len(self._cands) > self._keep and settled:
+                self._cands.pop(settled.pop(0), None)
+                self._evictions += 1
+        if self._dir:
+            self._spill(meta, leaves)
+
+    def _spill(self, meta: CandidateMeta, leaves) -> None:
+        path = os.path.join(self._dir, f"cand-{meta.version}.npz")
+        fd, tmp = tempfile.mkstemp(
+            dir=self._dir, prefix=".cand-", suffix=".tmp"
+        )
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez(
+                    f, **{f"leaf_{i}": a for i, a in enumerate(leaves)}
+                )
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        self._write_manifest()
+
+    def _write_manifest(self) -> None:
+        with self._lock:
+            manifest = [m.to_dict() for m, _l, _t in self._cands.values()]
+        blob = json.dumps(manifest, indent=1).encode("utf-8")
+        fd, tmp = tempfile.mkstemp(
+            dir=self._dir, prefix=".manifest-", suffix=".tmp"
+        )
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(self._dir, "manifest.json"))
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def load_leaves(self, version: int) -> List[np.ndarray]:
+        """Load a spilled candidate's leaves from disk (evaluator-side
+        double-check / post-mortem path)."""
+        if not self._dir:
+            raise FileNotFoundError("PolicyStore has no directory")
+        with np.load(
+            os.path.join(self._dir, f"cand-{int(version)}.npz")
+        ) as z:
+            return [z[f"leaf_{i}"] for i in range(len(z.files))]
+
+    def get(self, version: int) -> Optional[tuple]:
+        with self._lock:
+            return self._cands.get(int(version))
+
+    def oldest_pending(self) -> Optional[tuple]:
+        with self._lock:
+            for meta, leaves, tree in self._cands.values():
+                if meta.status == PENDING:
+                    return meta, leaves, tree
+        return None
+
+    def mark(self, version: int, status: str, score=None) -> bool:
+        updated = False
+        with self._lock:
+            entry = self._cands.get(int(version))
+            if entry is not None:
+                entry[0].status = status
+                if score is not None:
+                    entry[0].score = float(score)
+                updated = True
+        if updated and self._dir:
+            self._write_manifest()
+        return updated
+
+    def statuses(self) -> Dict[str, int]:
+        with self._lock:
+            out: Dict[str, int] = {}
+            for meta, _l, _t in self._cands.values():
+                out[meta.status] = out.get(meta.status, 0) + 1
+            return out
+
+    def metrics(self) -> dict:
+        st = self.statuses()
+        with self._lock:
+            size, evictions = len(self._cands), self._evictions
+        return {
+            "delivery_store_size": size,
+            "delivery_store_evictions": evictions,
+            "delivery_pending": st.get(PENDING, 0),
+        }
+
+
+class DeliveryController:
+    """The learner-side promotion brain.
+
+    Owns the candidate queue: ``submit()`` intercepts the publish
+    path, ``handle()`` is installed as the ``LearnerServer``'s
+    delivery handler (candidate polls + signed verdicts), and
+    ``rollback()`` is the one knob. ``on_promote(meta, leaves, tree)``
+    is how a promoted candidate reaches the fleet — the default
+    publishes through ``server.publish``; the trainer wires its full
+    path (wire broadcast + serving ``set_params`` + device source) so
+    a promotion flows through exactly the machinery a direct publish
+    used.
+    """
+
+    def __init__(
+        self,
+        store: PolicyStore,
+        server,
+        *,
+        serving=None,
+        secret=None,
+        canary_fraction: float = 0.0,
+        shadow: bool = False,
+        verdict_timeout_s: float = 60.0,
+        on_promote: Optional[Callable] = None,
+        log: Callable[[str], None] | None = None,
+    ):
+        self._store = store
+        self._server = server
+        self._serving = serving
+        self._secret = _canon_secret(secret)
+        self._canary_fraction = float(canary_fraction)
+        self._shadow = bool(shadow)
+        self._verdict_timeout = float(verdict_timeout_s)
+        self._on_promote = on_promote
+        self._log = log if log is not None else (
+            lambda msg: print(f"[delivery] {msg}", flush=True)
+        )
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._live: Optional[tuple] = None   # (meta, leaves, tree)
+        self._prior: Optional[tuple] = None  # previous promoted
+        self._candidates = 0
+        self._promotions = 0
+        self._rejections = 0
+        self._quarantines = 0
+        self._rollbacks = 0
+        self._bad_signatures = 0
+        self._stale_verdicts = 0
+        self._promo_lat = LatencyStats()
+
+    # -- publish interception -------------------------------------------
+
+    def submit(
+        self, leaves: Sequence[np.ndarray], *, step: int = 0, tree=None
+    ) -> CandidateMeta:
+        """Park new weights as a candidate instead of publishing them.
+
+        The FIRST submit auto-promotes: the fleet blocks on version 0
+        until something is published, so the bootstrap weights are the
+        baseline the gate protects (they predate any training that
+        could have diverged). Every later submit stays pending until a
+        verdict lands — staged on the serving tier's canary/shadow
+        lanes when one is attached.
+        """
+        with self._lock:
+            self._seq += 1
+            epoch = int(self._server.epoch)
+            version = (epoch << EPOCH_SHIFT) | self._seq
+            meta = CandidateMeta(version, step, epoch)
+            self._candidates += 1
+            bootstrap = self._live is None
+        self._store.put(meta, leaves, tree)
+        if bootstrap:
+            self._promote(meta)
+            return meta
+        if self._serving is not None and tree is not None:
+            if self._canary_fraction > 0.0:
+                self._serving.set_canary(
+                    tree, meta.version, self._canary_fraction
+                )
+            if self._shadow:
+                self._serving.set_shadow(tree, meta.version)
+        return meta
+
+    # -- wire handler (installed via set_delivery_handler) --------------
+
+    def handle(self, peer, kind: int, tag: int, arrays, reply) -> None:
+        if kind == KIND_CANDIDATE:
+            entry = self._store.oldest_pending()
+            if entry is None:
+                reply([np.zeros(4, np.int64)])
+                return
+            meta, leaves, _tree = entry
+            header = np.asarray(
+                [meta.version, meta.step, meta.epoch, len(leaves)],
+                np.int64,
+            )
+            reply([header, *leaves])
+            return
+        if kind == KIND_VERDICT:
+            self._apply_verdict(arrays)
+
+    def _apply_verdict(self, arrays) -> bool:
+        if len(arrays) < 3:
+            with self._lock:
+                self._bad_signatures += 1
+            return False
+        ints = np.asarray(arrays[0], np.int64).reshape(-1)
+        floats = np.asarray(arrays[1], np.float64).reshape(-1)
+        sig = arrays[2]
+        if ints.size < 4 or floats.size < 2:
+            with self._lock:
+                self._bad_signatures += 1
+            return False
+        version, promote, epoch, step = (int(v) for v in ints[:4])
+        score = float(floats[0])
+        if not verify_verdict(
+            self._secret, version, step, epoch, bool(promote), score, sig
+        ):
+            with self._lock:
+                self._bad_signatures += 1
+            self._log(
+                f"verdict for candidate {version} failed signature "
+                f"verification; dropped"
+            )
+            return False
+        entry = self._store.get(version)
+        if entry is None or entry[0].status != PENDING:
+            with self._lock:
+                self._stale_verdicts += 1
+            return False
+        meta = entry[0]
+        meta.score = score
+        if promote:
+            self._promote(meta)
+        else:
+            self._reject(meta)
+        return True
+
+    # -- lifecycle transitions ------------------------------------------
+
+    def _promote(self, meta: CandidateMeta) -> None:
+        entry = self._store.get(meta.version)
+        if entry is None:
+            return
+        _m, leaves, tree = entry
+        self._store.mark(meta.version, PROMOTED, meta.score)
+        if self._serving is not None:
+            self._serving.clear_candidate()
+        if self._on_promote is not None:
+            self._on_promote(meta, leaves, tree)
+        else:
+            self._server.publish(leaves, notify=True)
+            if self._serving is not None and tree is not None:
+                self._serving.set_params(tree)
+        with self._lock:
+            self._prior = self._live
+            self._live = entry
+            self._promotions += 1
+        self._promo_lat.add_s(time.monotonic() - meta.submitted_at)
+
+    def _reject(self, meta: CandidateMeta) -> None:
+        self._store.mark(meta.version, REJECTED, meta.score)
+        if self._serving is not None:
+            self._serving.clear_candidate()
+        with self._lock:
+            self._rejections += 1
+        self._log(
+            f"candidate {meta.version} REJECTED "
+            f"(score {meta.score}); fleet unchanged"
+        )
+
+    def check_timeouts(self) -> int:
+        """Quarantine pending candidates nobody judged in time (the
+        evaluator died mid-verdict). Serving is unaffected — the
+        candidate was never promoted; its canary lanes are cleared so
+        the fleet is 100% last-good again. Returns how many were
+        quarantined. Call from the trainer's log tick."""
+        now = time.monotonic()
+        quarantined = 0
+        while True:
+            entry = self._store.oldest_pending()
+            if entry is None:
+                break
+            meta = entry[0]
+            if now - meta.submitted_at < self._verdict_timeout:
+                break
+            self._store.mark(meta.version, QUARANTINED)
+            if self._serving is not None:
+                self._serving.clear_candidate()
+            quarantined += 1
+            self._log(
+                f"candidate {meta.version} QUARANTINED (no verdict in "
+                f"{self._verdict_timeout:.0f}s — evaluator dead?)"
+            )
+        if quarantined:
+            with self._lock:
+                self._quarantines += quarantined
+        return quarantined
+
+    # -- the one knob ---------------------------------------------------
+
+    def rollback(self, *, depose_live: bool = False) -> int:
+        """One-knob rollback: bump the fencing epoch and re-publish
+        the last-good version under the new reign. Everything else is
+        machinery that already exists — the version re-stamp makes
+        every actor re-fetch, ``ParamTailer.min_epoch`` and the
+        ``Redirector`` reign fence drop the deposed reign's late
+        frames. With ``depose_live`` the CURRENT promoted version is
+        the thing being deposed (a bad promotion slipped the gate) and
+        the fleet returns to the one before it; otherwise the rollback
+        re-pins the fleet on the current promoted version (deposing
+        whatever un-promoted candidate was in flight). Returns the new
+        epoch."""
+        with self._lock:
+            if depose_live and self._prior is not None:
+                deposed, target = self._live, self._prior
+                self._live, self._prior = self._prior, None
+            else:
+                deposed, target = None, self._live
+            self._rollbacks += 1
+        if deposed is not None:
+            self._store.mark(deposed[0].version, DEPOSED)
+        # Depose any in-flight candidate too: its verdict is moot.
+        pending = self._store.oldest_pending()
+        if pending is not None:
+            self._store.mark(pending[0].version, DEPOSED)
+        if self._serving is not None:
+            self._serving.clear_candidate()
+        new_epoch = self._server.set_epoch(int(self._server.epoch) + 1)
+        if target is not None:
+            meta, leaves, tree = target
+            if self._on_promote is not None:
+                self._on_promote(meta, leaves, tree)
+            else:
+                self._server.publish(leaves, notify=True)
+                if self._serving is not None and tree is not None:
+                    self._serving.set_params(tree)
+            self._log(
+                f"rolled back to version {meta.version} under epoch "
+                f"{new_epoch}"
+            )
+        return new_epoch
+
+    # -- observability --------------------------------------------------
+
+    def metrics(self) -> dict:
+        with self._lock:
+            m = {
+                "delivery_candidates": self._candidates,
+                "delivery_promotions": self._promotions,
+                "delivery_rejections": self._rejections,
+                "delivery_quarantines": self._quarantines,
+                "delivery_rollbacks": self._rollbacks,
+                "delivery_bad_signatures": self._bad_signatures,
+                "delivery_stale_verdicts": self._stale_verdicts,
+            }
+        m.update(self._store.metrics())
+        m.update(self._promo_lat.summary(metric_names.PROMO))
+        return m
+
+
+def greedy_checkpoint_scorer(
+    algo: str, cfg, checkpoint_dir: str, *, num_envs: int = 16,
+    max_steps: int = 500, stochastic: bool = False, seed: int = 1234
+):
+    """A ``score_fn`` that re-scores the newest checkpoint with the
+    greedy-eval path PERF.md's bars are defined against (the candidate
+    leaves identify WHICH weights; the Checkpointer artifact carries
+    the full restorable state the evaluator loads)."""
+    from actor_critic_algs_on_tensorflow_tpu.algos.evaluation import (
+        evaluate_checkpoint,
+    )
+
+    def score_fn(meta: CandidateMeta, leaves) -> float:
+        mean_return, _per_env, _finished = evaluate_checkpoint(
+            algo, cfg, checkpoint_dir,
+            num_envs=num_envs, max_steps=max_steps,
+            stochastic=stochastic, seed=seed,
+        )
+        return float(mean_return)
+
+    return score_fn
+
+
+def run_evaluator(
+    host: str,
+    port: int,
+    *,
+    score_fn: Callable[[CandidateMeta, List[np.ndarray]], float],
+    bar: float,
+    secret=None,
+    evaluator_id: int = 9000,
+    generation: int = 0,
+    poll_interval_s: float = 0.2,
+    max_candidates: Optional[int] = None,
+    stop_event: Optional[threading.Event] = None,
+    log: Callable[[str], None] | None = None,
+) -> int:
+    """The evaluator tier's main loop (process or thread entry).
+
+    Polls the learner for pending candidates, scores each with
+    ``score_fn(meta, leaves)``, and sends a signed PROMOTE verdict
+    when ``score >= bar`` (REJECT otherwise — including a NaN score:
+    a candidate that cannot be scored must not reach the fleet).
+    Exits on learner shutdown, ``stop_event``, or after
+    ``max_candidates`` verdicts; returns the verdict count.
+    """
+    emit = log if log is not None else (
+        lambda msg: print(f"[evaluator {evaluator_id}] {msg}", flush=True)
+    )
+    client = ActorClient(
+        host, port,
+        hello=(evaluator_id, generation, ROLE_EVALUATOR, CAP_DELIVERY),
+    )
+    verdicts = 0
+    seq = 0
+    try:
+        while stop_event is None or not stop_event.is_set():
+            out = client.candidate_request(seq)
+            seq += 1
+            header = (
+                np.asarray(out[0], np.int64).reshape(-1)
+                if out else np.zeros(4, np.int64)
+            )
+            version = int(header[0])
+            if version == 0:
+                time.sleep(poll_interval_s)
+                continue
+            step, epoch = int(header[1]), int(header[2])
+            n_leaves = int(header[3])
+            leaves = [np.asarray(a) for a in out[1 : 1 + n_leaves]]
+            meta = CandidateMeta(version, step, epoch)
+            try:
+                score = float(score_fn(meta, leaves))
+            except Exception as e:  # noqa: BLE001 — judge, don't crash
+                emit(
+                    f"score_fn failed for candidate {version}: "
+                    f"{type(e).__name__}: {e}; rejecting"
+                )
+                score = float("nan")
+            promote = bool(score >= bar) and np.isfinite(score)
+            sig = sign_verdict(
+                secret, version, step, epoch, promote, score
+            )
+            client.send_verdict(
+                version,
+                [
+                    np.asarray(
+                        [version, 1 if promote else 0, epoch, step],
+                        np.int64,
+                    ),
+                    np.asarray([score, bar], np.float64),
+                    sig,
+                ],
+            )
+            verdicts += 1
+            emit(
+                f"candidate {version} (step {step}): score "
+                f"{score:.3f} vs bar {bar:.3f} -> "
+                f"{'PROMOTE' if promote else 'REJECT'}"
+            )
+            if max_candidates is not None and verdicts >= max_candidates:
+                break
+    except LearnerShutdown:
+        emit("learner closed the stream; exiting")
+    except (ConnectionError, OSError) as e:
+        emit(f"transport failed: {type(e).__name__}: {e}")
+    finally:
+        try:
+            client.close()
+        except Exception:
+            pass
+    return verdicts
+
+
+def evaluator_process_main(
+    host: str, port: int, *, bar: float, secret=None,
+    evaluator_id: int = 9000, poll_interval_s: float = 0.2,
+    score_leaf_index: int = 0,
+) -> None:
+    """Entry point for a spawned evaluator PROCESS in tests/benches:
+    scores a candidate by the mean of one param leaf (cheap and
+    deterministic — real deployments pass ``greedy_checkpoint_scorer``
+    to ``run_evaluator`` instead)."""
+
+    def score_fn(meta, leaves):
+        leaf = np.asarray(leaves[score_leaf_index], np.float64)
+        return float(leaf.mean()) if leaf.size else float("nan")
+
+    run_evaluator(
+        host, port,
+        score_fn=score_fn, bar=bar, secret=secret,
+        evaluator_id=evaluator_id, poll_interval_s=poll_interval_s,
+    )

@@ -266,6 +266,13 @@ class InferenceServer:
         self._pending: List[_Pending] = []
         self._lanes: Dict[int, _Lane] = {}
         self._stop = False
+        # Candidate lanes (continuous delivery): a canary routes a
+        # deterministic fraction of lanes to the candidate params; a
+        # shadow scores the candidate against live traffic without
+        # serving its actions. Reference stores (GIL-atomic), same
+        # discipline as self._params.
+        self._canary: Optional[Tuple[Any, int, float]] = None
+        self._shadow: Optional[Tuple[Any, int]] = None
         # Counters (all under self._lock).
         self._requests = 0
         self._dup_replays = 0
@@ -277,6 +284,11 @@ class InferenceServer:
         self._reply_failures = 0
         self._param_swaps = 0
         self._lane_retires = 0
+        self._canary_requests = 0
+        self._canary_batches = 0
+        self._candidate_clears = 0
+        self._shadow_batches = 0
+        self._shadow_div_sum = 0.0
         self._act_lat = LatencyStats()
         self._tick = threading.Thread(
             target=self._tick_loop, name="inference-server-tick", daemon=True
@@ -295,6 +307,48 @@ class InferenceServer:
         self._params = params
         with self._lock:
             self._param_swaps += 1
+
+    # -- candidate lanes (continuous delivery) --------------------------
+
+    @staticmethod
+    def _lane_slot(lane_key: int) -> float:
+        """Deterministic [0, 1) slot for a lane (Knuth multiplicative
+        hash on the lane key): stable across processes and restarts,
+        so a lane's canary membership never flaps while the fraction
+        holds — each actor sees ONE policy per candidate, not a
+        per-tick coin flip."""
+        return ((int(lane_key) * 2654435761) & 0xFFFFFFFF) / 2.0**32
+
+    def set_canary(self, params, version: int, fraction: float) -> None:
+        """Stage candidate params on a canary lane slice: lanes whose
+        slot falls below ``fraction`` are served BY the candidate from
+        the next tick on (their builders keep assembling segments —
+        canary experience trains like any other). Everyone else stays
+        on the live params until a PROMOTE lands."""
+        with self._lock:
+            self._canary = (
+                params, int(version), min(max(float(fraction), 0.0), 1.0)
+            )
+
+    def set_shadow(self, params, version: int) -> None:
+        """Stage candidate params in shadow: every tick ALSO runs the
+        candidate on the live batch (same obs, same PRNG key) and
+        records action divergence, but only the live policy's actions
+        are served — zero blast radius scoring."""
+        with self._lock:
+            self._shadow = (params, int(version))
+
+    def clear_candidate(self) -> bool:
+        """Drop any staged canary/shadow candidate (REJECT verdict, or
+        a rollback deposing it): the next tick serves every lane from
+        the live params again. Returns whether anything was staged."""
+        with self._lock:
+            had = self._canary is not None or self._shadow is not None
+            self._canary = None
+            self._shadow = None
+            if had:
+                self._candidate_clears += 1
+        return had
 
     # -- request ingress (connection threads) ---------------------------
 
@@ -435,6 +489,46 @@ class InferenceServer:
                 )
 
     def _process(self, reqs: List[_Pending]) -> None:
+        # Partition the tick's requests into per-policy act() groups:
+        # canary lanes get the candidate params, everyone else the
+        # live params. With no candidate staged this is ONE group and
+        # one dispatch, exactly the pre-delivery hot path.
+        canary = self._canary
+        shadow = self._shadow
+        shadow_params = shadow[0] if shadow is not None else None
+        if canary is None:
+            self._dispatch(
+                self._params, reqs, is_canary=False,
+                shadow_params=shadow_params,
+            )
+            return
+        cparams, _cversion, fraction = canary
+        live = [
+            r for r in reqs
+            if self._lane_slot(r.lane.actor_id) >= fraction
+        ]
+        routed = [
+            r for r in reqs
+            if self._lane_slot(r.lane.actor_id) < fraction
+        ]
+        if live:
+            self._dispatch(
+                self._params, live, is_canary=False,
+                shadow_params=shadow_params,
+            )
+        if routed:
+            self._dispatch(
+                cparams, routed, is_canary=True, shadow_params=None
+            )
+
+    def _dispatch(
+        self,
+        params,
+        reqs: List[_Pending],
+        *,
+        is_canary: bool,
+        shadow_params=None,
+    ) -> None:
         import jax
 
         n = len(reqs)
@@ -459,9 +553,13 @@ class InferenceServer:
             cols.append(col)
         obs = jax.tree_util.tree_unflatten(self._obs_treedef, cols)
         self._key, k = jax.random.split(self._key)
-        params = self._params
+        shadow_actions = None
         if self._exec_lock is None:
             actions, log_probs = self._act(params, obs, k)
+            if shadow_params is not None:
+                # Same obs, same key: divergence measures the params
+                # delta, not sampling noise.
+                shadow_actions, _ = self._act(shadow_params, obs, k)
         else:
             # CPU-mesh serialize rule (see ImpalaActor._run_serialized):
             # every jitted dispatch runs to completion under the shared
@@ -469,8 +567,18 @@ class InferenceServer:
             with self._exec_lock:
                 actions, log_probs = self._act(params, obs, k)
                 jax.block_until_ready((actions, log_probs))
+                if shadow_params is not None:
+                    shadow_actions, _ = self._act(shadow_params, obs, k)
+                    jax.block_until_ready(shadow_actions)
         actions = np.asarray(actions)
         log_probs = np.asarray(log_probs)
+        if shadow_actions is not None:
+            served = actions[: n * self._rows]
+            mirror = np.asarray(shadow_actions)[: n * self._rows]
+            if np.issubdtype(served.dtype, np.integer):
+                div = float(np.mean(served != mirror))
+            else:
+                div = float(np.mean(np.abs(served - mirror)))
         segments: List[Tuple[int, tuple]] = []
         replies: List[Tuple[_Pending, List[np.ndarray]]] = []
         now = time.monotonic()
@@ -488,6 +596,12 @@ class InferenceServer:
                     segments.append((r.lane.actor_id, seg))
             self._batches += 1
             self._batched_requests += n
+            if is_canary:
+                self._canary_batches += 1
+                self._canary_requests += n
+            if shadow_actions is not None:
+                self._shadow_batches += 1
+                self._shadow_div_sum += div
         for r, out in replies:
             # r.reply may have been repointed at a retry's live
             # connection by submit(); read it now, after the lane
@@ -532,6 +646,12 @@ class InferenceServer:
 
     def metrics(self) -> dict:
         with self._lock:
+            canary = self._canary
+            fraction = canary[2] if canary is not None else 0.0
+            canary_lanes = sum(
+                1 for key in self._lanes
+                if self._lane_slot(key) < fraction
+            )
             m = {
                 "serve_requests": self._requests,
                 "serve_dup_replays": self._dup_replays,
@@ -546,6 +666,22 @@ class InferenceServer:
                 "serve_param_swaps": self._param_swaps,
                 "serve_lanes": len(self._lanes),
                 "serve_lane_retires": self._lane_retires,
+                # Candidate lanes (continuous delivery): the canary
+                # slice actually routed this instant, its lifetime
+                # traffic, and the shadow scorer's mean divergence
+                # (action mismatch fraction for discrete policies,
+                # mean |delta| for continuous ones).
+                "serve_canary_fraction": fraction,
+                "serve_canary_lanes": canary_lanes,
+                "serve_canary_requests": self._canary_requests,
+                "serve_canary_batches": self._canary_batches,
+                "serve_candidate_clears": self._candidate_clears,
+                "serve_shadow_batches": self._shadow_batches,
+                "serve_shadow_divergence": round(
+                    self._shadow_div_sum
+                    / max(1, self._shadow_batches),
+                    6,
+                ),
             }
         m.update(self._act_lat.summary(metric_names.SERVE_ACT))
         return m

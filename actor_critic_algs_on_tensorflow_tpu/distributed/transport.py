@@ -151,6 +151,23 @@ KIND_RESHARD = 24        # coordinator -> peer: tag = the NEW fencing
 #                          through the redirector tier and re-hello
 #                          under the new epoch (routed to the reshard
 #                          handler, see set_reshard_handler)
+KIND_CANDIDATE = 25      # evaluator -> learner: tag = poll sequence —
+#                          "hand me the oldest unevaluated candidate
+#                          snapshot"; the learner echoes the sequence
+#                          back with arrays = [int64 [version, step,
+#                          epoch, n_leaves] meta] + the candidate's
+#                          param leaves (meta alone, version 0, when
+#                          nothing is pending). Routed to the delivery
+#                          handler, see set_delivery_handler
+KIND_VERDICT = 26        # evaluator -> learner: tag = the candidate
+#                          version judged, arrays = [int64 [version,
+#                          promote, epoch, step], float64 [score, bar],
+#                          uint8 HMAC-SHA256 signature over the
+#                          canonical verdict payload]. One-way: a lost
+#                          verdict re-surfaces on the evaluator's next
+#                          poll (the candidate stays pending), so the
+#                          promotion plane pays no extra round trip
+#                          (routed to the delivery handler)
 
 # KIND_OBS_REQ tag flag bit: the request's arrays are one coded
 # trajectory-codec frame ([meta] + wire leaves — the PR-6 byte-plane
@@ -167,6 +184,13 @@ ROLE_STANDBY = 1
 # transition-pushing actors (whose goodbyes mean nothing tier-wide):
 # see distributed.replay.replay_server_main's goodbye handler.
 ROLE_LEARNER = 2
+# The evaluator tier of the continuous-delivery plane: polls the
+# learner for candidate snapshots (KIND_CANDIDATE) and returns signed
+# PROMOTE/REJECT verdicts (KIND_VERDICT). Its goodbye means nothing
+# fleet-wide — a dead evaluator just leaves candidates pending until
+# the delivery controller's verdict timeout quarantines them (see
+# distributed.delivery).
+ROLE_EVALUATOR = 3
 
 # --- fencing epoch (quorum control plane) ----------------------------
 # The epoch identifies a primary's REIGN: the first primary serves
@@ -213,6 +237,11 @@ CAP_INFERENCE = 2
 # its sample plane from its transition producers (see
 # distributed.replay).
 CAP_REPLAY = 4
+# The peer speaks the continuous-delivery protocol
+# (KIND_CANDIDATE/KIND_VERDICT): announced by evaluator processes so
+# the learner's registry distinguishes the promotion plane from the
+# acting/replay planes (see distributed.delivery.run_evaluator).
+CAP_DELIVERY = 8
 
 _HEADER = struct_lib.Struct(">4sBQI")
 _ARRAY_HEADER = struct_lib.Struct(">B")
@@ -548,6 +577,12 @@ class LearnerServer:
         # KIND_RESHARD frames are routed to it instead of being a
         # protocol error. handler(peer, epoch, shard_count, plan_json).
         self._reshard = None
+        # Continuous-delivery handler (distributed.delivery): routes
+        # KIND_CANDIDATE polls and KIND_VERDICT frames from evaluator
+        # peers to the DeliveryController. handler(peer, kind, tag,
+        # arrays, reply) — reply sends the candidate frame, None for
+        # the one-way verdict.
+        self._delivery = None
         self._idle_timeout = idle_timeout_s
         # Param wire codec (distributed.codec): keep a small ring of
         # recent published versions' wire leaves and serve an XOR-delta
@@ -624,6 +659,10 @@ class LearnerServer:
         # answered from the registry, reshard replan notices received.
         self._member_reqs = 0
         self._reshards_in = 0
+        # Continuous-delivery control plane: evaluator candidate polls
+        # answered, signed verdicts received.
+        self._candidate_polls = 0
+        self._verdicts_in = 0
         # Param-staleness-at-fetch accounting (actors only, excluding
         # the first fetch): how many publishes behind a fetching actor
         # was when it asked. The mid-rollout-fetch A/B reads this as
@@ -700,6 +739,18 @@ class LearnerServer:
         ``KIND_MEMBER_REQ`` needs no handler: the server answers it
         from the hello/generation registry directly."""
         self._reshard = handler
+
+    def set_delivery_handler(self, handler) -> None:
+        """Install the continuous-delivery hook
+        (``distributed.delivery.DeliveryController.handle``). Called as
+        ``handler(peer, kind, tag, arrays, reply)`` on the connection's
+        thread for ``KIND_CANDIDATE`` (``reply(arrays)`` sends the
+        candidate frame echoing the poll's sequence tag) and
+        ``KIND_VERDICT`` (one-way; ``reply`` is None). Without a
+        handler either kind is a protocol error — an evaluator pointed
+        at a learner with no delivery plane fails loudly instead of
+        polling forever."""
+        self._delivery = handler
 
     def set_goodbye_handler(self, handler) -> None:
         """Install a hook called with a peer's ``PeerInfo`` when it
@@ -900,6 +951,10 @@ class LearnerServer:
                 # KIND_RESHARD).
                 "transport_member_reqs": self._member_reqs,
                 "transport_reshard_notices": self._reshards_in,
+                # Continuous-delivery control plane (KIND_CANDIDATE /
+                # KIND_VERDICT).
+                "transport_candidate_polls": self._candidate_polls,
+                "transport_verdicts_in": self._verdicts_in,
                 # Mean publishes-behind at actor param fetches (first
                 # fetches excluded — "behind" is undefined before a
                 # version is held).
@@ -1108,6 +1163,17 @@ class LearnerServer:
         with self._reg_lock:
             self._sample_batches += 1
             self._sample_bytes_out += n
+        return True
+
+    def _reply_candidate(self, c: _Conn, seq: int, arrays) -> bool:
+        """Send one ``KIND_CANDIDATE`` reply on ``c`` (called by the
+        delivery handler, on the connection's thread). False when the
+        connection is already gone — the evaluator reconnects and
+        polls again; the candidate stays pending until judged."""
+        try:
+            self._send(c, KIND_CANDIDATE, seq, arrays)
+        except (OSError, ValueError):
+            return False
         return True
 
     def _retire(self, c: _Conn, reason: str) -> None:
@@ -1319,6 +1385,36 @@ class LearnerServer:
                     handler(
                         peer, int(rmeta[0]), int(rmeta[1]), plan_json
                     )
+                elif kind in (KIND_CANDIDATE, KIND_VERDICT):
+                    handler = self._delivery
+                    if handler is None:
+                        # An evaluator pointed at a learner with no
+                        # delivery plane must fail loudly, not poll a
+                        # candidate that will never come.
+                        raise ConnectionError(
+                            "delivery frame (kind "
+                            f"{kind}) but no delivery handler is "
+                            "installed on this server"
+                        )
+                    with self._reg_lock:
+                        peer = PeerInfo(
+                            c.cid, c.actor_id, c.generation, c.role,
+                            c.caps, c.epoch,
+                        )
+                        if kind == KIND_CANDIDATE:
+                            self._candidate_polls += 1
+                        else:
+                            self._verdicts_in += 1
+                    reply = (
+                        (
+                            lambda arrs, _c=c, _s=tag: (
+                                self._reply_candidate(_c, _s, arrs)
+                            )
+                        )
+                        if kind == KIND_CANDIDATE
+                        else None
+                    )
+                    handler(peer, kind, tag, arrays, reply)
                 elif kind == KIND_GET_PARAMS:
                     # tag = the version the client already holds (0 =
                     # none / legacy client): ring hit -> delta frame.
@@ -1852,6 +1948,42 @@ class ActorClient:
             plan_json.encode("utf-8"), np.uint8
         ).copy()
         self._send(KIND_RESHARD, int(epoch), (meta, blob))
+
+    def candidate_request(self, seq: int = 0) -> List[np.ndarray]:
+        """Poll the learner's delivery plane for the oldest
+        unevaluated candidate snapshot and block for the reply.
+        Returns the reply's arrays: ``[int64 [version, step, epoch,
+        n_leaves] meta] + leaves`` — meta with version 0 (and no
+        leaves) when nothing is pending. ``seq`` tags the poll and
+        must be echoed back (the strictly request/reply stream
+        discipline shared with ``act_request``)."""
+        self._send(KIND_CANDIDATE, seq)
+        kind, rtag, out = self._await_reply()
+        if kind != KIND_CANDIDATE:
+            raise ConnectionError(
+                f"expected CANDIDATE, got kind {kind}"
+            )
+        if rtag != seq:
+            raise ConnectionError(
+                f"candidate reply for seq {rtag}, expected {seq}"
+            )
+        return out
+
+    def send_verdict(
+        self, version: int, arrays: Sequence[np.ndarray]
+    ) -> None:
+        """One-way signed verdict for candidate ``version``: arrays =
+        ``[int64 [version, promote, epoch, step], float64 [score,
+        bar], uint8 signature]`` (see ``distributed.delivery`` for the
+        signing scheme). No reply — a lost verdict leaves the
+        candidate pending and the evaluator's next poll re-surfaces
+        it; a send failure surfaces as ``ConnectionError`` so the
+        caller reconnects (re-judging a candidate is idempotent: the
+        controller drops verdicts for versions no longer pending)."""
+        self._send(
+            KIND_VERDICT, int(version),
+            [np.asarray(a) for a in arrays],
+        )
 
     def fetch_params(self) -> Tuple[int, List[np.ndarray]]:
         """Fetch the newest published params, reporting the version

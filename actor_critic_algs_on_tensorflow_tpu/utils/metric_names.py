@@ -33,6 +33,8 @@ SHARD = "shard"          # shard{N}_* dynamic keys + shard_* statics
 REPLAY = "replay_"       # prioritized replay tier (distributed/replay.py)
 ELASTIC = "elastic_"     # live membership / resharding (distributed/elastic.py)
 AUTOSCALER = "autoscaler_"   # fleet-scale policy (distributed/elastic.py)
+DELIVERY = "delivery_"   # continuous delivery (distributed/delivery.py)
+PROMO = "promo_"         # promotion latency (LatencyStats.summary prefix)
 SERVE_ACT = SERVE + "act_"   # LatencyStats.summary prefix (serving tier)
 REPLAY_SAMPLE = REPLAY + "sample_"  # LatencyStats.summary prefix (draws)
 REPLAY_PIPELINE = REPLAY + "pipeline_"  # learner-side replay pipeline
@@ -40,7 +42,7 @@ REPLAY_PIPELINE = REPLAY + "pipeline_"  # learner-side replay pipeline
 
 FAMILY_PREFIXES = (
     TRANSPORT, PIPELINE, SERVE, DEVICE, SHARD, REPLAY, ELASTIC,
-    AUTOSCALER, REPLAY_PIPELINE,
+    AUTOSCALER, REPLAY_PIPELINE, DELIVERY, PROMO,
 )
 
 # --- registry: family key -> one-line provenance ---------------------
@@ -71,6 +73,8 @@ METRIC_NAMES: dict = {
     TRANSPORT + "prio_updates": "replay-tier priority updates received",
     TRANSPORT + "member_reqs": "membership-view requests answered",
     TRANSPORT + "reshard_notices": "elastic replan notices received",
+    TRANSPORT + "candidate_polls": "evaluator candidate polls answered",
+    TRANSPORT + "verdicts_in": "signed promotion verdicts received",
     TRANSPORT + "param_staleness_mean": "mean publishes-behind at fetch",
     TRANSPORT + "pings": "heartbeat probes received",
     TRANSPORT + "hellos": "identity announcements received",
@@ -113,6 +117,16 @@ METRIC_NAMES: dict = {
     SERVE + "lanes": "live per-actor lanes",
     SERVE + "lane_retires": "lanes retired on actor goodbyes "
                             "(elastic leave)",
+    SERVE + "canary_fraction": "configured canary lane fraction "
+                               "(0 = no candidate staged)",
+    SERVE + "canary_lanes": "lanes currently routed to the candidate",
+    SERVE + "canary_requests": "requests served BY the candidate",
+    SERVE + "canary_batches": "candidate-params act() dispatches",
+    SERVE + "candidate_clears": "staged candidates cleared "
+                                "(reject/rollback)",
+    SERVE + "shadow_batches": "shadow-scored act() dispatches",
+    SERVE + "shadow_divergence": "mean live-vs-candidate action "
+                                 "divergence under shadow",
     SERVE_ACT + "count": "act latency samples",
     SERVE_ACT + "mean_ms": "act latency mean",
     SERVE_ACT + "p50_ms": "act latency p50",
@@ -175,6 +189,8 @@ METRIC_NAMES: dict = {
                             "takeover/resume)",
     REPLAY + "shards_restoring": "shards currently loading a ring "
                                  "snapshot",
+    REPLAY + "reshards": "live ring re-deals applied (autoscale_"
+                         "reshard topology changes)",
     # -- replay_pipeline_*: learner-side replay pipeline (PR 17:
     # data/replay_pipeline.py TimeSplit buckets + counters, surfaced
     # through the off-policy learner loop's log tick)
@@ -232,6 +248,32 @@ METRIC_NAMES: dict = {
     REPLAY_SAMPLE + "p50_ms": "sample-draw latency p50",
     REPLAY_SAMPLE + "p99_ms": "sample-draw latency p99",
     REPLAY_SAMPLE + "max_ms": "sample-draw latency max",
+    # -- delivery_*: continuous-delivery controller + policy store
+    # (distributed/delivery.py, surfaced through the trainers' log
+    # ticks and scripts/delivery_bench.py)
+    DELIVERY + "candidates": "candidate snapshots submitted",
+    DELIVERY + "promotions": "candidates promoted to the fleet "
+                             "(incl. the bootstrap auto-promote)",
+    DELIVERY + "rejections": "candidates rejected by the eval gate",
+    DELIVERY + "quarantines": "candidates quarantined on verdict "
+                              "timeout (evaluator dead)",
+    DELIVERY + "rollbacks": "one-knob epoch-bump rollbacks taken",
+    DELIVERY + "bad_signatures": "verdicts dropped on signature "
+                                 "verification failure",
+    DELIVERY + "stale_verdicts": "verdicts for no-longer-pending "
+                                 "candidates dropped",
+    DELIVERY + "store_size": "candidates resident in the policy store",
+    DELIVERY + "store_evictions": "settled candidates evicted from "
+                                  "the keep window",
+    DELIVERY + "pending": "candidates awaiting a verdict",
+    # -- promo_*: candidate-submitted -> promoted-and-serving latency
+    # (DeliveryController's LatencyStats.summary)
+    PROMO + "count": "promotion latency samples",
+    PROMO + "mean_ms": "promotion latency mean",
+    PROMO + "p50_ms": "promotion latency p50 (the BENCH_PROMOTION "
+                      "headline)",
+    PROMO + "p99_ms": "promotion latency p99",
+    PROMO + "max_ms": "promotion latency max",
     # -- shard*: sharded-learner log attribution (algos/impala.py)
     # + the shard bench ledger (scripts/shard_bench.py)
     SHARD + "_count": "topology echo: shard count (log attribution)",

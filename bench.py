@@ -94,6 +94,14 @@ mid-run ChaosProxy link flap and exact row accounting. Merged under
 ``"elastic"`` with the required key set pinned by
 ``analysis/bench_schema.py`` (scripts/elastic_bench.py owns the
 drill).
+
+Optional continuous-delivery leg (``BENCH_PROMOTION=1``): a
+subprocess runs the promotion drill — eval-gated promote latency
+through the real candidate/verdict wire, the poisoned-candidate
+auto-reject under live canary traffic, a one-knob epoch rollback, and
+a SIGKILLed evaluator quarantine. Merged under ``"promotion"`` with
+the required key set pinned by ``analysis/bench_schema.py``
+(scripts/delivery_bench.py owns the drill).
 """
 
 from __future__ import annotations
@@ -558,6 +566,21 @@ def measure_elastic() -> dict:
     return elb.bench()
 
 
+def measure_promotion() -> dict:
+    """Continuous-delivery leg (scripts/delivery_bench.py owns the
+    drill): eval-gated promote latency p50/p99 over the real
+    candidate/verdict wire, poisoned-candidate auto-reject under live
+    canary traffic, one-knob rollback, SIGKILLed-evaluator
+    quarantine — returns the drill's verdict dict."""
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts"),
+    )
+    import delivery_bench as dlb
+
+    return dlb.bench()
+
+
 def _notify_latencies_ms(cpb, versions) -> list:
     """publish() -> fetch-complete latencies (ms); the harness itself
     lives in controlplane_bench (single source of truth)."""
@@ -643,6 +666,15 @@ def main() -> int:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         try:
             print(json.dumps(measure_elastic()))
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+            return 1
+        return 0
+
+    if len(sys.argv) > 1 and sys.argv[1] == "--measure-promotion":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        try:
+            print(json.dumps(measure_promotion()))
         except Exception:
             traceback.print_exc(file=sys.stderr)
             return 1
@@ -903,6 +935,27 @@ def main() -> int:
             sys.stderr.write(
                 "[bench] elastic leg failed\n"
                 + (echild.stderr[-2000:] if echild is not None else "")
+            )
+    if os.environ.get("BENCH_PROMOTION"):
+        dchild = None
+        try:
+            dchild = subprocess.run(
+                [
+                    sys.executable, os.path.abspath(__file__),
+                    "--measure-promotion",
+                ],
+                capture_output=True,
+                text=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                timeout=int(os.environ.get("BENCH_CHILD_TIMEOUT", 900)),
+            )
+            payload["promotion"] = json.loads(
+                dchild.stdout.strip().splitlines()[-1]
+            )
+        except Exception:
+            sys.stderr.write(
+                "[bench] promotion leg failed\n"
+                + (dchild.stderr[-2000:] if dchild is not None else "")
             )
     if os.environ.get("BENCH_SERVE"):
         schild = None

@@ -147,7 +147,7 @@ def failover_leg(mode: str) -> float:
 
         def redirect(h, p):
             result.setdefault("redirect_t", time.monotonic())
-            redirector.redirect(h, p)
+            redirector.redirect(h, p, force=True)
 
         def on_serving(h, p):
             # The hot-standby data plane is up: arm the fallback route
@@ -216,7 +216,7 @@ def failover_leg(mode: str) -> float:
             args=(cfg, primary_port, tmp, t0), daemon=True,
         )
         cold.start()
-        redirector.redirect("127.0.0.1", primary_port)
+        redirector.redirect("127.0.0.1", primary_port, force=True)
         cold.join(timeout=570.0)
         gap = float("nan")
     primary.join(timeout=5.0)

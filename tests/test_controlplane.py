@@ -188,7 +188,7 @@ def test_redirector_moves_fleet_to_new_learner():
             )
             client.push_trajectory([np.array([1], np.int64)])
             assert got1 == [1]
-            n_reset = proxy.redirect("127.0.0.1", s2.port)
+            n_reset = proxy.redirect("127.0.0.1", s2.port, force=True)
             assert n_reset >= 1  # the live link was kicked over
             client.push_trajectory([np.array([2], np.int64)])
             assert got2 == [2] and got1 == [1]

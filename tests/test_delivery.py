@@ -1,0 +1,705 @@
+"""Continuous policy delivery (ISSUE 18): eval-gated promotion,
+canary/shadow serving, one-knob epoch rollback.
+
+The correctness spine: new weights are CANDIDATES until a signed
+verdict promotes them — a poisoned candidate must be auto-rejected
+while canary lanes keep serving exactly-once, and one ``rollback()``
+(a single fencing-epoch bump) must re-pin the whole fleet on the
+last-good version with the deposed reign's late frames fenced. Pinned
+here against the real wire (``KIND_CANDIDATE``/``KIND_VERDICT``
+through a live ``LearnerServer``), the serving tier's per-lane
+canary/shadow groups, and the store's spill/restore discipline.
+"""
+
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from actor_critic_algs_on_tensorflow_tpu.distributed import delivery
+from actor_critic_algs_on_tensorflow_tpu.distributed.delivery import (
+    DEPOSED,
+    PENDING,
+    PROMOTED,
+    QUARANTINED,
+    REJECTED,
+    CandidateMeta,
+    DeliveryController,
+    PolicyStore,
+    run_evaluator,
+    sign_verdict,
+    verify_verdict,
+)
+from actor_critic_algs_on_tensorflow_tpu.distributed.serving import (
+    N_STEP_LEAVES,
+    InferenceServer,
+)
+from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+    CAP_DELIVERY,
+    KIND_CANDIDATE,
+    KIND_VERDICT,
+    ROLE_EVALUATOR,
+    ActorClient,
+    LearnerServer,
+    PeerInfo,
+    epoch_of,
+    version_seq,
+)
+from tests.helpers import PortReservation, time_limit
+
+pytestmark = pytest.mark.delivery
+
+B, D = 2, 3  # env rows per request / obs feature dim
+
+
+def _leaves(value: float, n: int = 2):
+    return [
+        np.full((4,), float(value), np.float32)
+        for _ in range(n)
+    ]
+
+
+class _FakeServer:
+    """The controller's server surface: version/epoch state + publish."""
+
+    def __init__(self):
+        self.epoch = 0
+        self.version = 0
+        self.published = []
+
+    def publish(self, leaves, notify=True):
+        self.version += 1
+        self.published.append([np.asarray(x).copy() for x in leaves])
+        return self.version
+
+    def set_epoch(self, epoch):
+        self.epoch = int(epoch)
+        return self.epoch
+
+
+def _verdict_frame(secret, meta, promote, score, *, version=None):
+    version = meta.version if version is None else version
+    return [
+        np.asarray(
+            [version, 1 if promote else 0, meta.epoch, meta.step],
+            np.int64,
+        ),
+        np.asarray([score, 0.0], np.float64),
+        sign_verdict(
+            secret, version, meta.step, meta.epoch, promote, score
+        ),
+    ]
+
+
+# ---------------------------------------------------------------------
+# Verdict signatures.
+# ---------------------------------------------------------------------
+
+def test_sign_verify_roundtrip_and_tamper():
+    sig = sign_verdict(b"k", 7, 3, 1, True, 123.5)
+    assert sig.dtype == np.uint8 and sig.size == 32
+    assert verify_verdict(b"k", 7, 3, 1, True, 123.5, sig)
+    # Any field flip — or the wrong secret — breaks the signature.
+    assert not verify_verdict(b"k", 8, 3, 1, True, 123.5, sig)
+    assert not verify_verdict(b"k", 7, 3, 1, False, 123.5, sig)
+    assert not verify_verdict(b"k", 7, 3, 1, True, 123.6, sig)
+    assert not verify_verdict(b"other", 7, 3, 1, True, 123.5, sig)
+    assert not verify_verdict(b"k", 7, 3, 1, True, 123.5, sig[:16])
+
+
+# ---------------------------------------------------------------------
+# PolicyStore: lifecycle, spill, eviction.
+# ---------------------------------------------------------------------
+
+def test_policy_store_roundtrip_and_pending_order(tmp_path):
+    store = PolicyStore(str(tmp_path), keep=8)
+    m1 = CandidateMeta(101, step=10, epoch=0)
+    m2 = CandidateMeta(102, step=20, epoch=0)
+    store.put(m1, _leaves(1.0))
+    store.put(m2, _leaves(2.0))
+    # Oldest pending first — the evaluator judges in submit order.
+    meta, leaves, _tree = store.oldest_pending()
+    assert meta.version == 101
+    np.testing.assert_array_equal(leaves[0], _leaves(1.0)[0])
+    # Spill is durable: leaves reload from the npz cut.
+    reloaded = store.load_leaves(102)
+    np.testing.assert_array_equal(reloaded[0], _leaves(2.0)[0])
+    assert store.mark(101, PROMOTED, score=5.0)
+    assert store.oldest_pending()[0].version == 102
+    assert store.statuses() == {PROMOTED: 1, PENDING: 1}
+    # The manifest rides every mutation (the restart story).
+    assert (tmp_path / "manifest.json").exists()
+
+
+def test_policy_store_evicts_settled_never_pending(tmp_path):
+    store = PolicyStore(str(tmp_path), keep=2)
+    for v in range(1, 6):
+        meta = CandidateMeta(v, step=v, epoch=0)
+        store.put(meta, _leaves(v))
+        if v <= 3:
+            store.mark(v, REJECTED)
+    m = store.metrics()
+    # 2 pending (4, 5) survive plus at most keep settled.
+    assert m["delivery_pending"] == 2
+    assert m["delivery_store_evictions"] >= 1
+    assert store.get(4) is not None and store.get(5) is not None
+
+
+# ---------------------------------------------------------------------
+# DeliveryController: bootstrap, gate, quarantine, rollback.
+# ---------------------------------------------------------------------
+
+def test_bootstrap_auto_promotes_then_gates():
+    server = _FakeServer()
+    ctl = DeliveryController(
+        PolicyStore(), server, secret=b"s", log=lambda m: None
+    )
+    m0 = ctl.submit(_leaves(0.0))
+    assert m0.status == PROMOTED
+    assert len(server.published) == 1  # the fleet never blocks on v0
+    m1 = ctl.submit(_leaves(1.0))
+    assert m1.status == PENDING
+    assert len(server.published) == 1  # gated: nothing shipped
+    frame = _verdict_frame(b"s", m1, True, 9.0)
+    ctl.handle(None, KIND_VERDICT, 0, frame, None)
+    assert m1.status == PROMOTED
+    assert len(server.published) == 2
+    met = ctl.metrics()
+    assert met["delivery_promotions"] == 2
+    assert met["promo_count"] == 2
+
+
+def test_bad_signature_and_stale_verdicts_dropped():
+    server = _FakeServer()
+    ctl = DeliveryController(
+        PolicyStore(), server, secret=b"s", log=lambda m: None
+    )
+    ctl.submit(_leaves(0.0))
+    m1 = ctl.submit(_leaves(1.0))
+    # Wrong secret: dropped, candidate stays pending.
+    ctl.handle(
+        None, KIND_VERDICT, 0, _verdict_frame(b"wrong", m1, True, 9.0),
+        None,
+    )
+    assert m1.status == PENDING
+    assert ctl.metrics()["delivery_bad_signatures"] == 1
+    # Settle it, then the SAME verdict again is stale (the delivery
+    # layer's late-frame fence).
+    ctl.handle(
+        None, KIND_VERDICT, 0, _verdict_frame(b"s", m1, False, -9.0),
+        None,
+    )
+    assert m1.status == REJECTED
+    ctl.handle(
+        None, KIND_VERDICT, 0, _verdict_frame(b"s", m1, False, -9.0),
+        None,
+    )
+    met = ctl.metrics()
+    assert met["delivery_stale_verdicts"] == 1
+    assert met["delivery_rejections"] == 1
+    assert len(server.published) == 1  # only the bootstrap shipped
+
+
+def test_quarantine_timeout_leaves_serving_on_last_good():
+    server = _FakeServer()
+    ctl = DeliveryController(
+        PolicyStore(), server, secret=b"s",
+        verdict_timeout_s=0.01, log=lambda m: None,
+    )
+    ctl.submit(_leaves(0.0))
+    m1 = ctl.submit(_leaves(1.0))
+    time.sleep(0.05)
+    assert ctl.check_timeouts() == 1
+    assert m1.status == QUARANTINED
+    assert len(server.published) == 1  # fleet untouched
+    assert ctl.metrics()["delivery_quarantines"] == 1
+    # Idempotent: nothing left to quarantine.
+    assert ctl.check_timeouts() == 0
+
+
+def test_rollback_is_one_epoch_bump_and_deposes():
+    server = _FakeServer()
+    ctl = DeliveryController(
+        PolicyStore(), server, secret=b"s", log=lambda m: None
+    )
+    m0 = ctl.submit(_leaves(0.0))       # bootstrap -> live
+    m1 = ctl.submit(_leaves(1.0))
+    ctl.handle(
+        None, KIND_VERDICT, 0, _verdict_frame(b"s", m1, True, 9.0),
+        None,
+    )
+    assert m1.status == PROMOTED        # slipped the gate
+    m2 = ctl.submit(_leaves(2.0))       # in-flight candidate
+    new_epoch = ctl.rollback(depose_live=True)
+    # ONE knob: exactly one epoch bump...
+    assert new_epoch == 1 and server.epoch == 1
+    # ...the bad promotion AND the in-flight candidate are deposed...
+    assert m1.status == DEPOSED and m2.status == DEPOSED
+    # ...and the prior version was re-published under the new reign.
+    np.testing.assert_array_equal(
+        server.published[-1][0], _leaves(0.0)[0]
+    )
+    assert m0.status == PROMOTED
+    # A late verdict from the deposed reign's evaluator is stale.
+    ctl.handle(
+        None, KIND_VERDICT, 0, _verdict_frame(b"s", m2, True, 9.0),
+        None,
+    )
+    assert ctl.metrics()["delivery_stale_verdicts"] == 1
+    assert ctl.metrics()["delivery_rollbacks"] == 1
+
+
+# ---------------------------------------------------------------------
+# Canary/shadow lanes on the serving tier.
+# ---------------------------------------------------------------------
+
+def _pid_act(params, obs, key):
+    """act() whose action IS the params identity — lane routing is
+    directly observable in the replies."""
+    obs = np.asarray(obs)
+    return (
+        np.full(obs.shape[0], int(params["pid"]), np.int32),
+        np.full(obs.shape[0], 0.25, np.float32),
+    )
+
+
+def _mk_serving(sink, *, T=3, batch_max=4, max_wait_s=0.05):
+    obs_treedef = jax.tree_util.tree_structure(np.zeros(1))
+    specs = [((B, D), np.dtype(np.float32))] + [
+        ((B,), np.dtype(np.float32))
+    ] * N_STEP_LEAVES
+    s = InferenceServer(
+        _pid_act,
+        None,
+        obs_treedef=obs_treedef,
+        request_specs=specs,
+        rollout_length=T,
+        batch_max=batch_max,
+        max_wait_s=max_wait_s,
+        sink=sink,
+        seed=0,
+        log=lambda m: None,
+    )
+    s.set_params({"pid": 1})
+    return s
+
+
+def _request_leaves(t: int):
+    return [
+        np.full((B, D), float(t), np.float32),
+        np.full((B,), float(t - 1), np.float32),
+        np.zeros((B,), np.float32),
+        np.full((B,), float(t - 1), np.float32),
+        np.zeros((B,), np.float32),
+    ]
+
+
+def _drive(serving, peer, seq, *, timeout=5.0):
+    box = []
+    done = threading.Event()
+
+    def reply(arrays):
+        box.append(arrays)
+        done.set()
+        return True
+
+    serving.submit(peer, seq, _request_leaves(seq), False, reply)
+    assert done.wait(timeout), f"no reply for seq {seq}"
+    return box[0]
+
+
+# Knuth-hash slots: actor 1 -> ~0.618 (live at fraction 0.5),
+# actor 2 -> ~0.236 (canary at fraction 0.5). Pinned so the routing
+# assertions below are deterministic.
+LIVE_ID, CANARY_ID = 1, 2
+
+
+def test_lane_slots_are_deterministic():
+    s = InferenceServer._lane_slot
+    assert s(LIVE_ID) == pytest.approx(0.618, abs=0.01)
+    assert s(CANARY_ID) == pytest.approx(0.236, abs=0.01)
+    assert s(LIVE_ID) == s(LIVE_ID)  # stable, never a coin flip
+
+
+def test_canary_lane_routing_and_exactly_once():
+    serving = _mk_serving(lambda t, e: None)
+    try:
+        live = PeerInfo(1, LIVE_ID, 0, 0)
+        canary = PeerInfo(2, CANARY_ID, 0, 0)
+        # No candidate staged: both lanes act with the live params.
+        assert int(_drive(serving, live, 0)[0][0]) == 1
+        assert int(_drive(serving, canary, 0)[0][0]) == 1
+        serving.set_canary({"pid": 7}, version=42, fraction=0.5)
+        # Canary lane serves the CANDIDATE; live lane is untouched.
+        assert int(_drive(serving, live, 1)[0][0]) == 1
+        first = _drive(serving, canary, 1)
+        assert int(first[0][0]) == 7
+        # Exactly-once holds on the canary lane: a dup-seq replay
+        # returns the cached reply without re-entering the builder.
+        again = _drive(serving, canary, 1)
+        np.testing.assert_array_equal(first[0], again[0])
+        m = serving.metrics()
+        assert m["serve_dup_replays"] == 1
+        assert m["serve_canary_requests"] >= 1
+        assert m["serve_canary_batches"] >= 1
+        assert m["serve_canary_lanes"] == 1
+        assert m["serve_canary_fraction"] == 0.5
+        # A REJECT clears the lanes: everyone back on live params.
+        assert serving.clear_candidate()
+        assert int(_drive(serving, canary, 2)[0][0]) == 1
+        assert serving.metrics()["serve_candidate_clears"] == 1
+    finally:
+        serving.close()
+
+
+def test_canary_fraction_one_routes_every_lane():
+    serving = _mk_serving(lambda t, e: None)
+    try:
+        serving.set_canary({"pid": 9}, version=5, fraction=1.0)
+        for aid in (LIVE_ID, CANARY_ID):
+            peer = PeerInfo(aid, aid, 0, 0)
+            assert int(_drive(serving, peer, 0)[0][0]) == 9
+    finally:
+        serving.close()
+
+
+def test_shadow_scores_without_serving():
+    serving = _mk_serving(lambda t, e: None)
+    try:
+        peer = PeerInfo(1, LIVE_ID, 0, 0)
+        # Shadow with DIVERGENT params: live actions served, nonzero
+        # divergence recorded.
+        serving.set_shadow({"pid": 3}, version=11)
+        assert int(_drive(serving, peer, 0)[0][0]) == 1  # live served
+        m = serving.metrics()
+        assert m["serve_shadow_batches"] == 1
+        assert m["serve_shadow_divergence"] == pytest.approx(1.0)
+        # Shadow with IDENTICAL params: zero divergence (same obs,
+        # same key — the comparison measures the params delta only).
+        serving.set_shadow({"pid": 1}, version=12)
+        _drive(serving, peer, 1)
+        assert serving.metrics()["serve_shadow_divergence"] < 1.0
+    finally:
+        serving.close()
+
+
+def test_tick_dispatches_per_policy_groups():
+    """One mixed tick = exactly two act() groups (live + canary),
+    each a single dispatch — the pre-delivery hot path stays one
+    batch when no candidate is staged."""
+    serving = _mk_serving(lambda t, e: None, batch_max=4, max_wait_s=0.2)
+    try:
+        serving.set_canary({"pid": 7}, version=1, fraction=0.5)
+        boxes, done = [], []
+
+        def submit(peer, seq):
+            ev = threading.Event()
+            out = []
+
+            def reply(arrays):
+                out.append(arrays)
+                ev.set()
+                return True
+
+            serving.submit(peer, seq, _request_leaves(seq), False, reply)
+            boxes.append(out)
+            done.append(ev)
+
+        submit(PeerInfo(1, LIVE_ID, 0, 0), 0)
+        submit(PeerInfo(2, CANARY_ID, 0, 0), 0)
+        for ev in done:
+            assert ev.wait(5.0)
+        assert int(boxes[0][0][0][0]) == 1
+        assert int(boxes[1][0][0][0]) == 7
+        m = serving.metrics()
+        assert m["serve_batches"] == 2  # one dispatch per policy group
+        assert m["serve_canary_batches"] == 1
+    finally:
+        serving.close()
+
+
+# ---------------------------------------------------------------------
+# The headline drill: poisoned candidate rejected, canary served
+# throughout, one-knob rollback after a bad promotion.
+# ---------------------------------------------------------------------
+
+def test_poisoned_candidate_drill():
+    serving = _mk_serving(lambda t, e: None)
+    server = _FakeServer()
+    ctl = DeliveryController(
+        PolicyStore(), server, serving=serving, secret=b"s",
+        canary_fraction=0.5, log=lambda m: None,
+    )
+    try:
+        live_peer = PeerInfo(1, LIVE_ID, 0, 0)
+        canary_peer = PeerInfo(2, CANARY_ID, 0, 0)
+        ctl.submit(_leaves(0.0), tree={"pid": 1})  # bootstrap -> live
+        # Poisoned candidate arrives: staged on the canary lanes only.
+        bad = ctl.submit(_leaves(-99.0), tree={"pid": 66})
+        assert bad.status == PENDING
+        assert int(_drive(serving, live_peer, 0)[0][0]) == 1
+        r = _drive(serving, canary_peer, 0)
+        assert int(r[0][0]) == 66  # canary lane served the candidate
+        # Exactly-once on the canary lane while the gate decides.
+        np.testing.assert_array_equal(
+            r[0], _drive(serving, canary_peer, 0)[0]
+        )
+        # The gate rejects: fleet unchanged, canary lanes restored.
+        ctl.handle(
+            None, KIND_VERDICT, 0,
+            _verdict_frame(b"s", bad, False, -99.0), None,
+        )
+        assert bad.status == REJECTED
+        assert len(server.published) == 1  # poison never shipped
+        assert int(_drive(serving, canary_peer, 1)[0][0]) == 1
+        # A second bad candidate SLIPS the gate (promoted)...
+        slipped = ctl.submit(_leaves(5.0), tree={"pid": 77})
+        ctl.handle(
+            None, KIND_VERDICT, 0,
+            _verdict_frame(b"s", slipped, True, 9.0), None,
+        )
+        assert int(_drive(serving, live_peer, 1)[0][0]) == 77
+        # ...and ONE rollback knob re-pins every lane on last-good
+        # under a single epoch bump.
+        assert ctl.rollback(depose_live=True) == 1
+        assert slipped.status == DEPOSED
+        assert int(_drive(serving, live_peer, 2)[0][0]) == 1
+        assert int(_drive(serving, canary_peer, 2)[0][0]) == 1
+    finally:
+        serving.close()
+
+
+# ---------------------------------------------------------------------
+# The wire: KIND_CANDIDATE/KIND_VERDICT through a live LearnerServer.
+# ---------------------------------------------------------------------
+
+def _quiet_server(**kw):
+    return LearnerServer(
+        lambda t, e: True, host="127.0.0.1", log=lambda m: None, **kw
+    )
+
+
+def test_evaluator_wire_promote_reject_end_to_end():
+    with PortReservation() as reservation:
+        server = _quiet_server(port=reservation.release())
+        ctl = DeliveryController(
+            PolicyStore(), server, secret=b"wire", log=lambda m: None
+        )
+        server.set_delivery_handler(ctl.handle)
+        stop = threading.Event()
+        done = []
+
+        def evaluate():
+            done.append(run_evaluator(
+                "127.0.0.1", server.port,
+                score_fn=lambda meta, leaves: float(
+                    np.asarray(leaves[0]).mean()
+                ),
+                bar=1.0, secret=b"wire",
+                poll_interval_s=0.02, max_candidates=2,
+                stop_event=stop, log=lambda m: None,
+            ))
+
+        t = threading.Thread(target=evaluate, daemon=True)
+        try:
+            with time_limit(60, "delivery wire e2e"):
+                ctl.submit(_leaves(0.0), step=0)   # bootstrap
+                good = ctl.submit(_leaves(5.0), step=10)
+                bad = ctl.submit(_leaves(-9.0), step=20)
+                t.start()
+                deadline = time.monotonic() + 30.0
+                while time.monotonic() < deadline and (
+                    bad.status == PENDING
+                ):
+                    time.sleep(0.02)
+                assert good.status == PROMOTED
+                assert bad.status == REJECTED
+                t.join(10.0)
+                assert done == [2]
+                m = ctl.metrics()
+                assert m["delivery_promotions"] == 2  # bootstrap+good
+                assert m["delivery_rejections"] == 1
+                assert m["delivery_bad_signatures"] == 0
+                assert m["promo_p50_ms"] >= 0.0
+                sm = server.metrics()
+                assert sm["transport_candidate_polls"] >= 2
+                assert sm["transport_verdicts_in"] == 2
+                # The promoted publish re-stamped the wire version.
+                assert version_seq(server.version) >= 2
+        finally:
+            stop.set()
+            server.close()
+
+
+def test_wrong_secret_evaluator_never_promotes_then_quarantine():
+    """The chaos shape: an evaluator whose verdicts do not verify is
+    indistinguishable from a dead one — the candidate must quarantine
+    on timeout with serving unaffected."""
+    with PortReservation() as reservation:
+        server = _quiet_server(port=reservation.release())
+        ctl = DeliveryController(
+            PolicyStore(), server, secret=b"right",
+            verdict_timeout_s=0.2, log=lambda m: None,
+        )
+        server.set_delivery_handler(ctl.handle)
+        try:
+            with time_limit(60, "bad-secret quarantine"):
+                ctl.submit(_leaves(0.0))
+                cand = ctl.submit(_leaves(5.0))
+                run_evaluator(
+                    "127.0.0.1", server.port,
+                    score_fn=lambda meta, leaves: 99.0,
+                    bar=1.0, secret=b"WRONG",
+                    poll_interval_s=0.02, max_candidates=1,
+                    log=lambda m: None,
+                )
+                # The verdict frame is one-way: wait for the server
+                # thread to apply (and drop) the forged one.
+                deadline = time.monotonic() + 10.0
+                while time.monotonic() < deadline and (
+                    ctl.metrics()["delivery_bad_signatures"] == 0
+                ):
+                    time.sleep(0.02)
+                assert cand.status == PENDING  # forged verdict dropped
+                assert ctl.metrics()["delivery_bad_signatures"] == 1
+                deadline = time.monotonic() + 10.0
+                while time.monotonic() < deadline and (
+                    ctl.check_timeouts() == 0
+                    and cand.status == PENDING
+                ):
+                    time.sleep(0.05)
+                assert cand.status == QUARANTINED
+        finally:
+            server.close()
+
+
+def test_epoch_bump_restamps_wire_version():
+    """The rollback primitive at the transport layer: set_epoch CHANGES
+    the composite version (actors re-fetch on any version change), and
+    the epoch rides the high bits."""
+    with PortReservation() as reservation:
+        server = _quiet_server(port=reservation.release())
+        try:
+            server.publish([np.zeros(2, np.float32)], notify=False)
+            v1 = server.version
+            assert epoch_of(v1) == 0 and version_seq(v1) == 1
+            server.set_epoch(3)
+            v2 = server.version
+            assert v2 != v1  # the re-fetch trigger
+            assert epoch_of(v2) == 3 and version_seq(v2) == 1
+        finally:
+            server.close()
+
+
+def test_delivery_frame_without_handler_is_protocol_error():
+    with PortReservation() as reservation:
+        server = _quiet_server(port=reservation.release())
+        client = ActorClient(
+            "127.0.0.1", server.port,
+            hello=(9000, 0, ROLE_EVALUATOR, CAP_DELIVERY),
+        )
+        try:
+            with pytest.raises((ConnectionError, OSError)):
+                client.candidate_request(0)
+        finally:
+            client.abort()
+            server.close()
+
+
+def test_actor_client_abort_is_idempotent():
+    """Satellite: double-abort and abort-after-close never raise (the
+    cross-thread interrupt path runs concurrently with teardown)."""
+    with PortReservation() as reservation:
+        server = _quiet_server(port=reservation.release())
+        try:
+            c1 = ActorClient(
+                "127.0.0.1", server.port,
+                hello=(9000, 0, ROLE_EVALUATOR, CAP_DELIVERY),
+            )
+            c1.abort()
+            c1.abort()  # double abort: no raise
+            c2 = ActorClient(
+                "127.0.0.1", server.port,
+                hello=(9001, 0, ROLE_EVALUATOR, CAP_DELIVERY),
+            )
+            c2.close()
+            c2.abort()  # abort after close: no raise
+            c2.close()  # close after close: no raise either
+        finally:
+            server.close()
+
+
+# ---------------------------------------------------------------------
+# Live resharding (satellite): ThresholdPolicy shard proposals applied
+# in a real distributed off-policy run.
+# ---------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.elastic
+def test_offpolicy_autoscale_reshard_applies_live(tmp_path):
+    """A mid-run 2 -> 3 reshard: rings re-dealt through final
+    snapshots, plan committed (stage -> commit), fencing epoch bumped
+    exactly once, and the run still completes its budget."""
+    from actor_critic_algs_on_tensorflow_tpu.algos.ddpg import (
+        DDPGConfig,
+        make_ddpg,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.algos.offpolicy_distributed import (  # noqa: E501
+        run_offpolicy_distributed,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.distributed.elastic import (
+        PlanStore,
+    )
+
+    snap_root = str(tmp_path / "replay")
+    cfg = DDPGConfig(
+        env="Pendulum-v1",
+        num_envs=4,
+        steps_per_iter=8,
+        updates_per_iter=4,
+        replay_capacity=20_000,
+        batch_size=32,
+        warmup_env_steps=500,
+        replay_snapshot_dir=snap_root,
+        replay_snapshot_interval_s=3600.0,  # final cuts only
+        num_devices=1,
+    )
+    fns = make_ddpg(cfg)
+    fired = []
+
+    def reshard_once(metrics, current_shards):
+        if not fired and metrics.get("replay_inserted", 0) >= 1500:
+            fired.append(current_shards)
+            return 3
+        return None
+
+    with time_limit(900, "live reshard e2e"):
+        result, history = run_offpolicy_distributed(
+            fns,
+            total_env_steps=6_000,
+            seed=0,
+            n_replay_shards=2,
+            n_actors=2,
+            log_interval=5,
+            log_fn=lambda s, m: None,
+            reshard_policy=reshard_once,
+        )
+    assert fired == [2], "reshard never triggered"
+    assert result.env_steps >= 6_000
+    final = history[-1][1]
+    assert final["replay_reshards"] == 1
+    assert final["replay_shards"] == 3
+    assert final["replay_fence_epoch"] == 1  # exactly one bump
+    # The plan committed durably through stage -> commit.
+    plan = PlanStore(os.path.join(snap_root, "plans")).load()
+    assert plan is not None
+    assert plan.shard_count == 3 and plan.epoch == 1
+    assert len(plan.endpoints) == 3
+    # The re-dealt generation dirs exist (fresh cuts, not the old
+    # chain).
+    assert any(
+        name.endswith("-g1") for name in os.listdir(snap_root)
+    )

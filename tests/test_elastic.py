@@ -522,9 +522,19 @@ def test_membership_and_reshard_wire_kinds():
             "127.0.0.1", server.port, hello=(3, 2, ROLE_ACTOR)
         )
         # Membership answered straight from the registry — no handler.
-        rows, hellos, epoch = c1.membership_request(seq=5)
-        seen = {(r[0], r[1]) for r in rows if r[0] >= 0}
-        assert {(0, 1), (3, 2)} <= seen
+        # Hellos register asynchronously on each connection's server
+        # thread, so poll until both have landed.
+        deadline = time.monotonic() + 5.0
+        while True:
+            rows, hellos, epoch = c1.membership_request(seq=5)
+            seen = {(r[0], r[1]) for r in rows if r[0] >= 0}
+            if {(0, 1), (3, 2)} <= seen:
+                break
+            if time.monotonic() >= deadline:
+                raise AssertionError(
+                    f"hellos never registered: {seen}"
+                )
+            time.sleep(0.01)
         assert hellos >= 2 and epoch == 0
         # The reply rows are exactly what MembershipView diffs.
         view = MembershipView()

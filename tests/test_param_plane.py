@@ -275,7 +275,7 @@ def test_reconnect_mid_delta_stream_falls_back_to_full_frame():
             _poll_metric(server, "transport_param_delta_sends", 1)
 
             # Kill the live link mid-stream; same server, new conn.
-            proxy.redirect("127.0.0.1", server.port)
+            proxy.redirect("127.0.0.1", server.port, force=True)
             cur = _perturb(cur, rng)
             server.publish(cur, notify=False)
             version, got = client.fetch_params()
@@ -359,7 +359,9 @@ def test_redirect_during_inflight_fetches_never_torn_or_stale():
             ports = [s2.port, s1.port]
             for i in range(10):
                 time.sleep(0.05)
-                proxy.redirect("127.0.0.1", ports[i % 2])
+                proxy.redirect(
+                    "127.0.0.1", ports[i % 2], force=True
+                )
             stop.set()
             # The final fetch may ride out a full reconnect-with-
             # backoff cycle (retry deadline 15 s) before it observes

@@ -272,10 +272,12 @@ def test_redirector_refuses_stale_epoch_redirect():
             assert s1.metrics()["transport_trajectories"] == 1
             assert s2.metrics()["transport_trajectories"] == 0
             # A newer reign re-points fine; epoch-less calls (chaos
-            # tooling) bypass the fence entirely.
+            # tooling) bypass the fence only with explicit force=True.
             assert proxy.redirect("127.0.0.1", s2.port, epoch=2) >= 0
             assert proxy.epoch == 2
-            assert proxy.redirect("127.0.0.1", s1.port) >= 0
+            assert proxy.redirect(
+                "127.0.0.1", s1.port, force=True
+            ) >= 0
             client.close()
         finally:
             proxy.close()

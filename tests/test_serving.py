@@ -497,7 +497,7 @@ def test_shim_survives_server_restart_through_redirector():
         dead = PortReservation.hold("127.0.0.1", server_a.port)
         serving_a.close()
         server_b, serving_b = mk_server(segs_b)
-        redirector.redirect("127.0.0.1", server_b.port)
+        redirector.redirect("127.0.0.1", server_b.port, force=True)
         t.join(timeout=45)
         dead.release()
         assert not t.is_alive()
