@@ -276,6 +276,29 @@ class ImpalaConfig:
     # (the SIGKILLed-evaluator case): serving is unaffected, the
     # candidate never reaches the fleet.
     delivery_timeout_s: float = 60.0
+    # Promote on a majority of this many signed evaluator verdicts
+    # (1 = first verdict decides, the pre-quorum behavior). Run N
+    # evaluator processes with distinct --evaluator-id; a SIGKILLed
+    # evaluator leaves promotion flowing as long as a majority lives.
+    delivery_quorum: int = 1
+    # --- multi-tenant policy service (distributed/tenancy.py) ---------
+    # Tenant this job runs as (rides the hello's 6th field and the
+    # high 8 bits of wire version tags; 0 = the default tenant, whose
+    # wire traffic is bit-identical to the pre-tenancy protocol).
+    tenant_id: int = 0
+    # Per-tenant ingest budget in MB/s applied at the learner's TRAJ
+    # ingress (0 = unmetered). Over-budget frames are shed BEFORE
+    # decode/validate/queue and counted under tenant{N}_frames_shed —
+    # a flooding tenant throttles itself, it never starves the others.
+    tenancy_budget_mb_s: float = 0.0
+    # Per-tenant overrides as "tenant:mb_s,tenant:mb_s" (e.g.
+    # "1:8,2:0.5"); tenants not listed fall back to
+    # tenancy_budget_mb_s.
+    tenancy_budgets: str = ""
+    # Token-bucket burst window in seconds: a tenant may burst up to
+    # budget * burst_s bytes above steady-state before shedding kicks
+    # in.
+    tenancy_burst_s: float = 2.0
     # --- mid-rollout param fetch (classic actor mode) -----------------
     # Fetch-params actors normally re-fetch weights only at rollout
     # boundaries; with this knob the rollout runs as mid_rollout_chunks
@@ -2218,9 +2241,13 @@ def _actor_process_main(
         idle_timeout_s=cfg.transport_idle_timeout_s,
         max_frame_bytes=cfg.transport_max_frame_mb << 20,
         hello=(
+            # Tenant rides as the optional 6th field (epoch slot 0:
+            # actors learn reigns from pongs, not config). A default-
+            # tenant hello stays the legacy 4-field frame, so the
+            # single-job wire is byte-identical.
             actor_id, generation, ROLE_ACTOR,
             CAP_TRAJ_CODED if cfg.traj_codec else 0,
-        ),
+        ) + ((0, cfg.tenant_id) if cfg.tenant_id else ()),
         endpoints=endpoints,
     )
     try:
@@ -2716,6 +2743,7 @@ def run_impala_distributed(
             param_delta_ring=cfg.param_delta_ring,
             param_bf16=cfg.param_bf16_wire,
             epoch=epoch,
+            tenant=cfg.tenant_id,
         )
 
     adopted = server is not None
@@ -2773,6 +2801,28 @@ def run_impala_distributed(
                 flush=True,
             )
 
+    # Per-tenant ingest metering (distributed.tenancy): a token-bucket
+    # gate installed at every shard listener's TRAJ ingress. Over-budget
+    # frames are shed BEFORE decode/validate/queue — a flooding tenant
+    # throttles itself at the wire instead of starving the other
+    # tenants' queue slots and decode CPU. Opt-in: with no budget
+    # configured the gate (and its per-frame cost) does not exist.
+    admission = None
+    if cfg.tenancy_budget_mb_s > 0 or cfg.tenancy_budgets:
+        from actor_critic_algs_on_tensorflow_tpu.distributed.tenancy import (
+            TenantAdmission,
+            parse_budgets,
+        )
+
+        admission = TenantAdmission(
+            default_mb_s=cfg.tenancy_budget_mb_s,
+            budgets=parse_budgets(cfg.tenancy_budgets),
+            burst_s=cfg.tenancy_burst_s,
+            validator=validator,
+        )
+        for s in servers:
+            s.set_admission_handler(admission.admit_frame)
+
     # No actor threads here, but a multi-device CPU learner must still
     # retire each collective-bearing dispatch before the next one
     # (run_loop's serialize rule) — and the central act() program
@@ -2802,17 +2852,20 @@ def run_impala_distributed(
             traj_shape.obs, cfg.envs_per_actor
         )
 
-        def serve_sink(traj_leaves, ep_leaves, actor_id):
+        def serve_sink(traj_leaves, ep_leaves, actor_id, tenant=0):
             # Segments enter through the same admission path as a
             # wire push: hello-grade provenance for the validator,
             # bounded-queue backpressure for flow control. (env_shim
             # is single-stack — validated above — so queues[0] IS the
-            # learner's queue.)
-            return on_trajectory(
-                traj_leaves, ep_leaves,
-                PeerInfo(-1, actor_id, -1, ROLE_ACTOR),
-                queues[0],
-            )
+            # learner's queue.) The serving tier hands its lane's
+            # tenant through, so locally-built segments meter against
+            # the same per-tenant budget a wire push would.
+            synth = PeerInfo(-1, actor_id, -1, ROLE_ACTOR, 0, 0, tenant)
+            if admission is not None and not admission.admit_frame(
+                synth, sum(int(a.nbytes) for a in traj_leaves)
+            ):
+                return False
+            return on_trajectory(traj_leaves, ep_leaves, synth, queues[0])
 
         serving = InferenceServer(
             programs.act,
@@ -2841,7 +2894,9 @@ def run_impala_distributed(
         # run. Learner/standby goodbyes carry no lane to retire.
         server.set_goodbye_handler(
             lambda peer: (
-                serving.retire_lane(peer.actor_id)
+                serving.retire_lane(
+                    peer.actor_id, getattr(peer, "tenant", 0)
+                )
                 if peer.role == ROLE_ACTOR and peer.actor_id >= 0
                 else None
             )
@@ -3016,10 +3071,13 @@ def run_impala_distributed(
     # a direct publish uses (the on_promote closure below). The FIRST
     # publish auto-promotes so the fleet never blocks on version 0.
     delivery_ctl = None
+    registry = None
     if cfg.delivery:
         from actor_critic_algs_on_tensorflow_tpu.distributed.delivery import (
             DeliveryController,
-            PolicyStore,
+        )
+        from actor_critic_algs_on_tensorflow_tpu.distributed.tenancy import (
+            PolicyRegistry,
         )
 
         def _promote_publish(meta, leaves, tree):
@@ -3035,14 +3093,21 @@ def run_impala_distributed(
                 for s in servers:
                     s.publish(leaves)
 
+        # The store is a lane in the multi-tenant PolicyRegistry:
+        # same spill format and keep-window as the PR-18 PolicyStore,
+        # plus a browsable per-tenant promotion/rollback ledger keyed
+        # (tenant, policy_id, version).
+        registry = PolicyRegistry(cfg.delivery_store_dir or None)
         delivery_ctl = DeliveryController(
-            PolicyStore(cfg.delivery_store_dir or None),
+            registry.store(cfg.tenant_id),
             server,
             serving=serving,
             secret=cfg.delivery_secret or None,
             canary_fraction=cfg.delivery_canary_fraction,
             shadow=cfg.delivery_shadow,
             verdict_timeout_s=cfg.delivery_timeout_s,
+            verdict_quorum=cfg.delivery_quorum,
+            tenant=cfg.tenant_id,
             on_promote=_promote_publish,
         )
         for s in servers:
@@ -3182,6 +3247,8 @@ def run_impala_distributed(
             **publisher.metrics(),
             **(serving.metrics() if serving is not None else {}),
             **(_delivery_metrics() if delivery_ctl is not None else {}),
+            **(admission.metrics() if admission is not None else {}),
+            **(registry.metrics() if registry is not None else {}),
             **(validator.metrics() if validator is not None else {}),
             **(_per_shard_metrics() if shard is not None else {}),
             **_membership_metrics(),

@@ -575,6 +575,14 @@ def run_offpolicy_distributed(
                 snapshot_full_every=getattr(
                     cfg, "replay_snapshot_full_every", 8
                 ),
+                # Per-tenant ingest metering at the replay tier (see
+                # distributed.tenancy) — the same knobs the on-policy
+                # learner's ingress gate reads.
+                tenancy_budget_mb_s=getattr(
+                    cfg, "tenancy_budget_mb_s", 0.0
+                ),
+                tenancy_budgets=getattr(cfg, "tenancy_budgets", ""),
+                tenancy_burst_s=getattr(cfg, "tenancy_burst_s", 2.0),
             ),
             daemon=True,
             name=f"replay-server-{k}",
@@ -622,7 +630,8 @@ def run_offpolicy_distributed(
 
     if server is None:
         server = LearnerServer(
-            _discard, host=host, port=port, epoch=epoch, log=log
+            _discard, host=host, port=port, epoch=epoch,
+            tenant=getattr(cfg, "tenant_id", 0), log=log,
         )
     else:
         # Adopt a pre-bound listener (the standby's early data plane —
@@ -685,16 +694,20 @@ def run_offpolicy_distributed(
     if getattr(cfg, "delivery", False):
         from actor_critic_algs_on_tensorflow_tpu.distributed.delivery import (  # noqa: E501
             DeliveryController,
-            PolicyStore,
+        )
+        from actor_critic_algs_on_tensorflow_tpu.distributed.tenancy import (  # noqa: E501
+            PolicyRegistry,
         )
 
         delivery_ctl = DeliveryController(
-            PolicyStore(),
+            PolicyRegistry().store(getattr(cfg, "tenant_id", 0)),
             server,
             secret=getattr(cfg, "delivery_secret", "") or None,
             verdict_timeout_s=float(
                 getattr(cfg, "delivery_timeout_s", 60.0)
             ),
+            verdict_quorum=int(getattr(cfg, "delivery_quorum", 1)),
+            tenant=int(getattr(cfg, "tenant_id", 0)),
             log=log,
         )
         server.set_delivery_handler(delivery_ctl.handle)

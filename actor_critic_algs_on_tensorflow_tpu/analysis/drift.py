@@ -51,10 +51,13 @@ from actor_critic_algs_on_tensorflow_tpu.analysis.core import (
 
 # shard keys: shard0_*/shard*_* dynamic, shard_* statics, and the
 # bare "shards" count — but NOT a lone "shard" (a common kwarg name).
+# tenant keys follow the same shape: tenant0_*/tenant*_* dynamic and
+# tenant_* statics — but NOT "tenant"/"tenants" (ubiquitous kwargs).
 _FAMILY_RE = re.compile(
     r"^(transport_|pipeline_|serve_|device_|replay_pipeline_|replay_"
     r"|elastic_|autoscaler_|delivery_|promo_"
-    r"|shard[0-9*]|shard_|shards$)"
+    r"|shard[0-9*]|shard_|shards$"
+    r"|tenant[0-9*]|tenant_)"
     r"[A-Za-z0-9_*]*$"
 )
 # TimeSplit's default prefix. utils/metrics.py defaults to

@@ -449,6 +449,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "methodology); without, a cheap leaf-mean "
                         "probe (tests/benches). Signing secret: --set "
                         "delivery_secret= (must match the learner)")
+    p.add_argument("--evaluator-id", type=int, default=9000,
+                   help="with --evaluator: this evaluator's hello "
+                        "identity (default 9000). A verdict-quorum "
+                        "learner (--set delivery_quorum=N) tallies one "
+                        "vote per DISTINCT evaluator id, so each peer "
+                        "in an N-evaluator panel needs its own id")
     p.add_argument("--replay-ports", default=None, metavar="P0,P1,..",
                    help="with --replay-servers: pin each replay "
                         "shard's bind port (default: ephemeral). "
@@ -1217,6 +1223,7 @@ def _run_evaluator(args, algo, cfg) -> int:
         score_fn=score_fn,
         bar=bar,
         secret=getattr(cfg, "delivery_secret", "") or None,
+        evaluator_id=args.evaluator_id,
     )
     print(f"[train] evaluator exited after {verdicts} verdict(s)")
     return 0

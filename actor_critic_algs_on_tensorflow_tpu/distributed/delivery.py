@@ -322,6 +322,8 @@ class DeliveryController:
         canary_fraction: float = 0.0,
         shadow: bool = False,
         verdict_timeout_s: float = 60.0,
+        verdict_quorum: int = 1,
+        tenant: int = 0,
         on_promote: Optional[Callable] = None,
         log: Callable[[str], None] | None = None,
     ):
@@ -332,6 +334,18 @@ class DeliveryController:
         self._canary_fraction = float(canary_fraction)
         self._shadow = bool(shadow)
         self._verdict_timeout = float(verdict_timeout_s)
+        # Verdict quorum: a candidate settles on a MAJORITY of
+        # verdict_quorum signed verdicts from DISTINCT evaluators
+        # (vote identity = the evaluator's hello id — provenance the
+        # verdict payload cannot forge). 1 (the default) keeps the
+        # single-evaluator behavior: the first valid verdict decides.
+        self._quorum = max(1, int(verdict_quorum))
+        # Which tenant's policy this controller gates: threaded into
+        # the serving tier's per-tenant canary/shadow lanes so one
+        # fleet runs N delivery pipelines without crosstalk. 0 = the
+        # default single-job tenant (serving calls stay 3-arg, so
+        # pre-tenancy serving stubs keep working).
+        self._tenant = int(tenant)
         self._on_promote = on_promote
         self._log = log if log is not None else (
             lambda msg: print(f"[delivery] {msg}", flush=True)
@@ -340,6 +354,9 @@ class DeliveryController:
         self._seq = 0
         self._live: Optional[tuple] = None   # (meta, leaves, tree)
         self._prior: Optional[tuple] = None  # previous promoted
+        # version -> {evaluator_id: (promote, score)} for candidates
+        # still short of quorum.
+        self._votes: Dict[int, Dict[int, Tuple[bool, float]]] = {}
         self._candidates = 0
         self._promotions = 0
         self._rejections = 0
@@ -347,7 +364,11 @@ class DeliveryController:
         self._rollbacks = 0
         self._bad_signatures = 0
         self._stale_verdicts = 0
+        self._verdict_votes = 0
         self._promo_lat = LatencyStats()
+
+    def _serving_kw(self) -> dict:
+        return {"tenant": self._tenant} if self._tenant else {}
 
     # -- publish interception -------------------------------------------
 
@@ -377,10 +398,13 @@ class DeliveryController:
         if self._serving is not None and tree is not None:
             if self._canary_fraction > 0.0:
                 self._serving.set_canary(
-                    tree, meta.version, self._canary_fraction
+                    tree, meta.version, self._canary_fraction,
+                    **self._serving_kw(),
                 )
             if self._shadow:
-                self._serving.set_shadow(tree, meta.version)
+                self._serving.set_shadow(
+                    tree, meta.version, **self._serving_kw()
+                )
         return meta
 
     # -- wire handler (installed via set_delivery_handler) --------------
@@ -399,9 +423,9 @@ class DeliveryController:
             reply([header, *leaves])
             return
         if kind == KIND_VERDICT:
-            self._apply_verdict(arrays)
+            self._apply_verdict(arrays, peer)
 
-    def _apply_verdict(self, arrays) -> bool:
+    def _apply_verdict(self, arrays, peer=None) -> bool:
         if len(arrays) < 3:
             with self._lock:
                 self._bad_signatures += 1
@@ -431,8 +455,34 @@ class DeliveryController:
                 self._stale_verdicts += 1
             return False
         meta = entry[0]
-        meta.score = score
-        if promote:
+        # Quorum vote: one slot per evaluator identity (re-votes after
+        # an evaluator's re-poll overwrite, so a retried verdict never
+        # double-counts). A candidate settles when either side holds a
+        # MAJORITY of the quorum — with quorum=1 the first valid
+        # verdict decides, exactly the single-evaluator behavior; with
+        # quorum=3, SIGKILLing one evaluator still leaves 2 live votes
+        # and promotion keeps flowing.
+        voter = int(peer.actor_id) if peer is not None else -1
+        majority = self._quorum // 2 + 1
+        with self._lock:
+            self._verdict_votes += 1
+            votes = self._votes.setdefault(version, {})
+            votes[voter] = (bool(promote), score)
+            promote_scores = [
+                s for p, s in votes.values() if p
+            ]
+            reject_scores = [
+                s for p, s in votes.values() if not p
+            ]
+            if len(promote_scores) >= majority:
+                decision, scores = True, promote_scores
+            elif len(reject_scores) >= majority:
+                decision, scores = False, reject_scores
+            else:
+                return True  # counted; candidate stays pending
+            self._votes.pop(version, None)
+        meta.score = float(np.mean(scores))
+        if decision:
             self._promote(meta)
         else:
             self._reject(meta)
@@ -447,13 +497,13 @@ class DeliveryController:
         _m, leaves, tree = entry
         self._store.mark(meta.version, PROMOTED, meta.score)
         if self._serving is not None:
-            self._serving.clear_candidate()
+            self._serving.clear_candidate(**self._serving_kw())
         if self._on_promote is not None:
             self._on_promote(meta, leaves, tree)
         else:
             self._server.publish(leaves, notify=True)
             if self._serving is not None and tree is not None:
-                self._serving.set_params(tree)
+                self._serving.set_params(tree, **self._serving_kw())
         with self._lock:
             self._prior = self._live
             self._live = entry
@@ -463,7 +513,7 @@ class DeliveryController:
     def _reject(self, meta: CandidateMeta) -> None:
         self._store.mark(meta.version, REJECTED, meta.score)
         if self._serving is not None:
-            self._serving.clear_candidate()
+            self._serving.clear_candidate(**self._serving_kw())
         with self._lock:
             self._rejections += 1
         self._log(
@@ -487,8 +537,11 @@ class DeliveryController:
             if now - meta.submitted_at < self._verdict_timeout:
                 break
             self._store.mark(meta.version, QUARANTINED)
+            with self._lock:
+                # Any partial quorum died with the candidate.
+                self._votes.pop(meta.version, None)
             if self._serving is not None:
-                self._serving.clear_candidate()
+                self._serving.clear_candidate(**self._serving_kw())
             quarantined += 1
             self._log(
                 f"candidate {meta.version} QUARANTINED (no verdict in "
@@ -527,7 +580,7 @@ class DeliveryController:
         if pending is not None:
             self._store.mark(pending[0].version, DEPOSED)
         if self._serving is not None:
-            self._serving.clear_candidate()
+            self._serving.clear_candidate(**self._serving_kw())
         new_epoch = self._server.set_epoch(int(self._server.epoch) + 1)
         if target is not None:
             meta, leaves, tree = target
@@ -536,7 +589,7 @@ class DeliveryController:
             else:
                 self._server.publish(leaves, notify=True)
                 if self._serving is not None and tree is not None:
-                    self._serving.set_params(tree)
+                    self._serving.set_params(tree, **self._serving_kw())
             self._log(
                 f"rolled back to version {meta.version} under epoch "
                 f"{new_epoch}"
@@ -555,6 +608,11 @@ class DeliveryController:
                 "delivery_rollbacks": self._rollbacks,
                 "delivery_bad_signatures": self._bad_signatures,
                 "delivery_stale_verdicts": self._stale_verdicts,
+                "delivery_verdict_quorum": self._quorum,
+                "delivery_verdict_votes": self._verdict_votes,
+                "delivery_votes_pending": sum(
+                    len(v) for v in self._votes.values()
+                ),
             }
         m.update(self._store.metrics())
         m.update(self._promo_lat.summary(metric_names.PROMO))

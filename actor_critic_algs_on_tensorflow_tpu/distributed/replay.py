@@ -832,10 +832,16 @@ class ReplayShardService:
         shard: PrioritizedReplayShard,
         *,
         validator=None,
+        admission=None,
         log: Callable[[str], None] | None = None,
     ):
         self.shard = shard
         self.validator = validator
+        # Tenant metering (distributed.tenancy.TenantAdmission): the
+        # quarantine adapter's question extends from "is this frame
+        # poisoned" to "is this tenant over budget" — over-budget
+        # frames are shed (still ACKed) before they cost a ring slot.
+        self.admission = admission
         self._log = log if log is not None else (
             lambda msg: print(f"[replay-shard] {msg}", flush=True)
         )
@@ -863,6 +869,10 @@ class ReplayShardService:
                 return False
         else:
             leaves = [np.asarray(x) for x in traj]
+        if self.admission is not None and not self.admission.admit_frame(
+            peer, sum(int(a.nbytes) for a in leaves)
+        ):
+            return False
         if self.validator is not None:
             ok = self.validator.admit(
                 _TransitionView(leaves), {}, source_actor_id=actor_id
@@ -979,7 +989,10 @@ class ReplayShardService:
                     self._log(f"rejected priority update: {e}")
 
     def metrics(self) -> Dict[str, float]:
-        return self.shard.metrics()
+        out = dict(self.shard.metrics())
+        if self.admission is not None:
+            out.update(self.admission.metrics())
+        return out
 
 
 def replay_server_main(
@@ -1000,6 +1013,9 @@ def replay_server_main(
     snapshot_dir: str | None = None,
     snapshot_interval_s: float = 30.0,
     snapshot_full_every: int = 8,
+    tenancy_budget_mb_s: float = 0.0,
+    tenancy_budgets: str = "",
+    tenancy_burst_s: float = 2.0,
 ) -> None:
     """Entry point of one spawned replay-server PROCESS.
 
@@ -1061,7 +1077,22 @@ def replay_server_main(
             # that race the load are dropped-and-counted, and draws
             # answer meta-only with the load fraction.
             shard.begin_restore()
-    service = ReplayShardService(shard, validator=validator, log=log)
+    admission = None
+    if tenancy_budget_mb_s > 0 or tenancy_budgets:
+        from actor_critic_algs_on_tensorflow_tpu.distributed.tenancy import (
+            TenantAdmission,
+            parse_budgets,
+        )
+
+        admission = TenantAdmission(
+            default_mb_s=tenancy_budget_mb_s,
+            budgets=parse_budgets(tenancy_budgets),
+            burst_s=tenancy_burst_s,
+            log=log,
+        )
+    service = ReplayShardService(
+        shard, validator=validator, admission=admission, log=log
+    )
     server = LearnerServer(
         service.ingest,
         host=host,

@@ -181,11 +181,14 @@ class _TrajBuilder:
 
 @dataclasses.dataclass
 class _Lane:
-    """Per-actor serving state: the idempotency guard + builder."""
+    """Per-``(tenant, actor)`` serving state: the idempotency guard +
+    builder. ``actor_id`` is the lane's actor component (unique within
+    its tenant); ``tenant`` selects which job's policy acts for it."""
 
     actor_id: int
     generation: int
     builder: _TrajBuilder
+    tenant: int = 0
     last_seq: int = -1
     last_reply: Optional[List[np.ndarray]] = None
     inflight: Optional[_Pending] = None
@@ -255,6 +258,17 @@ class InferenceServer:
         self._batch_max = batch_max
         self._max_wait = max_wait_s
         self._sink = sink
+        # A sink accepting a 4th parameter opts into tenant
+        # attribution (sink(traj, ep, actor_id, tenant)) — 3-arg
+        # sinks keep the pre-tenancy contract.
+        try:
+            import inspect
+
+            self._sink_tenant = (
+                len(inspect.signature(sink).parameters) >= 4
+            )
+        except (TypeError, ValueError):
+            self._sink_tenant = False
         self._exec_lock = exec_lock
         self._max_decode_bytes = max_decode_bytes
         self._log = log if log is not None else (
@@ -264,17 +278,27 @@ class InferenceServer:
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._pending: List[_Pending] = []
-        self._lanes: Dict[int, _Lane] = {}
+        # Lanes are keyed (tenant, actor_key): one fleet multiplexes N
+        # jobs, each actor's idempotency guard and builder scoped to
+        # its tenant. Tenant 0 is the default single-job tenant.
+        self._lanes: Dict[Tuple[int, int], _Lane] = {}
         self._stop = False
-        # Candidate lanes (continuous delivery): a canary routes a
-        # deterministic fraction of lanes to the candidate params; a
-        # shadow scores the candidate against live traffic without
-        # serving its actions. Reference stores (GIL-atomic), same
-        # discipline as self._params.
-        self._canary: Optional[Tuple[Any, int, float]] = None
-        self._shadow: Optional[Tuple[Any, int]] = None
+        # Per-tenant policies: tenant 0 acts with self._params (the
+        # original hot-path attribute — single-tenant fleets touch no
+        # dict); other tenants' params live here and FALL BACK to the
+        # live params until their job registers its own.
+        self._tenant_params: Dict[int, Any] = {}
+        # Candidate lanes (continuous delivery), PER TENANT: a canary
+        # routes a deterministic fraction of a tenant's lanes to its
+        # candidate params; a shadow scores the candidate against that
+        # tenant's live traffic without serving its actions. Reference
+        # stores under self._lock, same discipline as self._params.
+        self._canary: Dict[int, Tuple[Any, int, float]] = {}
+        self._shadow: Dict[int, Tuple[Any, int]] = {}
         # Counters (all under self._lock).
         self._requests = 0
+        self._policy_groups = 0
+        self._tenant_requests: Dict[int, int] = {}
         self._dup_replays = 0
         self._seq_resets = 0
         self._rejected = 0
@@ -297,55 +321,78 @@ class InferenceServer:
 
     # -- weights --------------------------------------------------------
 
-    def set_params(self, params) -> None:
-        """Swap the acting weights (reference store; the next tick's
-        dispatch reads the new tree). The learner's publish path calls
-        this alongside the wire publish, which is what makes the
+    def set_params(self, params, tenant: int = 0) -> None:
+        """Swap a tenant's acting weights (reference store; the next
+        tick's dispatch reads the new tree). The learner's publish path
+        calls this alongside the wire publish, which is what makes the
         serving tier's staleness ~one tick: by the time remote peers
         even receive their ``KIND_PARAMS_NOTIFY``, central inference
-        is already acting with the new weights."""
+        is already acting with the new weights. Tenant 0 (the default)
+        is the live single-job path."""
+        if tenant:
+            with self._lock:
+                self._tenant_params[int(tenant)] = params
+                self._param_swaps += 1
+            return
         self._params = params
         with self._lock:
             self._param_swaps += 1
 
+    def _params_for(self, tenant: int):
+        """The tree a tenant's lanes act with: its registered policy,
+        falling back to the live (tenant-0) params until one exists."""
+        if not tenant:
+            return self._params
+        return self._tenant_params.get(tenant, self._params)
+
     # -- candidate lanes (continuous delivery) --------------------------
 
     @staticmethod
-    def _lane_slot(lane_key: int) -> float:
+    def _lane_slot(lane_key) -> float:
         """Deterministic [0, 1) slot for a lane (Knuth multiplicative
-        hash on the lane key): stable across processes and restarts,
+        hash on the lane's ACTOR component — a ``(tenant, actor)``
+        tuple hashes its actor, so a given actor id lands on the same
+        slot in every tenant): stable across processes and restarts,
         so a lane's canary membership never flaps while the fraction
         holds — each actor sees ONE policy per candidate, not a
         per-tick coin flip."""
-        return ((int(lane_key) * 2654435761) & 0xFFFFFFFF) / 2.0**32
+        key = lane_key[1] if isinstance(lane_key, tuple) else lane_key
+        return ((int(key) * 2654435761) & 0xFFFFFFFF) / 2.0**32
 
-    def set_canary(self, params, version: int, fraction: float) -> None:
-        """Stage candidate params on a canary lane slice: lanes whose
-        slot falls below ``fraction`` are served BY the candidate from
-        the next tick on (their builders keep assembling segments —
-        canary experience trains like any other). Everyone else stays
-        on the live params until a PROMOTE lands."""
+    def set_canary(
+        self, params, version: int, fraction: float, tenant: int = 0
+    ) -> None:
+        """Stage candidate params on a canary slice of ``tenant``'s
+        lanes: lanes whose slot falls below ``fraction`` are served BY
+        the candidate from the next tick on (their builders keep
+        assembling segments — canary experience trains like any
+        other). Everyone else stays on the tenant's live params until
+        a PROMOTE lands. Canaries are per tenant: one job's candidate
+        never routes another job's lanes."""
         with self._lock:
-            self._canary = (
+            self._canary[int(tenant)] = (
                 params, int(version), min(max(float(fraction), 0.0), 1.0)
             )
 
-    def set_shadow(self, params, version: int) -> None:
-        """Stage candidate params in shadow: every tick ALSO runs the
-        candidate on the live batch (same obs, same PRNG key) and
-        records action divergence, but only the live policy's actions
-        are served — zero blast radius scoring."""
+    def set_shadow(self, params, version: int, tenant: int = 0) -> None:
+        """Stage candidate params in shadow for ``tenant``: every tick
+        ALSO runs the candidate on that tenant's live batch (same obs,
+        same PRNG key) and records action divergence, but only the
+        live policy's actions are served — zero blast radius
+        scoring."""
         with self._lock:
-            self._shadow = (params, int(version))
+            self._shadow[int(tenant)] = (params, int(version))
 
-    def clear_candidate(self) -> bool:
-        """Drop any staged canary/shadow candidate (REJECT verdict, or
-        a rollback deposing it): the next tick serves every lane from
-        the live params again. Returns whether anything was staged."""
+    def clear_candidate(self, tenant: int = 0) -> bool:
+        """Drop ``tenant``'s staged canary/shadow candidate (REJECT
+        verdict, or a rollback deposing it): the next tick serves all
+        of that tenant's lanes from its live params again. Returns
+        whether anything was staged."""
         with self._lock:
-            had = self._canary is not None or self._shadow is not None
-            self._canary = None
-            self._shadow = None
+            had = (
+                self._canary.pop(int(tenant), None) is not None
+                or self._shadow.pop(int(tenant), None) is not None
+            )
             if had:
                 self._candidate_clears += 1
         return had
@@ -391,21 +438,24 @@ class InferenceServer:
                     f"{leaf.dtype.str}{tuple(leaf.shape)}, expected "
                     f"{np.dtype(dtype).str}{shape} — stale config?"
                 )
-        lane_key = (
+        actor_key = (
             peer.actor_id if peer.actor_id >= 0 else -(1000 + peer.cid)
         )
+        tenant = int(getattr(peer, "tenant", 0))
+        lane_key = (tenant, actor_key)
         cached = None
         with self._lock:
             lane = self._lanes.get(lane_key)
             if lane is None:
                 lane = _Lane(
-                    actor_id=lane_key,
+                    actor_id=actor_key,
                     generation=peer.generation,
+                    tenant=tenant,
                     builder=_TrajBuilder(
                         self._rollout_length,
                         self._n_obs,
                         self._obs_treedef,
-                        lane_key,
+                        actor_key,
                     ),
                 )
                 self._lanes[lane_key] = lane
@@ -489,37 +539,45 @@ class InferenceServer:
                 )
 
     def _process(self, reqs: List[_Pending]) -> None:
-        # Partition the tick's requests into per-policy act() groups:
-        # canary lanes get the candidate params, everyone else the
-        # live params. With no candidate staged this is ONE group and
-        # one dispatch, exactly the pre-delivery hot path.
-        canary = self._canary
-        shadow = self._shadow
-        shadow_params = shadow[0] if shadow is not None else None
-        if canary is None:
-            self._dispatch(
-                self._params, reqs, is_canary=False,
-                shadow_params=shadow_params,
+        # Partition the tick's requests into per-POLICY act() groups:
+        # one group per (tenant, live-vs-canary) pair — a tenant's
+        # canary lanes get its candidate params, everyone else their
+        # tenant's live params. The tick COALESCES across tenants (one
+        # wait window, one wake) but each distinct policy is one
+        # dispatch, so single-tenant-no-candidate stays exactly ONE
+        # group and one dispatch — the pre-tenancy hot path,
+        # bit-identical at fixed seed.
+        with self._lock:
+            canary = dict(self._canary)
+            shadow = dict(self._shadow)
+        groups: Dict[Tuple[int, bool], List[_Pending]] = {}
+        for r in reqs:
+            t = r.lane.tenant
+            cand = canary.get(t)
+            routed = (
+                cand is not None
+                and self._lane_slot(r.lane.actor_id) < cand[2]
             )
-            return
-        cparams, _cversion, fraction = canary
-        live = [
-            r for r in reqs
-            if self._lane_slot(r.lane.actor_id) >= fraction
-        ]
-        routed = [
-            r for r in reqs
-            if self._lane_slot(r.lane.actor_id) < fraction
-        ]
-        if live:
-            self._dispatch(
-                self._params, live, is_canary=False,
-                shadow_params=shadow_params,
-            )
-        if routed:
-            self._dispatch(
-                cparams, routed, is_canary=True, shadow_params=None
-            )
+            groups.setdefault((t, routed), []).append(r)
+        if len(groups) > 1:
+            # A tick that coalesced requests for MORE than one policy:
+            # the multi-tenant batching win made visible. Counted at
+            # partition time, before dispatch, so the metric is
+            # readable the moment this tick's replies land.
+            with self._lock:
+                self._policy_groups += 1
+        for (t, routed), grp in groups.items():
+            if routed:
+                self._dispatch(
+                    canary[t][0], grp, is_canary=True,
+                    shadow_params=None,
+                )
+            else:
+                sh = shadow.get(t)
+                self._dispatch(
+                    self._params_for(t), grp, is_canary=False,
+                    shadow_params=sh[0] if sh is not None else None,
+                )
 
     def _dispatch(
         self,
@@ -579,7 +637,7 @@ class InferenceServer:
                 div = float(np.mean(served != mirror))
             else:
                 div = float(np.mean(np.abs(served - mirror)))
-        segments: List[Tuple[int, tuple]] = []
+        segments: List[Tuple[int, int, tuple]] = []
         replies: List[Tuple[_Pending, List[np.ndarray]]] = []
         now = time.monotonic()
         with self._lock:
@@ -593,7 +651,12 @@ class InferenceServer:
                     r.leaves, out[0], log_probs[sl]
                 )
                 if seg is not None:
-                    segments.append((r.lane.actor_id, seg))
+                    segments.append(
+                        (r.lane.actor_id, r.lane.tenant, seg)
+                    )
+                self._tenant_requests[r.lane.tenant] = (
+                    self._tenant_requests.get(r.lane.tenant, 0) + 1
+                )
             self._batches += 1
             self._batched_requests += n
             if is_canary:
@@ -610,14 +673,17 @@ class InferenceServer:
                 with self._lock:
                     self._reply_failures += 1
             self._act_lat.add_s(now - r.t0)
-        for actor_id, (traj_leaves, ep_leaves) in segments:
+        for actor_id, tenant, (traj_leaves, ep_leaves) in segments:
             # Outside the lock: the sink is the real trajectory path
             # and may BLOCK on queue backpressure — that stall is the
             # serving tier's flow control (the fleet's next requests
             # queue behind it), by design.
             with self._lock:
                 self._segments += 1
-            self._sink(traj_leaves, ep_leaves, actor_id)
+            if self._sink_tenant:
+                self._sink(traj_leaves, ep_leaves, actor_id, tenant)
+            else:
+                self._sink(traj_leaves, ep_leaves, actor_id)
 
     # -- observability / lifecycle --------------------------------------
 
@@ -627,7 +693,7 @@ class InferenceServer:
         the percentiles)."""
         self._act_lat.reset()
 
-    def retire_lane(self, actor_id: int) -> bool:
+    def retire_lane(self, actor_id: int, tenant: int = 0) -> bool:
         """Drop a departed shim's lane (elastic leave): its builder's
         partial segment is discarded — the actor announced an orderly
         goodbye, so no further steps will ever complete it — and an
@@ -639,20 +705,27 @@ class InferenceServer:
         have reset the lane anyway; retirement just reclaims it
         eagerly. Returns whether a lane existed."""
         with self._lock:
-            lane = self._lanes.pop(int(actor_id), None)
+            lane = self._lanes.pop((int(tenant), int(actor_id)), None)
             if lane is not None:
                 self._lane_retires += 1
         return lane is not None
 
     def metrics(self) -> dict:
         with self._lock:
-            canary = self._canary
-            fraction = canary[2] if canary is not None else 0.0
+            # Canary fraction reported for the DEFAULT tenant (the
+            # single-job reading); canary_lanes counts across every
+            # tenant's staged candidate.
+            cand0 = self._canary.get(0)
+            fraction = cand0[2] if cand0 is not None else 0.0
             canary_lanes = sum(
                 1 for key in self._lanes
-                if self._lane_slot(key) < fraction
+                if (cand := self._canary.get(key[0])) is not None
+                and self._lane_slot(key) < cand[2]
             )
+            tenants = {key[0] for key in self._lanes}
             m = {
+                "serve_tenants": len(tenants),
+                "serve_policy_group_ticks": self._policy_groups,
                 "serve_requests": self._requests,
                 "serve_dup_replays": self._dup_replays,
                 "serve_seq_resets": self._seq_resets,
@@ -683,6 +756,8 @@ class InferenceServer:
                     6,
                 ),
             }
+            for t, n in sorted(self._tenant_requests.items()):
+                m[f"tenant{t}_serve_requests"] = n
         m.update(self._act_lat.summary(metric_names.SERVE_ACT))
         return m
 
@@ -746,13 +821,20 @@ def env_shim_actor_main(
     )
 
     host, port, endpoints = endpoint_list(host, port)
+    # 6-field hello: [actor_id, generation, role, caps, epoch, tenant]
+    # — the tenant rides the same optional-trailing-field trick as the
+    # fencing epoch, so a tenant-0 shim's hello is parsed identically
+    # by legacy learners.
+    tenant = int(getattr(cfg, "tenant_id", 0))
     client = ResilientActorClient(
         host, port,
         retry=RetryPolicy(deadline_s=cfg.transport_retry_deadline_s),
         heartbeat_interval_s=cfg.transport_heartbeat_s,
         idle_timeout_s=cfg.transport_idle_timeout_s,
         max_frame_bytes=cfg.transport_max_frame_mb << 20,
-        hello=(actor_id, generation, ROLE_ACTOR, CAP_INFERENCE),
+        hello=(
+            actor_id, generation, ROLE_ACTOR, CAP_INFERENCE, 0, tenant
+        ),
         endpoints=endpoints,
     )
     lat = LatencyStats()

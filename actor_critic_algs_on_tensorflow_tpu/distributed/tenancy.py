@@ -1,0 +1,449 @@
+"""Multi-tenant policy service: registry, admission control, metering.
+
+The platform's planes all assumed exactly one training job and one
+policy. Podracer (Hessel et al. 2021) showed the economics of packing
+many jobs onto one accelerator fleet; this module is the learner-tier
+half of that claim, grafted onto machinery that already exists:
+
+  - ``PolicyRegistry``: candidate/promotion state keyed by
+    ``(tenant, policy_id, version)``, subsuming the PR-18
+    ``PolicyStore`` — each ``(tenant, policy_id)`` pair gets its own
+    store (same atomic npz + manifest spill) under a per-tenant
+    directory, and every lifecycle transition (submit, promote,
+    reject, quarantine, depose, rollback) lands in a BROWSABLE
+    per-tenant ledger that spills atomically to ``ledger.json`` (the
+    PlanStore write discipline). Promotion/rollback history stops
+    being a side effect of log lines and becomes a queryable record.
+  - Tenant identity on the wire: a 6th hello field and the high bits
+    of the param-version tag (``transport.TENANT_SHIFT`` — the same
+    optional-trailing-field trick as the fencing epoch, one field
+    higher), so one redirector/standby/replay tier multiplexes N jobs
+    and tenant 0 stays BIT-IDENTICAL to the pre-tenancy wire.
+  - ``TenantAdmission``: per-tenant token-bucket byte budgets on the
+    ingest path. ``TrajectoryValidator.admit`` and the replay tier's
+    quarantine adapter answer "is this frame poisoned?"; this extends
+    the same gate to "is this TENANT over budget?" — over-budget
+    frames are shed AT INGRESS (never decoded, validated, or queued)
+    with per-tenant ``tenant*_*`` counters, so a flooding job is
+    throttled by its own budget instead of starving its neighbors.
+
+Metric family: ``tenant_*`` (aggregate) and ``tenant{N}_*``
+(per-tenant dynamic keys, same convention as ``shard{N}_*``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from actor_critic_algs_on_tensorflow_tpu.distributed.delivery import (
+    CandidateMeta,
+    PolicyStore,
+)
+
+DEFAULT_TENANT = 0
+DEFAULT_POLICY = 0
+
+
+def parse_budgets(spec: str) -> Dict[int, float]:
+    """Parse a ``"tenant:mb_s,tenant:mb_s"`` budget-override string
+    (the CLI-friendly form of the per-tenant budget map; empty string
+    = no overrides). Malformed entries raise — a silently dropped
+    budget is an unmetered flood."""
+    out: Dict[int, float] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        tenant, _, rate = part.partition(":")
+        out[int(tenant)] = float(rate)
+    return out
+
+
+class TenantAdmission:
+    """Per-tenant token-bucket ingest budgets + metering.
+
+    One bucket per tenant, charged in BYTES: ``default_mb_s`` is every
+    tenant's budget unless ``budgets`` overrides it (0 = unmetered —
+    the single-tenant default costs nothing). A bucket holds at most
+    ``burst_s`` seconds of its rate, so a quiet tenant can burst but
+    never bank an unbounded backlog of credit.
+
+    ``admit_frame(peer, nbytes)`` is the transport-ingress gate
+    (installed via ``LearnerServer.set_admission_handler``): it runs
+    BEFORE the trajectory sink, so a shed frame is never decoded,
+    validated, or queued — the flooding tenant pays for its own flood.
+    ``admit(traj, ep, ...)`` is the in-process form of the same gate,
+    extending ``TrajectoryValidator.admit`` (budget first, then the
+    poison check) for runners that ingest without a wire.
+    """
+
+    def __init__(
+        self,
+        *,
+        default_mb_s: float = 0.0,
+        budgets: Optional[Dict[int, float]] = None,
+        burst_s: float = 2.0,
+        validator=None,
+        time_fn: Callable[[], float] = time.monotonic,
+        log: Callable[[str], None] | None = None,
+    ):
+        self._default_rate = max(0.0, float(default_mb_s)) * 1e6
+        self._rates = {
+            int(t): max(0.0, float(r)) * 1e6
+            for t, r in (budgets or {}).items()
+        }
+        self._burst_s = max(0.1, float(burst_s))
+        self._validator = validator
+        self._time = time_fn
+        self._log = log if log is not None else (
+            lambda msg: print(f"[tenancy] {msg}", flush=True)
+        )
+        self._lock = threading.Lock()
+        # tenant -> [tokens, last_refill]; created on first frame.
+        self._buckets: Dict[int, List[float]] = {}
+        # tenant -> [admitted_frames, shed_frames, bytes_in, shed_bytes]
+        self._counts: Dict[int, List[float]] = {}
+        self._shed_logged: Dict[int, float] = {}
+
+    def rate_for(self, tenant: int) -> float:
+        """The tenant's budget in bytes/s (0 = unmetered)."""
+        return self._rates.get(int(tenant), self._default_rate)
+
+    def _charge(self, tenant: int, cost: int) -> bool:
+        """Refill + charge ``tenant``'s bucket; False = over budget."""
+        rate = self.rate_for(tenant)
+        counts = self._counts.setdefault(tenant, [0, 0, 0, 0])
+        if rate <= 0.0:
+            counts[0] += 1
+            counts[2] += cost
+            return True
+        now = self._time()
+        cap = rate * self._burst_s
+        bucket = self._buckets.get(tenant)
+        if bucket is None:
+            bucket = self._buckets[tenant] = [cap, now]
+        tokens, last = bucket
+        tokens = min(cap, tokens + (now - last) * rate)
+        if tokens >= cost:
+            bucket[0], bucket[1] = tokens - cost, now
+            counts[0] += 1
+            counts[2] += cost
+            return True
+        bucket[0], bucket[1] = tokens, now
+        counts[1] += 1
+        counts[3] += cost
+        # Rate-limit the shed log itself: one line per tenant per
+        # burst window, not one per shed frame of the flood.
+        if now - self._shed_logged.get(tenant, -1e9) >= self._burst_s:
+            self._shed_logged[tenant] = now
+            self._log(
+                f"tenant {tenant} over budget "
+                f"({rate / 1e6:.2f} MB/s): shedding at ingress "
+                f"({int(counts[1])} frames shed so far)"
+            )
+        return False
+
+    # -- transport ingress gate (set_admission_handler) -----------------
+
+    def admit_frame(self, peer, nbytes: int) -> bool:
+        tenant = int(getattr(peer, "tenant", DEFAULT_TENANT))
+        with self._lock:
+            return self._charge(tenant, int(nbytes))
+
+    # -- in-process / validator-extending gate --------------------------
+
+    def admit(
+        self,
+        traj,
+        ep,
+        *,
+        tenant: int = DEFAULT_TENANT,
+        source_actor_id: int = -1,
+    ) -> bool:
+        """Budget gate + poison gate with the exact
+        ``TrajectoryValidator.admit`` bool contract: charges the
+        tenant for the trajectory's byte size, returns False (shed)
+        when over budget, and otherwise delegates to the wrapped
+        validator's poison check (pass ``validator=None`` to meter
+        without validating)."""
+        cost = sum(
+            int(np.asarray(a).nbytes) for a in traj
+        ) if isinstance(traj, (list, tuple)) else 0
+        with self._lock:
+            ok = self._charge(int(tenant), cost)
+        if not ok:
+            return False
+        if self._validator is None:
+            return True
+        return bool(
+            self._validator.admit(
+                traj, ep, source_actor_id=source_actor_id
+            )
+        )
+
+    # -- observability ---------------------------------------------------
+
+    def shed_frames(self, tenant: Optional[int] = None) -> int:
+        with self._lock:
+            if tenant is not None:
+                return int(self._counts.get(int(tenant), [0] * 4)[1])
+            return int(sum(c[1] for c in self._counts.values()))
+
+    def metrics(self) -> dict:
+        with self._lock:
+            m: Dict[str, float] = {
+                "tenant_count": len(self._counts),
+                "tenant_frames_admitted": int(
+                    sum(c[0] for c in self._counts.values())
+                ),
+                "tenant_frames_shed": int(
+                    sum(c[1] for c in self._counts.values())
+                ),
+                "tenant_mb_shed": round(
+                    sum(c[3] for c in self._counts.values()) / 1e6, 6
+                ),
+            }
+            for t in sorted(self._counts):
+                adm, shed, bytes_in, shed_bytes = self._counts[t]
+                m[f"tenant{t}_frames_admitted"] = int(adm)
+                m[f"tenant{t}_frames_shed"] = int(shed)
+                m[f"tenant{t}_mb_in"] = round(bytes_in / 1e6, 6)
+                m[f"tenant{t}_mb_shed"] = round(shed_bytes / 1e6, 6)
+                m[f"tenant{t}_budget_mb_s"] = round(
+                    self.rate_for(t) / 1e6, 6
+                )
+        return m
+
+
+class _LedgerStore(PolicyStore):
+    """One ``(tenant, policy_id)`` pair's ``PolicyStore``, with every
+    lifecycle transition recorded in the owning registry's per-tenant
+    ledger. The delivery controller uses it exactly like a plain
+    store — the ledger is a side effect of ``put``/``mark``, so the
+    promotion plane needed zero new call sites."""
+
+    def __init__(
+        self,
+        registry: "PolicyRegistry",
+        tenant: int,
+        policy_id: int,
+        directory: Optional[str] = None,
+        *,
+        keep: int = 8,
+    ):
+        super().__init__(directory, keep=keep)
+        self._registry = registry
+        self._tenant = int(tenant)
+        self._policy = int(policy_id)
+
+    def put(self, meta: CandidateMeta, leaves, tree=None) -> None:
+        super().put(meta, leaves, tree)
+        self._registry.record(
+            self._tenant, self._policy, "submit",
+            version=meta.version, step=meta.step, epoch=meta.epoch,
+        )
+
+    def mark(self, version: int, status: str, score=None) -> bool:
+        updated = super().mark(version, status, score)
+        if updated:
+            self._registry.record(
+                self._tenant, self._policy, status,
+                version=int(version),
+                score=None if score is None else float(score),
+            )
+        return updated
+
+
+class PolicyRegistry:
+    """Policies keyed ``(tenant, policy_id, version)`` on the learner
+    tier — the browsable successor of the single-job ``PolicyStore``.
+
+    ``store(tenant, policy_id)`` hands out that pair's candidate store
+    (created on demand; spilled under
+    ``<root>/tenant-<t>/policy-<p>/`` when a root directory is
+    configured), and the registry keeps ONE append-only ledger per
+    tenant recording every candidate lifecycle transition with its
+    version/step/epoch/score — ``history()`` is the browsable query,
+    ``load_ledger()`` reads a spilled ledger back post-mortem. Ledger
+    spills are atomic (temp + fsync + replace, the PlanStore
+    discipline), so a crash mid-append never leaves a torn file.
+    """
+
+    def __init__(
+        self,
+        root_dir: Optional[str] = None,
+        *,
+        keep: int = 8,
+        log: Callable[[str], None] | None = None,
+    ):
+        self._root = root_dir or None
+        self._keep = int(keep)
+        self._log = log if log is not None else (
+            lambda msg: print(f"[registry] {msg}", flush=True)
+        )
+        self._lock = threading.Lock()
+        self._stores: Dict[Tuple[int, int], _LedgerStore] = {}
+        self._ledgers: Dict[int, List[dict]] = {}
+        self._events = 0
+        if self._root:
+            os.makedirs(self._root, exist_ok=True)
+
+    # -- stores ----------------------------------------------------------
+
+    def store(
+        self,
+        tenant: int = DEFAULT_TENANT,
+        policy_id: int = DEFAULT_POLICY,
+    ) -> PolicyStore:
+        key = (int(tenant), int(policy_id))
+        with self._lock:
+            st = self._stores.get(key)
+            if st is None:
+                directory = None
+                if self._root:
+                    directory = os.path.join(
+                        self._root,
+                        f"tenant-{key[0]}",
+                        f"policy-{key[1]}",
+                    )
+                st = _LedgerStore(
+                    self, key[0], key[1], directory, keep=self._keep
+                )
+                self._stores[key] = st
+        return st
+
+    def get(
+        self, tenant: int, policy_id: int, version: int
+    ) -> Optional[tuple]:
+        """The ``(meta, leaves, tree)`` entry for one fully-qualified
+        ``(tenant, policy_id, version)`` key, or None."""
+        with self._lock:
+            st = self._stores.get((int(tenant), int(policy_id)))
+        return None if st is None else st.get(version)
+
+    def tenants(self) -> List[int]:
+        with self._lock:
+            out = {t for t, _p in self._stores} | set(self._ledgers)
+        return sorted(out)
+
+    def policies(self, tenant: int) -> List[int]:
+        with self._lock:
+            return sorted(
+                p for t, p in self._stores if t == int(tenant)
+            )
+
+    # -- ledger ----------------------------------------------------------
+
+    def record(
+        self,
+        tenant: int,
+        policy_id: int,
+        event: str,
+        *,
+        version: int = 0,
+        step: int = 0,
+        epoch: int = 0,
+        score: Optional[float] = None,
+    ) -> dict:
+        """Append one lifecycle event to ``tenant``'s ledger (and
+        spill it atomically when a root directory is configured).
+        Returns the entry."""
+        with self._lock:
+            self._events += 1
+            entry = {
+                "seq": self._events,
+                "time": time.time(),
+                "tenant": int(tenant),
+                "policy_id": int(policy_id),
+                "event": str(event),
+                "version": int(version),
+                "step": int(step),
+                "epoch": int(epoch),
+                "score": score,
+            }
+            ledger = self._ledgers.setdefault(int(tenant), [])
+            ledger.append(entry)
+            blob = None
+            if self._root:
+                blob = json.dumps(ledger, indent=1).encode("utf-8")
+        if blob is not None:
+            self._spill_ledger(int(tenant), blob)
+        return entry
+
+    def _ledger_path(self, tenant: int) -> str:
+        return os.path.join(
+            self._root, f"tenant-{int(tenant)}", "ledger.json"
+        )
+
+    def _spill_ledger(self, tenant: int, blob: bytes) -> None:
+        directory = os.path.join(self._root, f"tenant-{tenant}")
+        os.makedirs(directory, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(
+            dir=directory, prefix=".ledger-", suffix=".tmp"
+        )
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._ledger_path(tenant))
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def load_ledger(self, tenant: int) -> List[dict]:
+        """Read a tenant's spilled ledger back from disk (post-mortem
+        / external-browser path; requires a root directory)."""
+        if not self._root:
+            raise FileNotFoundError("PolicyRegistry has no root_dir")
+        with open(
+            self._ledger_path(tenant), "r", encoding="utf-8"
+        ) as f:
+            return json.load(f)
+
+    def history(
+        self,
+        tenant: Optional[int] = None,
+        policy_id: Optional[int] = None,
+        event: Optional[str] = None,
+    ) -> List[dict]:
+        """Browse the promotion/rollback record: every ledger entry
+        (across tenants by default), filtered by tenant, policy, or
+        event kind, in append order."""
+        with self._lock:
+            if tenant is not None:
+                entries = list(self._ledgers.get(int(tenant), ()))
+            else:
+                entries = [
+                    e for t in sorted(self._ledgers)
+                    for e in self._ledgers[t]
+                ]
+        if policy_id is not None:
+            entries = [
+                e for e in entries if e["policy_id"] == int(policy_id)
+            ]
+        if event is not None:
+            entries = [e for e in entries if e["event"] == event]
+        return sorted(entries, key=lambda e: e["seq"])
+
+    # -- observability ---------------------------------------------------
+
+    def metrics(self) -> dict:
+        with self._lock:
+            return {
+                "tenant_registry_tenants": len(
+                    {t for t, _p in self._stores} | set(self._ledgers)
+                ),
+                "tenant_registry_policies": len(self._stores),
+                "tenant_registry_events": self._events,
+            }

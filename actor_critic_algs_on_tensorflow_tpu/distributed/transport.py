@@ -208,10 +208,36 @@ ROLE_EVALUATOR = 3
 EPOCH_SHIFT = 48
 _EPOCH_SEQ_MASK = (1 << EPOCH_SHIFT) - 1
 
+# --- tenant id (multi-tenant policy service) -------------------------
+# The tenant identifies the JOB a frame belongs to: bits 56..63 of the
+# u64 param-version tag, above the 8-bit fencing-epoch field (the epoch
+# keeps bits 48..55 — 256 reigns per tenant is far beyond any fleet's
+# takeover count). Tenant 0 is the default single-job tenant, so a
+# single-tenant fleet's tags are BIT-IDENTICAL to the pre-tenancy wire
+# — legacy peers and mixed fleets interoperate unchanged, exactly the
+# epoch trick one field higher. The tenant also rides the hello as a
+# 6th ident field (absent = 0 = default tenant), so one
+# redirector/standby/replay tier multiplexes N jobs off one listener.
+TENANT_SHIFT = 56
+_TENANT_EPOCH_MASK = (1 << (TENANT_SHIFT - EPOCH_SHIFT)) - 1
+
 
 def epoch_of(version: int) -> int:
     """Fencing epoch carried in a param-version (or pong) tag."""
-    return int(version) >> EPOCH_SHIFT
+    return (int(version) >> EPOCH_SHIFT) & _TENANT_EPOCH_MASK
+
+
+def tenant_of(version: int) -> int:
+    """Tenant id carried in a param-version (or pong) tag."""
+    return int(version) >> TENANT_SHIFT
+
+
+def tenant_tag(tenant: int, version: int = 0) -> int:
+    """Stamp ``tenant`` into the high bits of a version/tag (tenant 0
+    returns ``version`` unchanged — the single-tenant bit-compat pin)."""
+    return (int(tenant) << TENANT_SHIFT) | (
+        int(version) & ((1 << TENANT_SHIFT) - 1)
+    )
 
 
 def version_seq(version: int) -> int:
@@ -483,6 +509,10 @@ class PeerInfo:
     # priority updates on the latter.
     caps: int = 0
     epoch: int = 0
+    # Tenant id from the 6th hello field (absent = 0 = default
+    # tenant): which JOB this connection belongs to on a multiplexed
+    # tier — admission/metering attribution the payload cannot forge.
+    tenant: int = 0
 
 
 @dataclasses.dataclass
@@ -509,6 +539,8 @@ class _Conn:
     # standbys announce it so the registry shows each one's reign
     # knowledge — absent = 0 = legacy peer).
     epoch: int = 0
+    # Tenant id (6th hello field; absent = 0 = default tenant).
+    tenant: int = 0
     send_lock: threading.Lock = dataclasses.field(
         default_factory=threading.Lock
     )
@@ -554,6 +586,7 @@ class LearnerServer:
         param_delta_ring: int = 4,
         param_bf16: bool = False,
         epoch: int = 0,
+        tenant: int = 0,
         log: Callable[[str], None] | None = None,
     ):
         self._sink = self._make_sink(on_trajectory)
@@ -583,6 +616,11 @@ class LearnerServer:
         # arrays, reply) — reply sends the candidate frame, None for
         # the one-way verdict.
         self._delivery = None
+        # Tenant admission hook (distributed.tenancy): when set,
+        # ``admission(peer, nbytes) -> bool`` runs BEFORE the
+        # trajectory sink; False sheds the frame at ingress (ACKed,
+        # never decoded or queued) — the multi-tenant metering gate.
+        self._admission = None
         self._idle_timeout = idle_timeout_s
         # Param wire codec (distributed.codec): keep a small ring of
         # recent published versions' wire leaves and serve an XOR-delta
@@ -614,6 +652,12 @@ class LearnerServer:
         # regardless of epoch ("nothing published yet" stays testable
         # as == 0 everywhere).
         self._epoch = int(epoch)
+        # Tenant id stamped above the epoch in every version tag (and
+        # pong), so one redirector/standby/replay tier can multiplex N
+        # jobs and still attribute every frame. Tenant 0 contributes
+        # zero bits — the default single-job wire stays bit-identical.
+        self._tenant = int(tenant)
+        self._tenant_bits = int(tenant) << TENANT_SHIFT
         self._vcount = 0
         self._version = 0
         self._stopping = threading.Event()
@@ -631,6 +675,10 @@ class LearnerServer:
         self._bytes_in = 0
         self._trajectories = 0
         self._rejected = 0
+        # Frames shed at ingress by the tenant-admission hook (the
+        # over-budget case — distinct from _rejected, the validator's
+        # poison case).
+        self._shed_frames = 0
         self._pings = 0
         self._hellos = 0
         self._checksum_failures = 0
@@ -752,6 +800,17 @@ class LearnerServer:
         polling forever."""
         self._delivery = handler
 
+    def set_admission_handler(self, handler) -> None:
+        """Install the tenant-admission gate
+        (``distributed.tenancy.TenantAdmission.admit_frame``). Called
+        as ``handler(peer, nbytes) -> bool`` on the connection's
+        thread for every inbound trajectory frame BEFORE the sink;
+        False sheds the frame at ingress (still ACKed — re-pushing an
+        over-budget frame only floods harder) and counts it under
+        ``transport_shed_frames``. None (the default) admits
+        everything — the single-tenant fleet pays nothing."""
+        self._admission = handler
+
     def set_goodbye_handler(self, handler) -> None:
         """Install a hook called with a peer's ``PeerInfo`` when it
         announces an orderly ``KIND_CLOSE`` (hello provenance attached,
@@ -803,7 +862,11 @@ class LearnerServer:
             self._param_leaves = leaves
             self._param_crcs = crcs
             self._vcount += 1
-            self._version = (self._epoch << EPOCH_SHIFT) | self._vcount
+            self._version = (
+                self._tenant_bits
+                | (self._epoch << EPOCH_SHIFT)
+                | self._vcount
+            )
             version = self._version
             if variants is not None:
                 self._param_ring[version] = variants
@@ -900,8 +963,10 @@ class LearnerServer:
                 self._epoch = int(epoch)
                 if self._vcount:
                     self._version = (
-                        self._epoch << EPOCH_SHIFT
-                    ) | self._vcount
+                        self._tenant_bits
+                        | (self._epoch << EPOCH_SHIFT)
+                        | self._vcount
+                    )
             return self._epoch
 
     def metrics(self) -> dict:
@@ -917,6 +982,7 @@ class LearnerServer:
                 "transport_mb_in": round(self._bytes_in / 1e6, 6),
                 "transport_trajectories": self._trajectories,
                 "transport_rejected": self._rejected,
+                "transport_shed_frames": self._shed_frames,
                 # Inbound trajectory plane: plain vs coded frame counts
                 # and their payload bytes. traj_codec_wire_ratio is the
                 # receiver-side view of the codec's win (decoded bytes
@@ -997,6 +1063,7 @@ class LearnerServer:
                     "role": c.role,
                     "caps": c.caps,
                     "epoch": c.epoch,
+                    "tenant": c.tenant,
                 }
                 for c in self._conns.values()
             ]
@@ -1260,11 +1327,28 @@ class LearnerServer:
                     else:
                         traj, ep = arrays[:tag], arrays[tag:]
                     on_trajectory, pass_peer = self._sink
-                    if pass_peer:
+                    with self._reg_lock:
+                        peer = PeerInfo(
+                            c.cid, c.actor_id, c.generation, c.role,
+                            c.caps, c.epoch, c.tenant,
+                        )
+                    admission = self._admission
+                    if admission is not None and not admission(
+                        peer, nbytes
+                    ):
+                        # Over-budget tenant: the frame is SHED at
+                        # ingress — never decoded, validated, or
+                        # queued, so one flooding job cannot starve
+                        # the others. Still ACK (an unacked frame
+                        # would just be re-pushed, and re-pushing an
+                        # over-budget frame only floods harder); the
+                        # per-tenant attribution lives in the
+                        # admission controller's tenant_* counters.
                         with self._reg_lock:
-                            peer = PeerInfo(
-                                c.cid, c.actor_id, c.generation, c.role
-                            )
+                            self._shed_frames += 1
+                        self._send(c, KIND_ACK, self._version)
+                        continue
+                    if pass_peer:
                         ok = on_trajectory(traj, ep, peer)
                     else:
                         ok = on_trajectory(traj, ep)
@@ -1292,7 +1376,8 @@ class LearnerServer:
                         self._obs_reqs += 1
                         self._obs_bytes_in += nbytes
                         peer = PeerInfo(
-                            c.cid, c.actor_id, c.generation, c.role
+                            c.cid, c.actor_id, c.generation, c.role,
+                            c.caps, c.epoch, c.tenant,
                         )
                     # Reply closure: the batching tick answers this
                     # request asynchronously, on its own thread, via
@@ -1319,7 +1404,7 @@ class LearnerServer:
                     with self._reg_lock:
                         peer = PeerInfo(
                             c.cid, c.actor_id, c.generation, c.role,
-                            c.caps, c.epoch,
+                            c.caps, c.epoch, c.tenant,
                         )
                         if kind == KIND_SAMPLE_REQ:
                             self._sample_reqs += 1
@@ -1369,7 +1454,7 @@ class LearnerServer:
                         self._reshards_in += 1
                         peer = PeerInfo(
                             c.cid, c.actor_id, c.generation, c.role,
-                            c.caps, c.epoch,
+                            c.caps, c.epoch, c.tenant,
                         )
                     rmeta = (
                         np.asarray(arrays[0], np.int64).reshape(-1)
@@ -1399,7 +1484,7 @@ class LearnerServer:
                     with self._reg_lock:
                         peer = PeerInfo(
                             c.cid, c.actor_id, c.generation, c.role,
-                            c.caps, c.epoch,
+                            c.caps, c.epoch, c.tenant,
                         )
                         if kind == KIND_CANDIDATE:
                             self._candidate_polls += 1
@@ -1427,14 +1512,16 @@ class LearnerServer:
                     # liveness. Legacy clients ignore pong tags.
                     self._send(
                         c, KIND_PONG,
-                        (self._epoch << EPOCH_SHIFT)
+                        self._tenant_bits
+                        | (self._epoch << EPOCH_SHIFT)
                         | (tag & _EPOCH_SEQ_MASK),
                     )
                 elif kind == KIND_HELLO:
                     # Identity announcement: [actor_id, generation,
-                    # role, caps, epoch] — the trailing fields are
-                    # optional so a legacy 3-/4-field hello parses
-                    # unchanged with caps/epoch 0.
+                    # role, caps, epoch, tenant] — the trailing fields
+                    # are optional so a legacy 3-/4-/5-field hello
+                    # parses unchanged with caps/epoch/tenant 0 (the
+                    # default single-job tenant).
                     # One-way (no reply) so the client never blocks on it.
                     ident = (
                         np.asarray(arrays[0]).reshape(-1)
@@ -1451,6 +1538,8 @@ class LearnerServer:
                             c.caps = int(ident[3])
                         if ident.size >= 5:
                             c.epoch = int(ident[4])
+                        if ident.size >= 6:
+                            c.tenant = int(ident[5])
                         self._hellos += 1
                 elif kind == KIND_CLOSE:
                     reason = "graceful"
@@ -1459,7 +1548,7 @@ class LearnerServer:
                         with self._reg_lock:
                             peer = PeerInfo(
                                 c.cid, c.actor_id, c.generation,
-                                c.role, c.caps, c.epoch,
+                                c.role, c.caps, c.epoch, c.tenant,
                             )
                         try:
                             goodbye(peer)

@@ -35,6 +35,8 @@ ELASTIC = "elastic_"     # live membership / resharding (distributed/elastic.py)
 AUTOSCALER = "autoscaler_"   # fleet-scale policy (distributed/elastic.py)
 DELIVERY = "delivery_"   # continuous delivery (distributed/delivery.py)
 PROMO = "promo_"         # promotion latency (LatencyStats.summary prefix)
+TENANT = "tenant"        # tenant{N}_* dynamic keys + tenant_* statics
+                         # (distributed/tenancy.py admission + registry)
 SERVE_ACT = SERVE + "act_"   # LatencyStats.summary prefix (serving tier)
 REPLAY_SAMPLE = REPLAY + "sample_"  # LatencyStats.summary prefix (draws)
 REPLAY_PIPELINE = REPLAY + "pipeline_"  # learner-side replay pipeline
@@ -42,7 +44,7 @@ REPLAY_PIPELINE = REPLAY + "pipeline_"  # learner-side replay pipeline
 
 FAMILY_PREFIXES = (
     TRANSPORT, PIPELINE, SERVE, DEVICE, SHARD, REPLAY, ELASTIC,
-    AUTOSCALER, REPLAY_PIPELINE, DELIVERY, PROMO,
+    AUTOSCALER, REPLAY_PIPELINE, DELIVERY, PROMO, TENANT,
 )
 
 # --- registry: family key -> one-line provenance ---------------------
@@ -79,6 +81,9 @@ METRIC_NAMES: dict = {
     TRANSPORT + "pings": "heartbeat probes received",
     TRANSPORT + "hellos": "identity announcements received",
     TRANSPORT + "checksum_failures": "payload CRC mismatches",
+    TRANSPORT + "shed_frames": "TRAJ frames shed at ingress by the "
+                               "tenant admission handler (ACKed, "
+                               "never decoded)",
     TRANSPORT + "handoffs_sent": "KIND_HANDOFF frames to standbys",
     TRANSPORT + "mb_out": "megabytes sent (all frames)",
     TRANSPORT + "param_sends": "param fetches served",
@@ -127,6 +132,9 @@ METRIC_NAMES: dict = {
     SERVE + "shadow_batches": "shadow-scored act() dispatches",
     SERVE + "shadow_divergence": "mean live-vs-candidate action "
                                  "divergence under shadow",
+    SERVE + "tenants": "distinct tenants with live serving lanes",
+    SERVE + "policy_group_ticks": "batching ticks that dispatched "
+                                  "more than one per-policy group",
     SERVE_ACT + "count": "act latency samples",
     SERVE_ACT + "mean_ms": "act latency mean",
     SERVE_ACT + "p50_ms": "act latency p50",
@@ -266,6 +274,29 @@ METRIC_NAMES: dict = {
     DELIVERY + "store_evictions": "settled candidates evicted from "
                                   "the keep window",
     DELIVERY + "pending": "candidates awaiting a verdict",
+    DELIVERY + "verdict_quorum": "signed verdicts required to settle "
+                                 "a candidate (delivery_quorum knob)",
+    DELIVERY + "verdict_votes": "quorum votes received (lifetime)",
+    DELIVERY + "votes_pending": "partial-quorum votes held on "
+                                "unsettled candidates",
+    # -- tenant_* / tenant{N}_*: multi-tenant admission + registry
+    # (distributed/tenancy.py TenantAdmission / PolicyRegistry,
+    # per-tenant serving counters in distributed/serving.py, and the
+    # noisy-neighbor bench ledger in scripts/tenancy_bench.py)
+    TENANT + "_count": "tenants with admission-counter activity",
+    TENANT + "_frames_admitted": "frames admitted (all tenants)",
+    TENANT + "_frames_shed": "frames shed over budget (all tenants)",
+    TENANT + "_mb_shed": "payload MB shed over budget (all tenants)",
+    TENANT + "*_frames_admitted": "per-tenant frames admitted",
+    TENANT + "*_frames_shed": "per-tenant frames shed over budget",
+    TENANT + "*_mb_in": "per-tenant payload MB offered at ingress",
+    TENANT + "*_mb_shed": "per-tenant payload MB shed over budget",
+    TENANT + "*_budget_mb_s": "per-tenant token-bucket budget "
+                              "(0 = unmetered)",
+    TENANT + "*_serve_requests": "per-tenant serving-tier requests",
+    TENANT + "_registry_tenants": "tenants with registry ledgers",
+    TENANT + "_registry_policies": "(tenant, policy) stores resident",
+    TENANT + "_registry_events": "ledger events recorded (lifetime)",
     # -- promo_*: candidate-submitted -> promoted-and-serving latency
     # (DeliveryController's LatencyStats.summary)
     PROMO + "count": "promotion latency samples",

@@ -487,6 +487,32 @@ def measure_serve() -> dict:
     )
 
 
+def measure_tenancy() -> dict:
+    """BENCH_SERVE multi-tenant leg (scripts/tenancy_bench.py owns
+    the helpers): two tenants on one serving fleet — aggregate
+    actions/sec, the victim tenant's act p99 solo vs under a noisy
+    tenant's trajectory flood, and the ingress-shed counters proving
+    the flooder was throttled at its budget rather than served at the
+    victim's expense."""
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts"),
+    )
+    import tenancy_bench as tb
+
+    return tb.tenancy_leg(
+        victim_actors=int(os.environ.get("BENCH_TENANCY_VICTIMS", 2)),
+        noisy_actors=int(os.environ.get("BENCH_TENANCY_NOISY", 2)),
+        envs_per_actor=int(os.environ.get("BENCH_TENANCY_ENVS", 8)),
+        steps_per_actor=int(os.environ.get("BENCH_TENANCY_STEPS", 150)),
+        flooders=int(os.environ.get("BENCH_TENANCY_FLOODERS", 2)),
+        flood_budget_mb_s=float(
+            os.environ.get("BENCH_TENANCY_BUDGET_MB_S", 0.5)
+        ),
+        env=os.environ.get("BENCH_TENANCY_ENV", "CartPole-v1"),
+    )
+
+
 def measure_shard() -> dict:
     """Sharded-learner leg (scripts/shard_bench.py owns the helpers):
     aggregate learner env-steps/sec at 1 vs N in-process ingest shards
@@ -639,6 +665,15 @@ def main() -> int:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         try:
             print(json.dumps(measure_serve()))
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+            return 1
+        return 0
+
+    if len(sys.argv) > 1 and sys.argv[1] == "--measure-tenancy":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        try:
+            print(json.dumps(measure_tenancy()))
         except Exception:
             traceback.print_exc(file=sys.stderr)
             return 1
@@ -974,6 +1009,28 @@ def main() -> int:
             sys.stderr.write(
                 "[bench] serve leg failed\n"
                 + (schild.stderr[-2000:] if schild is not None else "")
+            )
+        # The multi-tenant leg rides the BENCH_SERVE opt-in: same
+        # serving tier, now shared by a metered noisy tenant.
+        tchild = None
+        try:
+            tchild = subprocess.run(
+                [
+                    sys.executable, os.path.abspath(__file__),
+                    "--measure-tenancy",
+                ],
+                capture_output=True,
+                text=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                timeout=int(os.environ.get("BENCH_CHILD_TIMEOUT", 900)),
+            )
+            payload["tenancy"] = json.loads(
+                tchild.stdout.strip().splitlines()[-1]
+            )
+        except Exception:
+            sys.stderr.write(
+                "[bench] tenancy leg failed\n"
+                + (tchild.stderr[-2000:] if tchild is not None else "")
             )
     print(json.dumps(payload))
     return 0

@@ -1,0 +1,383 @@
+"""Multi-tenant serving bench: noisy-neighbor isolation on one fleet.
+
+Two tenants share one ``LearnerServer`` + ``InferenceServer`` (the
+real compiled CartPole ``act()``): a VICTIM fleet (tenant 1,
+unmetered) and a NOISY fleet (tenant 2, token-bucket budget via
+``TenantAdmission``).  The leg measures the victim's client-observed
+act p99 twice — solo, then while the noisy tenant both serves its own
+act traffic and floods the trajectory ingress with oversized frames —
+and reads the per-tenant admission counters to witness that the
+flooder's overage was shed at ingress (before decode/sink) rather
+than by starving the victim.
+
+The isolation claim this leg pins: ``p99_isolation_ratio``
+(victim p99 under flood / victim p99 solo) stays bounded because the
+flooder is throttled at its budget, not at the victim's expense.  On
+1-core containers clients, server and flooders timeshare the same
+core, so the ratio measures scheduler fairness more than admission —
+``cpu_limited`` flags that honestly (BENCH discipline).
+
+``bench.py --measure-tenancy`` (``BENCH_SERVE=1``) runs this in a
+subprocess and merges the dict into the bench JSON line under
+``"tenancy"``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import sys
+import threading
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import numpy as np  # noqa: E402
+
+VICTIM_TENANT = 1
+NOISY_TENANT = 2
+
+
+def _quiet(msg):  # server logs stay out of the measurement output
+    pass
+
+
+def _tenant_shim(
+    actor_id: int,
+    tenant: int,
+    host: str,
+    port: int,
+    b: int,
+    steps: int,
+    warmup: int,
+    obs_specs,
+    barrier,
+    out_q,
+) -> None:
+    """One scripted shim client on a tenant-tagged lane.
+
+    The scripted payload (no real env) isolates the serving path —
+    wire + (tenant, actor) lane coalescing + per-policy dispatch —
+    from env CPU, same rationale as ``serve_bench``'s scripted mode.
+    Runs ``warmup`` steps, waits on the barrier twice around the
+    timed phase, ships per-step act latencies (ms) via ``out_q``.
+    """
+    from actor_critic_algs_on_tensorflow_tpu.distributed.serving import (
+        N_STEP_LEAVES,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+        CAP_INFERENCE,
+        ROLE_ACTOR,
+        ActorClient,
+    )
+
+    try:
+        obs_leaves = [
+            np.zeros(shape, np.dtype(dt)) for shape, dt in obs_specs
+        ]
+        step_leaves = [np.zeros(b, np.float32)] * N_STEP_LEAVES
+        client = ActorClient(
+            host,
+            port,
+            hello=(actor_id, 0, ROLE_ACTOR, CAP_INFERENCE, 0, tenant),
+        )
+        seq = 0
+        lat_ms = []
+
+        def one_step(record: bool):
+            nonlocal seq
+            leaves = [*obs_leaves, *step_leaves]
+            t0 = time.perf_counter()
+            client.act_request(seq, leaves)
+            if record:
+                lat_ms.append((time.perf_counter() - t0) * 1e3)
+            seq += 1
+            for leaf in obs_leaves:
+                leaf.flat[0] = float(seq % 251)
+
+        for _ in range(warmup):
+            one_step(False)
+        barrier.wait()
+        for _ in range(steps):
+            one_step(True)
+        barrier.wait()
+        client.close()
+        out_q.put((actor_id, lat_ms))
+    except Exception as e:  # surfaced by the parent
+        try:
+            barrier.abort()
+        except Exception:
+            pass
+        out_q.put((actor_id, e))
+
+
+def _flooder(
+    actor_id: int,
+    host: str,
+    port: int,
+    frame_kb: int,
+    stop_event,
+    counts,
+    slot: int,
+) -> None:
+    """Pushes oversized TRAJ frames on the noisy tenant until told to
+    stop.  Shed frames are still ACKed, so the loop runs at wire
+    speed — exactly the over-budget producer the admission tier is
+    there to meter."""
+    from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+        ROLE_ACTOR,
+        ActorClient,
+    )
+
+    try:
+        client = ActorClient(
+            host,
+            port,
+            hello=(actor_id, 0, ROLE_ACTOR, 0, 0, NOISY_TENANT),
+        )
+        frame = np.zeros(frame_kb * 1024 // 8, np.float64)
+        sent = 0
+        while not stop_event.is_set():
+            client.push_trajectory([frame])
+            sent += 1
+        client.close()
+        counts[slot] = sent
+    except Exception:
+        counts[slot] = counts[slot] or 0
+
+
+def _run_fleet(specs, shim_args, lat_capacity):
+    """Start shim threads, time the barrier-coordinated window, pool
+    latencies per tenant.  ``specs`` is [(actor_id, tenant), ...]."""
+    from actor_critic_algs_on_tensorflow_tpu.utils.metrics import (
+        LatencyStats,
+    )
+
+    barrier = threading.Barrier(len(specs) + 1)
+    out_q = queue.Queue()
+    workers = [
+        threading.Thread(
+            target=_tenant_shim,
+            args=(aid, tenant, *shim_args, barrier, out_q),
+            daemon=True,
+        )
+        for aid, tenant in specs
+    ]
+    for w in workers:
+        w.start()
+    barrier.wait()  # all clients warmed (jit compiles paid)
+    t0 = time.perf_counter()
+    barrier.wait()  # all timed steps done
+    wall = time.perf_counter() - t0
+    by_tenant = {}
+    tenant_of = dict(specs)
+    for _ in range(specs.__len__()):
+        aid, payload = out_q.get(timeout=120.0)
+        if isinstance(payload, Exception):
+            raise payload
+        stats = by_tenant.setdefault(
+            tenant_of[aid], LatencyStats(capacity=lat_capacity)
+        )
+        for ms in payload:
+            stats.add_ms(ms)
+    for w in workers:
+        w.join(timeout=10.0)
+    return wall, by_tenant
+
+
+def tenancy_leg(
+    *,
+    victim_actors: int = 2,
+    noisy_actors: int = 2,
+    envs_per_actor: int = 8,
+    steps_per_actor: int = 150,
+    warmup_steps: int = 20,
+    flooders: int = 2,
+    flood_budget_mb_s: float = 0.5,
+    flood_frame_kb: int = 128,
+    max_wait_ms: float = 2.0,
+    env: str = "CartPole-v1",
+) -> dict:
+    """Solo-vs-flood isolation measurement; returns the merged dict.
+
+    Phase 1 (solo): victim fleet alone → baseline act p99.  Phase 2
+    (flood): victim + noisy fleets serving concurrently while flooder
+    clients push ``flood_frame_kb`` KB trajectory frames on the noisy
+    tenant, whose budget is ``flood_budget_mb_s`` MB/s — everything
+    above it is shed at ingress with per-tenant counters.
+    """
+    import jax
+
+    from actor_critic_algs_on_tensorflow_tpu.algos.impala import (
+        ImpalaConfig,
+        _derive_wire_plan,
+        make_impala,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.distributed.serving import (
+        InferenceServer,
+        request_specs_for,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.distributed.tenancy import (
+        TenantAdmission,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
+        LearnerServer,
+    )
+    from actor_critic_algs_on_tensorflow_tpu.utils import metric_names
+
+    cfg = ImpalaConfig(
+        env=env, envs_per_actor=envs_per_actor, num_devices=1
+    )
+    programs = make_impala(cfg)
+    params = programs.init(jax.random.PRNGKey(0)).params
+    traj_shape = _derive_wire_plan(programs, params)[3]
+    b = envs_per_actor
+    obs_treedef, request_specs = request_specs_for(traj_shape.obs, b)
+    obs_specs = [
+        (shape, np.dtype(dt).str)
+        for shape, dt in request_specs[: obs_treedef.num_leaves]
+    ]
+
+    admission = TenantAdmission(
+        default_mb_s=0.0,  # victim unmetered
+        budgets={NOISY_TENANT: flood_budget_mb_s},
+        log=_quiet,
+    )
+    ingested = [0]
+    server = LearnerServer(
+        lambda t, e: ingested.__setitem__(0, ingested[0] + 1),
+        log=_quiet,
+    )
+    server.set_admission_handler(admission.admit_frame)
+    serving = InferenceServer(
+        programs.act,
+        params,
+        obs_treedef=obs_treedef,
+        request_specs=request_specs,
+        rollout_length=cfg.rollout_length,
+        batch_max=victim_actors + noisy_actors,
+        max_wait_s=max_wait_ms / 1e3,
+        sink=lambda tl, el, aid: None,
+        seed=0,
+        log=_quiet,
+    )
+    # Noisy tenant serves off its own registered policy so the flood
+    # phase exercises the per-policy dispatch groups, not one shared
+    # param set.
+    serving.set_params(params, tenant=NOISY_TENANT)
+    server.set_inference_handler(serving.submit)
+    shim_args = (
+        "127.0.0.1",
+        server.port,
+        b,
+        steps_per_actor,
+        warmup_steps,
+        obs_specs,
+    )
+
+    # --- phase 1: victim alone --------------------------------------
+    solo_specs = [(i, VICTIM_TENANT) for i in range(victim_actors)]
+    _, solo_lat = _run_fleet(
+        solo_specs, shim_args, victim_actors * steps_per_actor
+    )
+    solo = solo_lat[VICTIM_TENANT].summary()
+
+    # --- phase 2: victim + noisy serving, flooders on TRAJ ingress ---
+    stop = threading.Event()
+    counts = [0] * flooders
+    flood_threads = [
+        threading.Thread(
+            target=_flooder,
+            args=(
+                200 + i, "127.0.0.1", server.port,
+                flood_frame_kb, stop, counts, i,
+            ),
+            daemon=True,
+        )
+        for i in range(flooders)
+    ]
+    for t in flood_threads:
+        t.start()
+    flood_specs = solo_specs + [
+        (100 + i, NOISY_TENANT) for i in range(noisy_actors)
+    ]
+    wall, flood_lat = _run_fleet(
+        flood_specs,
+        shim_args,
+        (victim_actors + noisy_actors) * steps_per_actor,
+    )
+    stop.set()
+    for t in flood_threads:
+        t.join(timeout=10.0)
+    flood = flood_lat[VICTIM_TENANT].summary()
+    noisy = flood_lat[NOISY_TENANT].summary()
+
+    am = admission.metrics()
+    sm = serving.metrics()
+    tm = server.metrics()
+    serving.close()
+    server.close()
+
+    aggregate = (
+        (victim_actors + noisy_actors) * steps_per_actor * b
+        / max(wall, 1e-9)
+    )
+    cpus = os.cpu_count() or 1
+    out = {
+        "tenants": 2,
+        "victim_actors": victim_actors,
+        "noisy_actors": noisy_actors,
+        "flooders": flooders,
+        "envs_per_actor": b,
+        "env": env,
+        "flood_budget_mb_s": flood_budget_mb_s,
+        "flood_frame_kb": flood_frame_kb,
+        "aggregate_actions_per_sec": round(aggregate, 1),
+        "victim_act_p50_ms_solo": solo["p50_ms"],
+        "victim_act_p99_ms_solo": solo["p99_ms"],
+        "victim_act_p50_ms_flood": flood["p50_ms"],
+        "victim_act_p99_ms_flood": flood["p99_ms"],
+        "noisy_act_p99_ms_flood": noisy["p99_ms"],
+        "p99_isolation_ratio": round(
+            flood["p99_ms"] / max(solo["p99_ms"], 1e-9), 3
+        ),
+        "flood_frames_sent": int(sum(counts)),
+        "flood_frames_shed": int(
+            am.get(f"tenant{NOISY_TENANT}_frames_shed", 0)
+        ),
+        "flood_frames_admitted": int(
+            am.get(f"tenant{NOISY_TENANT}_frames_admitted", 0)
+        ),
+        "flood_mb_shed": am.get("tenant_mb_shed", 0.0),
+        "transport_shed_frames": int(
+            tm.get("transport_shed_frames", 0)
+        ),
+        "frames_ingested": ingested[0],
+        "serve_tenants": int(
+            sm.get(metric_names.SERVE + "tenants", 0)
+        ),
+        "serve_policy_group_ticks": int(
+            sm.get(metric_names.SERVE + "policy_group_ticks", 0)
+        ),
+        # Clients, server tick thread and flooders all timeshare the
+        # host; below this core budget the p99 ratio measures the
+        # scheduler, not admission isolation.
+        "cpu_limited": cpus
+        < victim_actors + noisy_actors + flooders + 2,
+    }
+    print(
+        f"TENANCY solo p99={solo['p99_ms']:.2f}ms "
+        f"flood p99={flood['p99_ms']:.2f}ms "
+        f"ratio={out['p99_isolation_ratio']} "
+        f"aggregate={aggregate:.0f} act/s "
+        f"shed={out['flood_frames_shed']}/{out['flood_frames_sent']}",
+        flush=True,
+    )
+    return out
+
+
+if __name__ == "__main__":
+    print(json.dumps(tenancy_leg()), flush=True)

@@ -126,3 +126,56 @@ def greedy_cartpole_return(params, model=None):
         )
     )(jax.random.PRNGKey(123))
     return float(mean_ret), float(frac_done)
+
+import time as _time
+
+
+def wait_registered(server, *expect, hellos=None, timeout=5.0):
+    """Poll a ``LearnerServer``'s hello registry until it settles.
+
+    Hellos register asynchronously on each connection's serve thread,
+    so a registry/membership assertion issued right after connect races
+    them (the async-hello flake class first hardened ad hoc inside
+    ``test_membership_and_reshard_wire_kinds``). ``expect`` is any
+    number of ``(actor_id, generation)`` pairs that must ALL appear in
+    ``server.connections()``; ``hellos`` additionally waits for
+    ``transport_hellos >= hellos``. Returns the settled connection
+    rows; raises ``AssertionError`` on timeout so the failure names
+    what never registered instead of surfacing as a downstream
+    ``KeyError``."""
+    want = {(int(a), int(g)) for a, g in expect}
+    deadline = _time.monotonic() + timeout
+    while True:
+        rows = server.connections()
+        seen = {(r["actor_id"], r["generation"]) for r in rows}
+        if want <= seen and (
+            hellos is None
+            or server.metrics()["transport_hellos"] >= hellos
+        ):
+            return rows
+        if _time.monotonic() >= deadline:
+            raise AssertionError(
+                f"hellos never registered: want {sorted(want)} "
+                f"(hellos>={hellos}), have {sorted(seen)}"
+            )
+        _time.sleep(0.01)
+
+
+def wait_member_rows(client, expect, *, seq=0, timeout=5.0):
+    """Wire-side twin of ``wait_registered``: poll
+    ``client.membership_request`` until every ``(actor_id,
+    generation)`` pair in ``expect`` appears in the reply rows.
+    Returns the final ``(rows, hellos, epoch)`` reply."""
+    want = {(int(a), int(g)) for a, g in expect}
+    deadline = _time.monotonic() + timeout
+    while True:
+        rows, hellos, epoch = client.membership_request(seq=seq)
+        seen = {(r[0], r[1]) for r in rows if r[0] >= 0}
+        if want <= seen:
+            return rows, hellos, epoch
+        if _time.monotonic() >= deadline:
+            raise AssertionError(
+                f"hellos never registered: want {sorted(want)}, "
+                f"have {sorted(seen)}"
+            )
+        _time.sleep(0.01)

@@ -703,3 +703,152 @@ def test_offpolicy_autoscale_reshard_applies_live(tmp_path):
     assert any(
         name.endswith("-g1") for name in os.listdir(snap_root)
     )
+
+
+# ---------------------------------------------------------------------
+# Verdict quorum (ISSUE 19 satellite): majority of N signed verdicts.
+# ---------------------------------------------------------------------
+
+def _vote(ctl, secret, meta, evaluator_id, promote, score):
+    ctl._apply_verdict(
+        _verdict_frame(secret, meta, promote, score),
+        PeerInfo(0, evaluator_id, 0, ROLE_EVALUATOR),
+    )
+
+
+def test_verdict_quorum_majority_promotes_and_revote_single_counts():
+    server = _FakeServer()
+    ctl = DeliveryController(
+        PolicyStore(), server, secret=b"q", verdict_quorum=3,
+        log=lambda m: None,
+    )
+    ctl.submit(_leaves(0.0))  # bootstrap auto-promotes
+    cand = ctl.submit(_leaves(5.0), step=10)
+    _vote(ctl, b"q", cand, 9001, True, 5.0)
+    # 1 of 3 is short of the majority: the candidate stays pending.
+    assert cand.status == PENDING
+    assert ctl.metrics()["delivery_votes_pending"] == 1
+    # A re-poll's repeat verdict overwrites the SAME evaluator's slot
+    # — it must never complete the quorum on its own.
+    _vote(ctl, b"q", cand, 9001, True, 6.0)
+    assert cand.status == PENDING
+    assert ctl.metrics()["delivery_votes_pending"] == 1
+    _vote(ctl, b"q", cand, 9002, True, 7.0)  # 2nd distinct: majority
+    assert cand.status == PROMOTED
+    # Settled score = mean of the majority's latest votes.
+    assert cand.score == pytest.approx((6.0 + 7.0) / 2)
+    m = ctl.metrics()
+    assert m["delivery_verdict_quorum"] == 3
+    assert m["delivery_verdict_votes"] == 3
+    assert m["delivery_votes_pending"] == 0
+
+
+def test_verdict_quorum_reject_majority_keeps_fleet_unchanged():
+    server = _FakeServer()
+    ctl = DeliveryController(
+        PolicyStore(), server, secret=b"q", verdict_quorum=3,
+        log=lambda m: None,
+    )
+    ctl.submit(_leaves(0.0))
+    published_after_bootstrap = len(server.published)
+    cand = ctl.submit(_leaves(-9.0), step=10)
+    _vote(ctl, b"q", cand, 9001, True, 2.0)    # one optimist
+    _vote(ctl, b"q", cand, 9002, False, -9.0)
+    assert cand.status == PENDING              # 1-1: no majority yet
+    _vote(ctl, b"q", cand, 9003, False, -8.0)
+    assert cand.status == REJECTED
+    assert len(server.published) == published_after_bootstrap
+    assert ctl.metrics()["delivery_rejections"] == 1
+
+
+def test_verdict_quorum_partial_votes_dropped_on_quarantine():
+    server = _FakeServer()
+    ctl = DeliveryController(
+        PolicyStore(), server, secret=b"q", verdict_quorum=3,
+        verdict_timeout_s=0.01, log=lambda m: None,
+    )
+    ctl.submit(_leaves(0.0))
+    cand = ctl.submit(_leaves(5.0), step=10)
+    _vote(ctl, b"q", cand, 9001, True, 5.0)
+    assert ctl.metrics()["delivery_votes_pending"] == 1
+    time.sleep(0.05)
+    assert ctl.check_timeouts() == 1
+    assert cand.status == QUARANTINED
+    # The partial quorum died with the candidate...
+    assert ctl.metrics()["delivery_votes_pending"] == 0
+    # ...and a straggler's late verdict is stale, not a resurrection.
+    _vote(ctl, b"q", cand, 9002, True, 6.0)
+    assert cand.status == QUARANTINED
+    assert ctl.metrics()["delivery_stale_verdicts"] == 1
+
+
+def test_quorum_default_one_first_verdict_decides():
+    server = _FakeServer()
+    ctl = DeliveryController(
+        PolicyStore(), server, secret=b"q", log=lambda m: None
+    )
+    ctl.submit(_leaves(0.0))
+    cand = ctl.submit(_leaves(5.0), step=10)
+    _vote(ctl, b"q", cand, 9001, True, 5.0)
+    assert cand.status == PROMOTED  # the pre-quorum behavior, pinned
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_quorum_survives_sigkilled_evaluator():
+    """SIGKILL one of a 3-evaluator panel: the remaining two still form
+    a majority and promotion keeps flowing over the real wire."""
+    import multiprocessing as mp
+    import os as os_lib
+    import signal as signal_lib
+
+    from actor_critic_algs_on_tensorflow_tpu.distributed.delivery import (
+        evaluator_process_main,
+    )
+
+    ctx = mp.get_context("spawn")
+    with PortReservation() as reservation:
+        server = _quiet_server(port=reservation.release())
+        ctl = DeliveryController(
+            PolicyStore(), server, secret=b"panel", verdict_quorum=3,
+            log=lambda m: None,
+        )
+        server.set_delivery_handler(ctl.handle)
+        evaluators = [
+            ctx.Process(
+                target=evaluator_process_main,
+                args=("127.0.0.1", server.port),
+                kwargs=dict(
+                    bar=1.0, secret=b"panel",
+                    evaluator_id=9000 + i, poll_interval_s=0.05,
+                ),
+                daemon=True,
+            )
+            for i in range(3)
+        ]
+        try:
+            with time_limit(120, "quorum sigkill"):
+                for p in evaluators:
+                    p.start()
+                ctl.submit(_leaves(0.0))  # bootstrap
+                # Hard-kill one panel member BEFORE the candidate: two
+                # live voters remain, exactly the majority of 3.
+                os_lib.kill(evaluators[0].pid, signal_lib.SIGKILL)
+                evaluators[0].join(10.0)
+                cand = ctl.submit(_leaves(5.0), step=10)
+                deadline = time.monotonic() + 60.0
+                while (
+                    cand.status == PENDING
+                    and time.monotonic() < deadline
+                ):
+                    time.sleep(0.05)
+                assert cand.status == PROMOTED
+                m = ctl.metrics()
+                assert m["delivery_verdict_quorum"] == 3
+                assert m["delivery_verdict_votes"] >= 2
+        finally:
+            server.close()
+            for p in evaluators:
+                if p.is_alive():
+                    p.terminate()
+                p.join(10.0)

@@ -513,6 +513,8 @@ def test_coordinator_reshard_cycle_and_resume(tmp_path):
 def test_membership_and_reshard_wire_kinds():
     import time
 
+    from tests.helpers import wait_member_rows
+
     server = LearnerServer(lambda traj, ep: None, log=lambda m: None)
     try:
         c0 = ActorClient(
@@ -523,18 +525,10 @@ def test_membership_and_reshard_wire_kinds():
         )
         # Membership answered straight from the registry — no handler.
         # Hellos register asynchronously on each connection's server
-        # thread, so poll until both have landed.
-        deadline = time.monotonic() + 5.0
-        while True:
-            rows, hellos, epoch = c1.membership_request(seq=5)
-            seen = {(r[0], r[1]) for r in rows if r[0] >= 0}
-            if {(0, 1), (3, 2)} <= seen:
-                break
-            if time.monotonic() >= deadline:
-                raise AssertionError(
-                    f"hellos never registered: {seen}"
-                )
-            time.sleep(0.01)
+        # thread, so poll until both have landed (helpers.wait_member_rows).
+        rows, hellos, epoch = wait_member_rows(
+            c1, [(0, 1), (3, 2)], seq=5
+        )
         assert hellos >= 2 and epoch == 0
         # The reply rows are exactly what MembershipView diffs.
         view = MembershipView()
@@ -561,7 +555,9 @@ def test_membership_and_reshard_wire_kinds():
         assert (ep, shards) == (7, 2)
         assert ReshardPlan.from_json(plan_json) == plan
         m = server.metrics()
-        assert m["transport_member_reqs"] == 1
+        # wait_member_rows polls: one request per attempt until both
+        # hellos have registered, so the count is at-least, not exact.
+        assert m["transport_member_reqs"] >= 1
         assert m["transport_reshard_notices"] == 1
     finally:
         server.close()

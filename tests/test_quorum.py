@@ -35,7 +35,12 @@ from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
     epoch_of,
     version_seq,
 )
-from tests.helpers import PortReservation, reserve_port, time_limit
+from tests.helpers import (
+    PortReservation,
+    reserve_port,
+    time_limit,
+    wait_registered,
+)
 
 
 def _quiet_server(sink=None, **kw):
@@ -104,13 +109,8 @@ def test_hello_epoch_field_recorded_in_registry():
         c4 = ActorClient(
             "127.0.0.1", server.port, hello=(4, 0, ROLE_STANDBY, 0)
         )
-        deadline = time.monotonic() + 5.0
-        while (
-            server.metrics()["transport_hellos"] < 2
-            and time.monotonic() < deadline
-        ):
-            time.sleep(0.02)
-        by_id = {c["actor_id"]: c for c in server.connections()}
+        rows = wait_registered(server, (3, 0), (4, 0), hellos=2)
+        by_id = {c["actor_id"]: c for c in rows}
         assert by_id[3]["epoch"] == 7
         assert by_id[4]["epoch"] == 0
         c5.close()
@@ -138,14 +138,9 @@ def test_monitor_and_tailer_share_one_distinct_standby_id():
                 standby_id=rank, poll_interval_s=0.1,
                 log=lambda m: None,
             ))
-        deadline = time.monotonic() + 5.0
-        while (
-            server.metrics()["transport_hellos"] < 4
-            and time.monotonic() < deadline
-        ):
-            time.sleep(0.02)
+        rows = wait_registered(server, (4, 0), (7, 0), hellos=4)
         standby_ids = sorted(
-            c["actor_id"] for c in server.connections()
+            c["actor_id"] for c in rows
             if c["role"] == ROLE_STANDBY
         )
         assert standby_ids == [4, 4, 7, 7]

@@ -45,7 +45,7 @@ from actor_critic_algs_on_tensorflow_tpu.utils.metrics import (
     LatencyStats,
     percentile,
 )
-from tests.helpers import PortReservation, time_limit
+from tests.helpers import PortReservation, time_limit, wait_registered
 
 B, D = 2, 3  # env rows per request / obs feature dim in the unit tests
 
@@ -396,14 +396,10 @@ def test_hello_caps_mixed_fleet_and_reconnect_reannounce():
         legacy.push_trajectory(
             [np.zeros((4, B), np.float32)], [np.zeros(B, np.float32)]
         )
-        deadline = time.monotonic() + 5
-        while time.monotonic() < deadline:
-            conns = {c["actor_id"]: c for c in server.connections()}
-            if len(conns) == 3 and all(
-                c["actor_id"] >= 0 for c in conns.values()
-            ):
-                break
-            time.sleep(0.02)
+        conns = {
+            c["actor_id"]: c
+            for c in wait_registered(server, (0, 0), (1, 0), (2, 0))
+        }
         assert conns[0]["caps"] == CAP_INFERENCE
         assert conns[1]["caps"] == CAP_TRAJ_CODED
         assert conns[2]["caps"] == 0  # legacy 3-field hello -> caps 0
@@ -415,15 +411,10 @@ def test_hello_caps_mixed_fleet_and_reconnect_reannounce():
             hello=(0, 1, ROLE_ACTOR, CAP_INFERENCE),
         )
         shim2.act_request(1, _request_leaves(1))
-        deadline = time.monotonic() + 5
-        while time.monotonic() < deadline:
-            fresh = [
-                c for c in server.connections()
-                if c["actor_id"] == 0 and c["generation"] == 1
-            ]
-            if fresh:
-                break
-            time.sleep(0.02)
+        fresh = [
+            c for c in wait_registered(server, (0, 1))
+            if c["actor_id"] == 0 and c["generation"] == 1
+        ]
         assert fresh and fresh[0]["caps"] == CAP_INFERENCE
         shim2.close()
         coded.close()

@@ -130,15 +130,17 @@ def test_bench_schema_fixtures():
     # BENCH_bad: missing cmd + parsed missing vs_baseline + replay
     # missing e2e_steps_per_sec and the PR-17 pipelined keys (one
     # finding listing them all) + elastic missing desyncs + promotion
-    # missing promote_p99_ms (BENCH001), rc / parsed.value /
-    # replay.ingest_tps / replay.overlap_frac /
-    # elastic.epochs_monotonic / promotion.promote_p50_ms /
-    # promotion.late_publish_fenced mistyped (BENCH002), cpu_limited
-    # int (BENCH003).
+    # missing promote_p99_ms + tenancy missing p99_isolation_ratio
+    # (BENCH001), rc / parsed.value / replay.ingest_tps /
+    # replay.overlap_frac / elastic.epochs_monotonic /
+    # promotion.promote_p50_ms / promotion.late_publish_fenced /
+    # tenancy.tenants / tenancy.flood_frames_shed mistyped
+    # (BENCH002), cpu_limited int (BENCH003).
     assert sorted(by_file["BENCH_bad.json"]) == [
         "BENCH001", "BENCH001", "BENCH001", "BENCH001", "BENCH001",
+        "BENCH001",
         "BENCH002", "BENCH002", "BENCH002", "BENCH002", "BENCH002",
-        "BENCH002", "BENCH002",
+        "BENCH002", "BENCH002", "BENCH002", "BENCH002",
         "BENCH003",
     ]
     # MULTICHIP_bad: missing skipped (BENCH001), ok mistyped (BENCH002).
