@@ -169,6 +169,11 @@ class ImpalaConfig:
     transport_idle_timeout_s: float = 120.0
     transport_retry_deadline_s: float = 60.0
     transport_max_frame_mb: int = 1024
+    # Server receive driver: "reactor" runs one selector event loop per
+    # listener (O(1) I/O threads in fleet size); "threads" is the
+    # legacy thread-per-connection fallback (wire- and fixed-seed
+    # identical).
+    server_io_mode: str = "reactor"
     # --- param-sync data plane (distributed.codec) -------------------
     # Serve weight fetches as lossless XOR-delta + zlib frames against
     # the version each client reports holding (full frame on a ring
@@ -2744,6 +2749,7 @@ def run_impala_distributed(
             param_bf16=cfg.param_bf16_wire,
             epoch=epoch,
             tenant=cfg.tenant_id,
+            server_io_mode=cfg.server_io_mode,
         )
 
     adopted = server is not None
@@ -2820,8 +2826,13 @@ def run_impala_distributed(
             burst_s=cfg.tenancy_burst_s,
             validator=validator,
         )
+        # The probe lets the reactor shed an over-budget tenant's TRAJ
+        # frame at header time — body bytes drained, never buffered —
+        # while admit_frame still runs at frame end for metering.
         for s in servers:
-            s.set_admission_handler(admission.admit_frame)
+            s.set_admission_handler(
+                admission.admit_frame, probe=admission.over_budget
+            )
 
     # No actor threads here, but a multi-device CPU learner must still
     # retire each collective-bearing dispatch before the next one
@@ -2887,7 +2898,15 @@ def run_impala_distributed(
             exec_lock=exec_lock,
             max_decode_bytes=cfg.transport_max_frame_mb << 20,
         )
-        server.set_inference_handler(serving.submit)
+        if cfg.server_io_mode == "reactor":
+            # One wakeup per OBS_REQ burst: the reactor coalesces all
+            # submits from a readiness pass into a single tick notify.
+            serving.set_wake_batching(True)
+            server.set_inference_handler(
+                serving.submit, batch_wake=serving.wake
+            )
+        else:
+            server.set_inference_handler(serving.submit)
         # Elastic leave: an orderly actor goodbye retires its serving
         # lane eagerly, so a scale-down does not leave ghost lanes
         # (and partial-segment builders) pinned for the rest of the
@@ -3660,6 +3679,7 @@ def run_impala_standby(
                     param_delta=cfg.param_delta,
                     param_delta_ring=cfg.param_delta_ring,
                     param_bf16=cfg.param_bf16_wire,
+                    server_io_mode=cfg.server_io_mode,
                     log=(lambda tag: lambda msg: print(
                         f"[{tag}] {msg}", flush=True
                     ))(f"standby-{standby_id}-server{j}"),

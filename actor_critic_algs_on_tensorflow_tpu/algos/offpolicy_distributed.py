@@ -583,6 +583,9 @@ def run_offpolicy_distributed(
                 ),
                 tenancy_budgets=getattr(cfg, "tenancy_budgets", ""),
                 tenancy_burst_s=getattr(cfg, "tenancy_burst_s", 2.0),
+                server_io_mode=getattr(
+                    cfg, "server_io_mode", "reactor"
+                ),
             ),
             daemon=True,
             name=f"replay-server-{k}",
@@ -632,6 +635,7 @@ def run_offpolicy_distributed(
         server = LearnerServer(
             _discard, host=host, port=port, epoch=epoch,
             tenant=getattr(cfg, "tenant_id", 0), log=log,
+            server_io_mode=getattr(cfg, "server_io_mode", "reactor"),
         )
     else:
         # Adopt a pre-bound listener (the standby's early data plane —
@@ -1754,6 +1758,7 @@ def run_offpolicy_standby(
     # never ride this plane — the absorb sink is a mis-wire backstop.)
     server = LearnerServer(
         lambda traj, ep: True, host=host, port=port,
+        server_io_mode=getattr(cfg, "server_io_mode", "reactor"),
         log=lambda msg: print(
             f"[offpolicy-standby-{standby_id}-server] {msg}", flush=True
         ),

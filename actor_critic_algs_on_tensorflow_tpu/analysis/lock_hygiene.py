@@ -16,7 +16,13 @@ peer behind it. Rules (scope: ``distributed/``):
            publish-path function — one wedged peer stalls the fleet
   LOCK003  a recv loop with no deadline source in its function — no
            ``settimeout``, no ``select.select`` gate, no deadline
-           variable — blocks its thread forever on a wedged peer
+           variable — blocks its thread forever on a wedged peer.
+           Reactor extension (PR-20): inside a reactor event-loop
+           function (name contains ``reactor``) ANY blocking call —
+           ``time.sleep``, ``recv_msg``, ``sendall``, a thread
+           ``join``, or a ``settimeout`` that would flip a
+           non-blocking fd — stalls EVERY connection on the shared
+           loop, so those are flagged outright
 
 Structural exceptions live in the module-level ``ALLOWLIST`` below,
 each with a justification string; tree-specific one-offs go in
@@ -47,6 +53,18 @@ ALLOWLIST = {
         "LOCK003: lowest-level fill helper; it never owns the socket "
         "— every caller configures the deadline (idle settimeout or "
         "a select gate) before handing the socket in"
+    ),
+    ("distributed/transport.py", "recv_msg"): (
+        "LOCK003: the blocking driver over the shared frame parser; "
+        "like _recv_exact_into it never owns the socket — every "
+        "caller configures the deadline (idle settimeout or a select "
+        "gate) before handing the socket in"
+    ),
+    ("distributed/transport.py", "_RxState.pump"): (
+        "LOCK003: reactor-side driver over a NON-BLOCKING socket — "
+        "recv returns immediately (BlockingIOError ends the pass); "
+        "the deadline lives in the reactor loop's selector timeout, "
+        "not on the fd"
     ),
     ("distributed/transport.py", "LearnerServer._broadcast_close"): (
         "LOCK001: shutdown-only goodbye send; the serve thread "
@@ -115,8 +133,9 @@ def _check_function(path, fn, qual, findings):
     is_broadcast_path = any(
         pat in fn.name.lower() for pat in _BROADCAST_PAT
     )
+    is_reactor_path = "reactor" in fn.name.lower()
     # Nested defs are visited as their own qualnames; don't double-walk.
-    own_nodes = _own_nodes(fn)
+    own_nodes = list(_own_nodes(fn))
 
     for node in own_nodes:
         if isinstance(node, ast.Call):
@@ -170,6 +189,34 @@ def _check_function(path, fn, qual, findings):
                         f"peer behind it",
                         hint="acquire(timeout=...) and skip the peer",
                     ))
+        # LOCK003 (reactor extension): a reactor event-loop function
+        # serves EVERY connection from one thread — any blocking call
+        # inside it is a fleet-wide stall, not a per-peer one.
+        if is_reactor_path and isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            base = name.rsplit(".", 1)[-1]
+            blocking = (
+                base in ("sleep", "recv_msg", "sendall", "settimeout")
+                or (
+                    base == "join"
+                    and any(
+                        pat in name.lower()
+                        for pat in ("thread", "proc")
+                    )
+                )
+            )
+            if blocking and not _allowed(path, qual, "LOCK003"):
+                findings.append(Finding(
+                    "LOCK003", path, node.lineno,
+                    f"blocking call {name}() inside reactor "
+                    f"event-loop function {qual}() — the loop serves "
+                    f"every connection, so this stalls the whole "
+                    f"fleet, not one peer",
+                    hint="do the blocking work off-loop (handler "
+                         "thread), or use the non-blocking/bounded "
+                         "variant (_sendmsg_all with stall_timeout_s, "
+                         "selector timeout)",
+                ))
         # LOCK003: recv loop with no deadline source in the function.
         if isinstance(node, ast.While):
             has_recv = any(

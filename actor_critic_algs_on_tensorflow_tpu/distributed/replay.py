@@ -1016,6 +1016,7 @@ def replay_server_main(
     tenancy_budget_mb_s: float = 0.0,
     tenancy_budgets: str = "",
     tenancy_burst_s: float = 2.0,
+    server_io_mode: str = "reactor",
 ) -> None:
     """Entry point of one spawned replay-server PROCESS.
 
@@ -1102,6 +1103,7 @@ def replay_server_main(
         # The replay tier publishes no params; the delta ring would
         # only hold memory.
         param_delta=False,
+        server_io_mode=server_io_mode,
         log=log,
     )
     server.set_replay_handler(service.handle)

@@ -26,6 +26,7 @@ from __future__ import annotations
 import dataclasses
 import random
 import select
+import selectors
 import socket
 import struct as struct_lib
 import threading
@@ -605,13 +606,26 @@ class ChaosProxy:
         # port 0 = ephemeral (tests); the control-plane Redirector binds
         # a FIXED port — it is the stable address the actor fleet keeps.
         self._listener = socket.create_server((host, port))
-        self._listener.settimeout(0.1)
+        self._listener.setblocking(False)
         self.port = self._listener.getsockname()[1]
-        self._threads: List[threading.Thread] = []
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, name="chaos-proxy-accept", daemon=True
+        # One selector-driven I/O thread per proxy carries the accept
+        # path and every link's both directions — a 64-link fleet costs
+        # one thread, not 128 half-second select polls. Paused links
+        # park (unregistered) until ``resume`` wakes the loop through
+        # the self-pipe.
+        self._selector = selectors.DefaultSelector()
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._wake_w.setblocking(False)
+        self._selector.register(
+            self._listener, selectors.EVENT_READ, "accept"
         )
-        self._accept_thread.start()
+        self._selector.register(self._wake_r, selectors.EVENT_READ, "wake")
+        self._parked: List[tuple] = []  # loop-owned: paused directions
+        self._io_thread = threading.Thread(
+            target=self._io_loop, name="chaos-proxy-io", daemon=True
+        )
+        self._io_thread.start()
 
     # -- fault controls -------------------------------------------------
 
@@ -709,6 +723,8 @@ class ChaosProxy:
             if l.paused.is_set():
                 l.paused.clear()
                 n += 1
+        if n:
+            self._wake()
         return n
 
     def live_links(self) -> int:
@@ -737,14 +753,101 @@ class ChaosProxy:
 
     # -- plumbing -------------------------------------------------------
 
-    def _accept_loop(self) -> None:
-        while not self._stop.is_set():
+    def _wake(self) -> None:
+        try:
+            self._wake_w.send(b"\0")
+        except (BlockingIOError, OSError):
+            pass  # pipe full = a wake is already pending
+
+    def _unregister(self, sock: socket.socket) -> None:
+        try:
+            self._selector.unregister(sock)
+        except (KeyError, ValueError, OSError):
+            pass  # never registered, or torn down concurrently
+
+    def _register(self, sock: socket.socket, entry: tuple) -> bool:
+        try:
+            self._selector.register(sock, selectors.EVENT_READ, entry)
+            return True
+        except KeyError:
+            # fd number reused: a reset link's registration lingers
+            # after its close (closed fds leave epoll silently, and
+            # reset_all runs off-loop). Evict the stale key — it is
+            # looked up by fd, so unregistering the NEW socket pops
+            # the OLD entry — then claim the slot.
+            self._unregister(sock)
+            try:
+                self._selector.register(
+                    sock, selectors.EVENT_READ, entry
+                )
+                return True
+            except (KeyError, ValueError, OSError):
+                return False
+        except (ValueError, OSError):
+            return False
+
+    def _io_loop(self) -> None:
+        # The single event loop: readiness on the listener accepts,
+        # readiness on a link direction forwards one chunk (with the
+        # armed faults applied), the self-pipe revives resumed links.
+        try:
+            while not self._stop.is_set():
+                try:
+                    events = self._selector.select(0.5)
+                except (OSError, ValueError):
+                    # A reset_all() can close fds under a non-epoll
+                    # selector mid-poll; sweep and re-enter.
+                    self._sweep_dead()
+                    continue
+                for key, _ in events:
+                    entry = key.data
+                    if entry == "wake":
+                        try:
+                            while self._wake_r.recv(4096):
+                                pass
+                        except (BlockingIOError, OSError):
+                            pass
+                    elif entry == "accept":
+                        self._accept_ready()
+                    else:
+                        self._pump_ready(entry)
+                if not events:
+                    # Idle tick: evict registrations whose links were
+                    # reset off-loop (their closed fds never fire).
+                    self._sweep_dead()
+                self._revive_parked()
+        finally:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            try:
+                self._selector.close()
+            except OSError:
+                pass
+            for s in (self._wake_r, self._wake_w):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    def _sweep_dead(self) -> None:
+        for key in list(self._selector.get_map().values()):
+            try:
+                dead = key.fileobj.fileno() < 0
+            except (OSError, ValueError):
+                dead = True
+            if dead:
+                self._unregister(key.fileobj)
+
+    def _accept_ready(self) -> None:
+        while True:
             try:
                 client, _ = self._listener.accept()
-            except socket.timeout:
-                continue
+            except (BlockingIOError, socket.timeout):
+                return
             except OSError:
-                break
+                return
             with self._lock:
                 refuse, target = self._refuse, self._target
                 truncate, self._truncate_after = self._truncate_after, None
@@ -777,81 +880,113 @@ class ChaosProxy:
                 self._links = [l for l in self._links if not l.closed]
                 self._links.append(link)
                 self.connections_total += 1
-            # Sweep finished pump threads: reconnect churn is the
-            # proxy's designed workload, so the list must stay O(live).
-            self._threads = [t for t in self._threads if t.is_alive()]
             for src, dst, is_up in (
                 (client, upstream, True),
                 (upstream, client, False),
             ):
-                t = threading.Thread(
-                    target=self._pump, args=(link, src, dst, is_up),
-                    name="chaos-proxy-pump", daemon=True,
-                )
-                t.start()
-                self._threads.append(t)
-        self._listener.close()
-
-    def _pump(self, link: _Link, src: socket.socket, dst: socket.socket,
-              upstream: bool) -> None:
-        try:
-            while not link.closed:
-                if link.paused.is_set():
-                    # Flapped: stop reading, keep the sockets. The
-                    # sender's TCP window closes naturally once the
-                    # kernel buffers fill — slow-but-alive.
-                    time.sleep(0.02)
-                    continue
-                # Gate the read so ``link.closed`` is honored within
-                # the poll interval instead of only when bytes arrive
-                # — a silent peer no longer pins the pump thread.
-                readable, _, _ = select.select([src], [], [], 0.5)
-                if not readable:
-                    continue
-                data = src.recv(65536)
-                if not data:
+                src.setblocking(False)
+                if not self._register(src, (link, src, dst, is_up)):
+                    link.close()
                     break
-                with self._lock:
-                    delay = self._delay
-                    corrupt = (
-                        upstream
-                        and self._corrupt_chunks > 0
-                        and len(data) >= self._corrupt_min_bytes
-                    )
-                    if corrupt:
-                        self._corrupt_chunks -= 1
-                        self.corrupted_chunks += 1
-                        clen = self._corrupt_len
-                if delay:
-                    time.sleep(delay)
-                if corrupt:
-                    # A quarter into the chunk: comfortably past the
-                    # frame/array headers at the front, inside the
-                    # first (largest) payload — for trajectory frames,
-                    # the float observations.
-                    at = len(data) // 4
-                    data = data[:at] + b"\xff" * clen + data[at + clen:]
-                if upstream and link.truncate_after is not None:
-                    if len(data) >= link.truncate_after:
-                        dst.sendall(data[: link.truncate_after])
-                        link.reset()
-                        return
-                    link.truncate_after -= len(data)
-                dst.sendall(data)
+
+    def _drop_link(self, entry: tuple) -> None:
+        link, src, dst, _ = entry
+        self._unregister(src)
+        self._unregister(dst)
+        # Crude full-close on either side ending: fine for a fault
+        # proxy (a half-closed link is indistinguishable from a fault
+        # to the retry layer anyway).
+        link.close()
+
+    def _send_all(self, link: _Link, dst: socket.socket,
+                  data: bytes) -> None:
+        # Non-blocking sockets need an explicit drain wait. A peer
+        # that stops reading stalls the loop here — the same stall a
+        # blocking sendall imposed per pump thread, now proxy-wide;
+        # acceptable for a fault proxy whose links are test fixtures.
+        view = memoryview(data)
+        while view and not link.closed:
+            try:
+                sent = dst.send(view)
+                view = view[sent:]
+            except BlockingIOError:
+                select.select([], [dst], [], 0.1)
+
+    def _pump_ready(self, entry: tuple) -> None:
+        link, src, dst, upstream = entry
+        if link.closed:
+            self._drop_link(entry)
+            return
+        if link.paused.is_set():
+            # Flapped: stop reading, keep the sockets. The sender's
+            # TCP window closes naturally once the kernel buffers
+            # fill — slow-but-alive. Parked until resume() wakes us.
+            self._unregister(src)
+            self._parked.append(entry)
+            return
+        try:
+            data = src.recv(65536)
+        except BlockingIOError:
+            return
         except (OSError, ValueError):
-            # ValueError: a link.close() between the loop's closed
-            # check and the select handed a -1 fd to select().
-            pass
-        finally:
-            # Crude full-close on either side ending: fine for a fault
-            # proxy (a half-closed link is indistinguishable from a
-            # fault to the retry layer anyway).
-            link.close()
+            self._drop_link(entry)
+            return
+        if not data:
+            self._drop_link(entry)
+            return
+        with self._lock:
+            delay = self._delay
+            corrupt = (
+                upstream
+                and self._corrupt_chunks > 0
+                and len(data) >= self._corrupt_min_bytes
+            )
+            if corrupt:
+                self._corrupt_chunks -= 1
+                self.corrupted_chunks += 1
+                clen = self._corrupt_len
+        if delay:
+            time.sleep(delay)
+        if corrupt:
+            # A quarter into the chunk: comfortably past the
+            # frame/array headers at the front, inside the first
+            # (largest) payload — for trajectory frames, the float
+            # observations.
+            at = len(data) // 4
+            data = data[:at] + b"\xff" * clen + data[at + clen:]
+        try:
+            if upstream and link.truncate_after is not None:
+                if len(data) >= link.truncate_after:
+                    self._send_all(link, dst, data[: link.truncate_after])
+                    link.reset()
+                    self._drop_link(entry)
+                    return
+                link.truncate_after -= len(data)
+            self._send_all(link, dst, data)
+        except (OSError, ValueError):
+            self._drop_link(entry)
+
+    def _revive_parked(self) -> None:
+        if not self._parked:
+            return
+        keep: List[tuple] = []
+        for entry in self._parked:
+            link, src, _, _ = entry
+            if link.closed:
+                self._drop_link(entry)
+                continue
+            if link.paused.is_set():
+                keep.append(entry)
+                continue
+            if not self._register(src, entry):
+                self._drop_link(entry)
+        self._parked = keep
 
     def close(self) -> None:
         self._stop.set()
+        self._wake()
         with self._lock:
             links = list(self._links)
         for link in links:
             link.close()
-        self._accept_thread.join(timeout=2.0)
+        self._io_thread.join(timeout=2.0)

@@ -278,6 +278,12 @@ class InferenceServer:
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._pending: List[_Pending] = []
+        # Deferred wakes (reactor transport): when True, submit() does
+        # NOT notify the tick per request — the transport's event loop
+        # calls wake() once per readiness pass instead, so an OBS_REQ
+        # burst costs one condition-variable wake, not N. The tick's
+        # bounded wait (0.2 s / 50 ms) backstops a lost wake.
+        self._defer_wakes = False
         # Lanes are keyed (tenant, actor_key): one fleet multiplexes N
         # jobs, each actor's idempotency guard and builder scoped to
         # its tenant. Tenant 0 is the default single-job tenant.
@@ -494,9 +500,25 @@ class InferenceServer:
                 lane.inflight = req
                 self._pending.append(req)
                 self._requests += 1
-                self._cond.notify()
+                if not self._defer_wakes:
+                    self._cond.notify()
         if cached is not None:
             reply(cached)
+
+    def set_wake_batching(self, defer: bool) -> None:
+        """Switch submit() to DEFERRED wakes: the caller promises to
+        invoke ``wake()`` after each burst of submits (the reactor
+        transport's per-readiness-pass batch wake). One boolean store
+        (GIL-atomic); a request racing the flip at worst costs one
+        extra notify or rides the tick's 0.2 s backstop."""
+        self._defer_wakes = bool(defer)
+
+    def wake(self) -> None:
+        """Nudge the batching tick once — the deferred-wake partner of
+        ``set_wake_batching`` (installed as the transport's
+        ``batch_wake``)."""
+        with self._cond:
+            self._cond.notify()
 
     # -- batching tick --------------------------------------------------
 

@@ -157,6 +157,28 @@ class TenantAdmission:
         with self._lock:
             return self._charge(tenant, int(nbytes))
 
+    def over_budget(self, peer) -> bool:
+        """HEADER-TIME peek (the reactor transport's shed probe,
+        installed via ``set_admission_handler(..., probe=...)``): is
+        this peer's tenant exhausted RIGHT NOW? Refills the bucket but
+        charges nothing — ``admit_frame`` still runs at frame end for
+        the metering attribution — so a True here lets the transport
+        drain the frame's body to scratch instead of buffering it."""
+        tenant = int(getattr(peer, "tenant", DEFAULT_TENANT))
+        rate = self.rate_for(tenant)
+        if rate <= 0.0:
+            return False
+        with self._lock:
+            now = self._time()
+            cap = rate * self._burst_s
+            bucket = self._buckets.get(tenant)
+            if bucket is None:
+                return False
+            tokens, last = bucket
+            tokens = min(cap, tokens + (now - last) * rate)
+            bucket[0], bucket[1] = tokens, now
+            return tokens <= 0.0
+
     # -- in-process / validator-extending gate --------------------------
 
     def admit(

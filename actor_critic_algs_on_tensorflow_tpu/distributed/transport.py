@@ -56,6 +56,7 @@ import collections
 import dataclasses
 import math
 import select
+import selectors
 import socket
 import struct as struct_lib
 import threading
@@ -351,21 +352,52 @@ def pack_arrays(kind: int, tag: int, arrays: Sequence[np.ndarray]) -> bytes:
 # comfortably below it. Each chunk is one vectored write syscall.
 _SENDMSG_MAX_BUFFERS = 512
 
+# How long a send on a NON-BLOCKING socket (the reactor's connections)
+# may sit in EAGAIN before the connection is declared wedged. Blocking
+# sockets never hit this path — their flow control is the blocking
+# send itself, exactly as before.
+_SEND_STALL_S = 20.0
 
-def _sendmsg_all(sock: socket.socket, parts: Sequence) -> None:
+
+def _sendmsg_all(
+    sock: socket.socket,
+    parts: Sequence,
+    *,
+    stall_timeout_s: float = _SEND_STALL_S,
+) -> None:
     """``sendall`` semantics over a scatter-gather buffer list.
 
     Uses vectored ``sendmsg`` so array payloads go from the caller's
     memory to the kernel with no intermediate ``b"".join`` copy;
     resumes correctly after partial sends. Falls back to ``sendall``
-    where ``sendmsg`` is unavailable."""
+    where ``sendmsg`` is unavailable.
+
+    On a non-blocking socket a full send buffer surfaces as
+    ``BlockingIOError``: wait for writability (bounded by
+    ``stall_timeout_s`` of NO progress — the deadline re-arms on every
+    partial send) instead of spinning; expiry raises
+    ``ConnectionError`` so the caller recycles the peer."""
     if not hasattr(sock, "sendmsg"):
         sock.sendall(b"".join(parts))
         return
     bufs = [memoryview(p) for p in parts if len(p)]
     idx = 0
+    deadline = None
     while idx < len(bufs):
-        sent = sock.sendmsg(bufs[idx : idx + _SENDMSG_MAX_BUFFERS])
+        try:
+            sent = sock.sendmsg(bufs[idx : idx + _SENDMSG_MAX_BUFFERS])
+        except BlockingIOError:
+            now = time.monotonic()
+            if deadline is None:
+                deadline = now + stall_timeout_s
+            elif now >= deadline:
+                raise ConnectionError(
+                    f"send stalled for {stall_timeout_s:.1f}s "
+                    f"(peer not draining)"
+                )
+            select.select([], [sock], [], max(0.0, deadline - now))
+            continue
+        deadline = None
         while sent:
             b = bufs[idx]
             if sent >= len(b):
@@ -402,6 +434,119 @@ def send_msg(
     _sendmsg_all(sock, frame_views(kind, tag, arrays, crcs))
 
 
+# Sentinel yielded by ``_frame_parser`` in place of a destination view
+# when the frame is being SHED at the header (tenant over budget): the
+# driver must consume exactly ``need`` payload bytes off the stream
+# and throw them away — nothing is allocated or retained.
+_DISCARD = object()
+
+
+def _frame_parser(
+    *,
+    max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+    alloc: Callable[[int], np.ndarray] | None = None,
+    shed_probe: Callable[[int, int, int], bool] | None = None,
+):
+    """Incremental frame parser: ONE generator holds every validation
+    rule (magic, structural limits, per-frame byte budget, shape/dtype
+    consistency, per-leaf CRC-32), and both transports drive it — the
+    blocking path (``recv_msg``) feeds it with exact reads, the
+    reactor feeds it whatever bytes epoll delivered — so the two
+    ``server_io_mode``s share the hardening byte for byte.
+
+    Protocol: yields ``(need, view)`` requests. ``view is None`` asks
+    the driver to ``send`` back exactly ``need`` bytes; a memoryview
+    asks the driver to fill it completely (zero-copy payload ingest)
+    and ``send(None)``; ``_DISCARD`` asks it to consume and drop
+    ``need`` bytes (header-time shedding — see ``shed_probe``).
+    Returns ``(kind, tag, arrays, payload_bytes)`` via StopIteration;
+    ``arrays`` is None for a shed frame.
+
+    ``shed_probe(kind, tag, n_arrays)`` (optional) runs the moment the
+    frame header parses: True puts the frame in discard mode — every
+    array header is still validated identically (a hostile frame fails
+    the same way whether or not its tenant is over budget), but
+    payloads are never buffered and the CRC pass is skipped (the data
+    is going nowhere — not paying the checksum is the point of
+    shedding early)."""
+    magic, kind, tag, n = _HEADER.unpack((yield (_HEADER.size, None)))
+    if magic != MAGIC:
+        raise ConnectionError(f"bad frame magic {magic!r}")
+    if n > MAX_ARRAYS_PER_FRAME:
+        raise ConnectionError(
+            f"frame claims {n} arrays (limit {MAX_ARRAYS_PER_FRAME}) — "
+            f"corrupt header"
+        )
+    shed = shed_probe is not None and shed_probe(kind, tag, n)
+    budget = max_frame_bytes
+    total = 0
+    arrays: List[np.ndarray] | None = None if shed else []
+    for _ in range(n):
+        (dtype_len,) = _ARRAY_HEADER.unpack((yield (1, None)))
+        if dtype_len > MAX_DTYPE_LEN:
+            raise ConnectionError(
+                f"frame dtype string of {dtype_len} bytes — corrupt header"
+            )
+        try:
+            dtype = np.dtype(bytes((yield (dtype_len, None))).decode())
+        except (UnicodeDecodeError, TypeError, ValueError) as e:
+            raise ConnectionError(f"bad dtype in frame: {e}") from e
+        (ndim,) = struct_lib.unpack(">B", (yield (1, None)))
+        if ndim > MAX_NDIM:
+            raise ConnectionError(
+                f"frame array of rank {ndim} (limit {MAX_NDIM}) — "
+                f"corrupt header"
+            )
+        shape = struct_lib.unpack(f">{ndim}Q", (yield (8 * ndim, None)))
+        (nbytes,) = struct_lib.unpack(">Q", (yield (8, None)))
+        if nbytes > budget:
+            raise ConnectionError(
+                f"frame array of {nbytes} bytes exceeds the remaining "
+                f"{budget}-byte frame budget (max_frame_bytes="
+                f"{max_frame_bytes}) — corrupt or hostile header"
+            )
+        expected = math.prod(shape) * dtype.itemsize
+        if expected != nbytes:
+            raise ConnectionError(
+                f"frame array header inconsistent: shape {shape} x dtype "
+                f"{dtype.str} implies {expected} bytes, header claims "
+                f"{nbytes}"
+            )
+        budget -= nbytes
+        total += nbytes
+        (crc_want,) = struct_lib.unpack(">I", (yield (4, None)))
+        if shed:
+            if nbytes:
+                yield (nbytes, _DISCARD)
+            continue
+        buf = (
+            alloc(nbytes) if alloc is not None
+            else np.empty(nbytes, dtype=np.uint8)
+        )
+        payload = memoryview(buf).cast("B")[:nbytes]
+        if nbytes:
+            yield (nbytes, payload)
+        crc_got = zlib.crc32(payload) if nbytes else zlib.crc32(b"")
+        if crc_got != crc_want:
+            # Valid framing, rotten data: in-flight corruption. Fail the
+            # connection (the stream's integrity is no longer trusted);
+            # the resilient client reconnects and re-pushes.
+            raise ChecksumError(
+                f"frame array checksum mismatch (crc32 {crc_got:#010x} != "
+                f"header {crc_want:#010x}, {nbytes} bytes) — payload "
+                f"corrupted in flight"
+            )
+        try:
+            arrays.append(buf[:nbytes].view(dtype).reshape(shape))
+        except (ValueError, TypeError) as e:
+            raise ConnectionError(f"undecodable frame array: {e}") from e
+    return kind, tag, arrays, total
+
+
+# Blocking-path scratch size for draining shed payloads.
+_DRAIN_CHUNK = 65536
+
+
 def recv_msg(
     sock: socket.socket,
     *,
@@ -418,72 +563,31 @@ def recv_msg(
     supplies the backing byte buffer (a writable C-contiguous uint8
     ndarray, e.g. an arena slice) instead of a fresh allocation; it is
     only ever called with header-validated sizes within the frame
-    budget."""
-    magic, kind, tag, n = _HEADER.unpack(_recv_exact(sock, _HEADER.size))
-    if magic != MAGIC:
-        raise ConnectionError(f"bad frame magic {magic!r}")
-    if n > MAX_ARRAYS_PER_FRAME:
-        raise ConnectionError(
-            f"frame claims {n} arrays (limit {MAX_ARRAYS_PER_FRAME}) — "
-            f"corrupt header"
-        )
-    budget = max_frame_bytes
-    arrays = []
-    for _ in range(n):
-        (dtype_len,) = _ARRAY_HEADER.unpack(_recv_exact(sock, 1))
-        if dtype_len > MAX_DTYPE_LEN:
-            raise ConnectionError(
-                f"frame dtype string of {dtype_len} bytes — corrupt header"
-            )
-        try:
-            dtype = np.dtype(_recv_exact(sock, dtype_len).decode())
-        except (UnicodeDecodeError, TypeError, ValueError) as e:
-            raise ConnectionError(f"bad dtype in frame: {e}") from e
-        (ndim,) = struct_lib.unpack(">B", _recv_exact(sock, 1))
-        if ndim > MAX_NDIM:
-            raise ConnectionError(
-                f"frame array of rank {ndim} (limit {MAX_NDIM}) — "
-                f"corrupt header"
-            )
-        shape = struct_lib.unpack(f">{ndim}Q", _recv_exact(sock, 8 * ndim))
-        (nbytes,) = struct_lib.unpack(">Q", _recv_exact(sock, 8))
-        if nbytes > budget:
-            raise ConnectionError(
-                f"frame array of {nbytes} bytes exceeds the remaining "
-                f"{budget}-byte frame budget (max_frame_bytes="
-                f"{max_frame_bytes}) — corrupt or hostile header"
-            )
-        expected = math.prod(shape) * dtype.itemsize
-        if expected != nbytes:
-            raise ConnectionError(
-                f"frame array header inconsistent: shape {shape} x dtype "
-                f"{dtype.str} implies {expected} bytes, header claims "
-                f"{nbytes}"
-            )
-        budget -= nbytes
-        (crc_want,) = struct_lib.unpack(">I", _recv_exact(sock, 4))
-        buf = (
-            alloc(nbytes) if alloc is not None
-            else np.empty(nbytes, dtype=np.uint8)
-        )
-        payload = memoryview(buf).cast("B")[:nbytes]
-        if nbytes:
-            _recv_exact_into(sock, payload)
-        crc_got = zlib.crc32(payload) if nbytes else zlib.crc32(b"")
-        if crc_got != crc_want:
-            # Valid framing, rotten data: in-flight corruption. Fail the
-            # connection (the stream's integrity is no longer trusted);
-            # the resilient client reconnects and re-pushes.
-            raise ChecksumError(
-                f"frame array checksum mismatch (crc32 {crc_got:#010x} != "
-                f"header {crc_want:#010x}, {nbytes} bytes) — payload "
-                f"corrupted in flight"
-            )
-        try:
-            arrays.append(buf[:nbytes].view(dtype).reshape(shape))
-        except (ValueError, TypeError) as e:
-            raise ConnectionError(f"undecodable frame array: {e}") from e
-    return kind, tag, arrays
+    budget.
+
+    The validation itself lives in ``_frame_parser`` (shared with the
+    reactor's incremental reassembly); this is the blocking driver."""
+    gen = _frame_parser(max_frame_bytes=max_frame_bytes, alloc=alloc)
+    try:
+        need, view = gen.send(None)
+        while True:
+            if view is None:
+                need, view = gen.send(_recv_exact(sock, need))
+            elif view is _DISCARD:
+                scratch = bytearray(min(need, _DRAIN_CHUNK))
+                left = need
+                while left:
+                    r = sock.recv_into(scratch, min(left, len(scratch)))
+                    if r == 0:
+                        raise ConnectionError("peer closed mid-frame")
+                    left -= r
+                need, view = gen.send(None)
+            else:
+                _recv_exact_into(sock, view)
+                need, view = gen.send(None)
+    except StopIteration as stop:
+        kind, tag, arrays, _ = stop.value
+        return kind, tag, arrays
 
 
 def _set_nodelay(sock: socket.socket) -> None:
@@ -541,9 +645,134 @@ class _Conn:
     epoch: int = 0
     # Tenant id (6th hello field; absent = 0 = default tenant).
     tenant: int = 0
+    # Reactor-mode incremental reassembly state (``_RxState``); None in
+    # threads mode, where the connection's own thread blocks in
+    # ``recv_msg`` instead.
+    rx: object = None
     send_lock: threading.Lock = dataclasses.field(
         default_factory=threading.Lock
     )
+
+
+class _GracefulClose(Exception):
+    """Internal unwind signal: a KIND_CLOSE was dispatched mid-pump, so
+    stop parsing this connection's stream (any bytes after the goodbye
+    are the peer's close-race artifacts, exactly the frames the threads
+    mode never reads after its ``break``)."""
+
+
+# Reactor read size: one recv per readiness event covers many small
+# header fields (the threads path pays one syscall per field), and
+# payloads at least this large go straight into the destination array
+# (the zero-copy ingest path recv_msg uses).
+_RX_CHUNK = 262144
+
+
+class _RxState:
+    """Per-connection incremental frame reassembly (reactor mode).
+
+    Owns one ``_frame_parser`` generator plus the progress of its
+    current byte request; ``pump`` feeds it whatever the non-blocking
+    socket has ready and dispatches each completed frame. All
+    validation lives in the parser — shared with the blocking path —
+    so a hostile frame fails identically in both ``server_io_mode``s.
+    """
+
+    __slots__ = (
+        "_factory", "gen", "need", "view", "got", "head", "buf", "pos",
+        "last_byte",
+    )
+
+    def __init__(self, factory):
+        self._factory = factory
+        self.head = bytearray()
+        self.buf = b""
+        self.pos = 0
+        self.last_byte = time.monotonic()
+        self._begin()
+
+    def _begin(self) -> None:
+        self.gen = self._factory()
+        self.need, self.view = self.gen.send(None)
+        self.got = 0
+
+    def _step(self, data):
+        """Feed one completed byte request; returns the finished frame
+        tuple when the parser ran to completion, else None."""
+        try:
+            self.need, self.view = self.gen.send(data)
+            self.got = 0
+            return None
+        except StopIteration as stop:
+            frame = stop.value
+            self._begin()
+            return frame
+
+    def pump(self, sock: socket.socket, on_frame) -> None:
+        """Drain readable bytes into the parser. Calls ``on_frame(kind,
+        tag, arrays, nbytes)`` per completed frame; returns when the
+        socket would block; raises ``ConnectionError`` on EOF (the same
+        "peer closed mid-frame" the blocking path raises) and whatever
+        the parser raises on hostile bytes."""
+        while True:
+            done = False
+            data = None
+            avail = len(self.buf) - self.pos
+            if self.view is None:
+                take = min(self.need - len(self.head), avail)
+                if take:
+                    self.head += self.buf[self.pos : self.pos + take]
+                    self.pos += take
+                if len(self.head) == self.need:
+                    data = bytes(self.head)
+                    self.head = bytearray()
+                    done = True
+            elif self.view is _DISCARD:
+                take = min(self.need - self.got, avail)
+                self.pos += take
+                self.got += take
+                done = self.got == self.need
+            else:
+                take = min(self.need - self.got, avail)
+                if take:
+                    self.view[self.got : self.got + take] = (
+                        self.buf[self.pos : self.pos + take]
+                    )
+                    self.pos += take
+                    self.got += take
+                done = self.got == self.need
+            if done:
+                frame = self._step(data)
+                if frame is not None:
+                    on_frame(*frame)
+                continue
+            # Request still short and the buffer is dry: read more.
+            left = self.need - self.got
+            if (
+                self.view is not None
+                and self.view is not _DISCARD
+                and left >= _RX_CHUNK
+            ):
+                # Bulk payload: receive straight into the destination
+                # array's memory, no intermediate buffer.
+                try:
+                    r = sock.recv_into(self.view[self.got :], left)
+                except BlockingIOError:
+                    return
+                if r == 0:
+                    raise ConnectionError("peer closed mid-frame")
+                self.last_byte = time.monotonic()
+                self.got += r
+                continue
+            try:
+                chunk = sock.recv(_RX_CHUNK)
+            except BlockingIOError:
+                return
+            if not chunk:
+                raise ConnectionError("peer closed mid-frame")
+            self.last_byte = time.monotonic()
+            self.buf = chunk
+            self.pos = 0
 
 
 class LearnerServer:
@@ -587,13 +816,30 @@ class LearnerServer:
         param_bf16: bool = False,
         epoch: int = 0,
         tenant: int = 0,
+        server_io_mode: str = "reactor",
         log: Callable[[str], None] | None = None,
     ):
+        if server_io_mode not in ("reactor", "threads"):
+            raise ValueError(
+                f"server_io_mode must be 'reactor' or 'threads', got "
+                f"{server_io_mode!r}"
+            )
+        # I/O plane shape: "reactor" (default) runs ONE selector-driven
+        # event loop for accept + every connection's incremental frame
+        # reassembly — O(1) threads in fleet size; "threads" is the
+        # legacy thread-per-connection blocking path (wire- and
+        # fixed-seed bit-identical: both feed the same _frame_parser
+        # and the same _dispatch_frame).
+        self._io_mode = server_io_mode
         self._sink = self._make_sink(on_trajectory)
         # Central-inference handler (distributed.serving): when set,
         # KIND_OBS_REQ frames are routed to it instead of being a
         # protocol error. handler(peer, seq, arrays, coded, reply).
         self._inference = None
+        # Optional batched wake for the serving tick (reactor mode): an
+        # OBS_REQ burst drained in one readiness pass triggers ONE
+        # wake() instead of one condition-variable notify per request.
+        self._inference_wake = None
         # Prioritized-replay handler (distributed.replay): when set,
         # KIND_SAMPLE_REQ / KIND_PRIO_UPDATE frames are routed to it
         # instead of being a protocol error.
@@ -621,6 +867,11 @@ class LearnerServer:
         # trajectory sink; False sheds the frame at ingress (ACKed,
         # never decoded or queued) — the multi-tenant metering gate.
         self._admission = None
+        # Header-time shed probe (reactor mode): ``probe(peer) -> True``
+        # marks the peer's tenant over budget BEFORE a TRAJ frame's
+        # body is buffered, so a flooding job's payload bytes are
+        # drained to scratch instead of allocated.
+        self._admission_probe = None
         self._idle_timeout = idle_timeout_s
         # Param wire codec (distributed.codec): keep a small ring of
         # recent published versions' wire leaves and serve an XOR-delta
@@ -723,13 +974,44 @@ class LearnerServer:
         self._param_delta_sends = 0
         self._param_bytes_out = 0
         self._notifies_sent = 0
+        # Reactor accounting: event-loop wakeups (0 in threads mode)
+        # and the deferred serving-tick wake flag (set by OBS_REQ
+        # dispatch, consumed once per readiness pass).
+        self._reactor_wakeups = 0
+        self._obs_pending_wake = False
         self._listener = socket.create_server((host, port))
-        self._listener.settimeout(0.2)
         self.port = self._listener.getsockname()[1]
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, name="learner-server-accept", daemon=True
-        )
-        self._accept_thread.start()
+        if server_io_mode == "reactor":
+            # One selector drives accept + every connection: the
+            # listener is non-blocking (no 0.2 s accept poll), and a
+            # socketpair self-pipe lets close() wake the loop from a
+            # foreign thread.
+            self._listener.setblocking(False)
+            self._selector = selectors.DefaultSelector()
+            self._selector.register(
+                self._listener, selectors.EVENT_READ, "accept"
+            )
+            self._wake_r, self._wake_w = socket.socketpair()
+            self._wake_r.setblocking(False)
+            self._wake_w.setblocking(False)
+            self._selector.register(
+                self._wake_r, selectors.EVENT_READ, "wake"
+            )
+            self._io_thread = threading.Thread(
+                target=self._reactor_loop,
+                name="learner-server-reactor", daemon=True,
+            )
+        else:
+            self._listener.settimeout(0.2)
+            self._selector = None
+            self._io_thread = threading.Thread(
+                target=self._accept_loop,
+                name="learner-server-accept", daemon=True,
+            )
+        # Legacy alias: ``alive`` and close() track the I/O thread
+        # under the name the pre-reactor call sites knew.
+        self._accept_thread = self._io_thread
+        self._io_thread.start()
 
     @staticmethod
     def _make_sink(on_trajectory):
@@ -751,7 +1033,7 @@ class LearnerServer:
         in flight land on whichever sink they raced."""
         self._sink = self._make_sink(on_trajectory)
 
-    def set_inference_handler(self, handler) -> None:
+    def set_inference_handler(self, handler, *, batch_wake=None) -> None:
         """Install the central-inference request handler
         (``distributed.serving.InferenceServer.submit``). Called as
         ``handler(peer, seq, arrays, coded, reply)`` on the
@@ -760,8 +1042,15 @@ class LearnerServer:
         batching tick replies asynchronously) and returns False if the
         connection is already gone. Without a handler, a
         ``KIND_OBS_REQ`` is a protocol error (a shim actor pointed at
-        a non-serving learner fails loudly instead of hanging)."""
+        a non-serving learner fails loudly instead of hanging).
+
+        ``batch_wake`` (reactor mode, with the serving tier's deferred
+        wakes — ``InferenceServer.set_wake_batching``): called ONCE
+        after any readiness pass that dispatched at least one OBS_REQ,
+        so a burst of N requests costs one condition-variable notify
+        instead of N."""
         self._inference = handler
+        self._inference_wake = batch_wake
 
     def set_replay_handler(self, handler) -> None:
         """Install the prioritized-replay request handler
@@ -800,7 +1089,7 @@ class LearnerServer:
         polling forever."""
         self._delivery = handler
 
-    def set_admission_handler(self, handler) -> None:
+    def set_admission_handler(self, handler, *, probe=None) -> None:
         """Install the tenant-admission gate
         (``distributed.tenancy.TenantAdmission.admit_frame``). Called
         as ``handler(peer, nbytes) -> bool`` on the connection's
@@ -808,8 +1097,19 @@ class LearnerServer:
         False sheds the frame at ingress (still ACKed — re-pushing an
         over-budget frame only floods harder) and counts it under
         ``transport_shed_frames``. None (the default) admits
-        everything — the single-tenant fleet pays nothing."""
+        everything — the single-tenant fleet pays nothing.
+
+        ``probe(peer) -> bool`` (optional, reactor mode —
+        ``TenantAdmission.over_budget``) is the HEADER-TIME peek: True
+        the moment a TRAJ frame's header parses puts the frame in
+        discard mode — array headers still validate identically, but
+        the body is drained to scratch instead of buffered, so an
+        over-budget tenant's flood never allocates. The frame-end
+        ``handler`` still runs for such frames (metering attribution);
+        without a probe, shedding happens at frame end only — exactly
+        the threads-mode (and pre-reactor) semantics."""
         self._admission = handler
+        self._admission_probe = probe
 
     def set_goodbye_handler(self, handler) -> None:
         """Install a hook called with a peer's ``PeerInfo`` when it
@@ -1042,6 +1342,17 @@ class LearnerServer:
                     self._param_bytes_out / 1e6, 6
                 ),
                 "transport_notifies_sent": self._notifies_sent,
+                # I/O plane shape: how many threads this server spends
+                # on socket I/O (reactor: ONE, O(1) in fleet size;
+                # threads: accept + one per live connection) and how
+                # many times the event loop woke (0 in threads mode).
+                "transport_io_threads": (
+                    1 if self._io_mode == "reactor"
+                    else 1 + sum(
+                        1 for t in self._conn_threads if t.is_alive()
+                    )
+                ),
+                "transport_reactor_wakeups": self._reactor_wakeups,
             }
 
     def connections(self) -> List[dict]:
@@ -1100,6 +1411,198 @@ class LearnerServer:
             ]
             self._conn_threads.append(t)
         self._listener.close()
+
+    # --- reactor mode -------------------------------------------------
+
+    def _wake_loop(self) -> None:
+        """Nudge the reactor from a foreign thread (close() needs the
+        loop to notice ``_stopping``/``_closing`` without waiting out
+        its select timeout). Best-effort: a full pipe means a wake is
+        already pending."""
+        try:
+            self._wake_w.send(b"\0")
+        except (BlockingIOError, OSError):
+            pass
+
+    def _make_shed_probe(self, c: _Conn):
+        """Header-time admission peek for ``c``'s frame parser: only
+        TRAJ kinds are ever shed, and only when the installed probe
+        says the peer's tenant is over budget RIGHT NOW. Fails open —
+        a broken probe admits (the frame-end gate still meters)."""
+        def probe(kind: int, tag: int, n_arrays: int) -> bool:
+            if kind not in (KIND_TRAJ, KIND_TRAJ_CODED):
+                return False
+            over = self._admission_probe
+            if over is None:
+                return False
+            with self._reg_lock:
+                peer = PeerInfo(
+                    c.cid, c.actor_id, c.generation, c.role,
+                    c.caps, c.epoch, c.tenant,
+                )
+            try:
+                return bool(over(peer))
+            except Exception:
+                return False
+        return probe
+
+    def _reactor_accept(self) -> None:
+        """Drain the non-blocking listener: register every pending
+        connection with the selector (no per-connection thread)."""
+        while True:
+            try:
+                conn, addr = self._listener.accept()
+            except (BlockingIOError, OSError):
+                return
+            _set_nodelay(conn)
+            conn.setblocking(False)
+            with self._reg_lock:
+                cid = self._next_cid
+                self._next_cid += 1
+                self._accepts += 1
+                now = time.monotonic()
+                c = _Conn(
+                    cid=cid, sock=conn, addr=f"{addr[0]}:{addr[1]}",
+                    connected_at=now, last_recv=now,
+                )
+                self._conns[cid] = c
+            c.rx = _RxState(
+                lambda c=c: _frame_parser(
+                    max_frame_bytes=self._max_frame_bytes,
+                    shed_probe=self._make_shed_probe(c),
+                )
+            )
+            try:
+                self._selector.register(conn, selectors.EVENT_READ, c)
+            except (KeyError, ValueError, OSError):
+                self._reactor_retire(c, "disconnect")
+
+    def _reactor_retire(self, c: _Conn, reason: str) -> None:
+        """Unregister + retire + close — the reactor's analog of the
+        connection thread's ``finally`` block."""
+        try:
+            self._selector.unregister(c.sock)
+        except (KeyError, ValueError, OSError):
+            pass
+        self._retire(c, reason)
+        try:
+            c.sock.close()
+        except OSError:
+            pass
+
+    def _reactor_readable(self, c: _Conn) -> None:
+        """One readiness event on ``c``: pump everything the kernel
+        has into the connection's parser, dispatching each completed
+        frame. Error handling mirrors the threads-mode serve loop
+        exactly (same log lines, same counters, same retire reasons)."""
+        def on_frame(kind, tag, arrays, nbytes):
+            if not self._dispatch_frame(c, kind, tag, arrays, nbytes):
+                raise _GracefulClose()
+
+        try:
+            c.rx.pump(c.sock, on_frame)
+        except _GracefulClose:
+            self._reactor_retire(c, "graceful")
+        except ChecksumError as e:
+            with self._reg_lock:
+                self._checksum_failures += 1
+            if not self._stopping.is_set():
+                self._log(
+                    f"actor#{c.cid} ({c.addr}) payload corrupt: {e}; "
+                    f"recycling connection"
+                )
+            self._reactor_retire(c, "disconnect")
+        except (ConnectionError, OSError) as e:
+            if not self._stopping.is_set():
+                self._log(
+                    f"actor#{c.cid} ({c.addr}) lost: "
+                    f"{type(e).__name__}: {e}"
+                )
+            self._reactor_retire(c, "disconnect")
+
+    def _reactor_timeout(self) -> float | None:
+        """Selector timeout to the NEAREST idle deadline across live
+        connections (None = sleep until an fd or the wake pipe fires —
+        no deadline to track). Byte-level activity counts: a peer
+        trickling a large frame is not idle, matching the threads
+        mode's per-recv timeout."""
+        if self._idle_timeout is None:
+            return None
+        now = time.monotonic()
+        with self._reg_lock:
+            if not self._conns:
+                return None
+            nearest = min(
+                max(c.last_recv, c.rx.last_byte)
+                if c.rx is not None else c.last_recv
+                for c in self._conns.values()
+            )
+        return max(0.0, nearest + self._idle_timeout - now)
+
+    def _reactor_sweep_idle(self) -> None:
+        if self._idle_timeout is None or self._closing.is_set():
+            # During the graceful drain a quiet peer is not "idle" —
+            # it is reading the goodbye; close() force-closes momentarily
+            # (the threads mode's closing-timeout carve-out).
+            return
+        now = time.monotonic()
+        with self._reg_lock:
+            stale = [
+                c for c in self._conns.values()
+                if now - (
+                    max(c.last_recv, c.rx.last_byte)
+                    if c.rx is not None else c.last_recv
+                ) >= self._idle_timeout
+            ]
+        for c in stale:
+            self._log(
+                f"actor#{c.cid} ({c.addr}) silent for "
+                f"{self._idle_timeout:.0f}s; recycling connection"
+            )
+            self._reactor_retire(c, "idle")
+
+    def _reactor_loop(self) -> None:
+        """THE event loop: one thread drives accept, every connection's
+        frame reassembly + dispatch, idle deadlines, and the batched
+        serving-tick wake. Never blocks outside ``selector.select`` —
+        see analysis/lock_hygiene (LOCK003 covers reactor callbacks)."""
+        sel = self._selector
+        try:
+            while not self._stopping.is_set():
+                events = sel.select(self._reactor_timeout())
+                with self._reg_lock:
+                    self._reactor_wakeups += 1
+                for key, _mask in events:
+                    what = key.data
+                    if what == "wake":
+                        try:
+                            self._wake_r.recv(4096)
+                        except (BlockingIOError, OSError):
+                            pass
+                    elif what == "accept":
+                        self._reactor_accept()
+                    else:
+                        self._reactor_readable(what)
+                if self._obs_pending_wake:
+                    self._obs_pending_wake = False
+                    wake = self._inference_wake
+                    if wake is not None:
+                        wake()
+                self._reactor_sweep_idle()
+        finally:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            try:
+                sel.close()
+            except OSError:
+                pass
+            for s in (self._wake_r, self._wake_w):
+                try:
+                    s.close()
+                except OSError:
+                    pass
 
     def _send(
         self, c: _Conn, kind: int, tag: int = 0, arrays=(), crcs=None
@@ -1285,281 +1788,10 @@ class LearnerServer:
                         f"{self._idle_timeout:.0f}s; recycling connection"
                     )
                     break
-                with self._reg_lock:
-                    c.last_recv = time.monotonic()
-                    c.frames_in += 1
-                    self._frames_in += 1
-                    nbytes = sum(int(a.nbytes) for a in arrays)
-                    c.bytes_in += nbytes
-                    self._bytes_in += nbytes
-                    if kind in (KIND_TRAJ, KIND_TRAJ_CODED):
-                        c.trajectories += 1
-                        self._trajectories += 1
-                        self._traj_bytes_in += nbytes
-                        if kind == KIND_TRAJ_CODED:
-                            self._traj_coded_frames += 1
-                            self._traj_coded_bytes_in += nbytes
-                        else:
-                            self._traj_plain_frames += 1
-                    elif kind == KIND_PING:
-                        self._pings += 1
-                if kind in (KIND_TRAJ, KIND_TRAJ_CODED):
-                    if kind == KIND_TRAJ_CODED:
-                        # Coded frame: [meta] + tag coded trajectory
-                        # leaves + episode-info leaves. The payload
-                        # stays COMPRESSED here — CRC already verified
-                        # the coded bytes in recv_msg, and the decode
-                        # happens exactly once, downstream, where the
-                        # destination arena slot is known. The sink
-                        # receives a CodedTrajectory in place of the
-                        # leaf list (hello provenance attached: the
-                        # validator runs post-decode).
-                        if len(arrays) < 1 + tag:
-                            raise ConnectionError(
-                                f"coded trajectory frame carries "
-                                f"{len(arrays)} arrays, tag claims "
-                                f"{tag} coded leaves"
-                            )
-                        traj = codec.CodedTrajectory(
-                            arrays[: 1 + tag], actor_id=c.actor_id
-                        )
-                        ep = arrays[1 + tag:]
-                    else:
-                        traj, ep = arrays[:tag], arrays[tag:]
-                    on_trajectory, pass_peer = self._sink
-                    with self._reg_lock:
-                        peer = PeerInfo(
-                            c.cid, c.actor_id, c.generation, c.role,
-                            c.caps, c.epoch, c.tenant,
-                        )
-                    admission = self._admission
-                    if admission is not None and not admission(
-                        peer, nbytes
-                    ):
-                        # Over-budget tenant: the frame is SHED at
-                        # ingress — never decoded, validated, or
-                        # queued, so one flooding job cannot starve
-                        # the others. Still ACK (an unacked frame
-                        # would just be re-pushed, and re-pushing an
-                        # over-budget frame only floods harder); the
-                        # per-tenant attribution lives in the
-                        # admission controller's tenant_* counters.
-                        with self._reg_lock:
-                            self._shed_frames += 1
-                        self._send(c, KIND_ACK, self._version)
-                        continue
-                    if pass_peer:
-                        ok = on_trajectory(traj, ep, peer)
-                    else:
-                        ok = on_trajectory(traj, ep)
-                    if ok is False:
-                        with self._reg_lock:
-                            c.rejected += 1
-                            self._rejected += 1
-                    self._send(c, KIND_ACK, self._version)
-                elif kind == KIND_OBS_REQ:
-                    handler = self._inference
-                    if handler is None:
-                        # A shim actor pointed at a learner that is
-                        # not serving inference: fail the connection
-                        # loudly (the actor's retries surface it in
-                        # its stderr) instead of letting it block on
-                        # a reply that will never come.
-                        raise ConnectionError(
-                            "KIND_OBS_REQ but central inference is "
-                            "not enabled on this learner "
-                            "(actor_mode mismatch?)"
-                        )
-                    coded = bool(tag & OBS_REQ_CODED)
-                    seq = int(tag & (OBS_REQ_CODED - 1))
-                    with self._reg_lock:
-                        self._obs_reqs += 1
-                        self._obs_bytes_in += nbytes
-                        peer = PeerInfo(
-                            c.cid, c.actor_id, c.generation, c.role,
-                            c.caps, c.epoch, c.tenant,
-                        )
-                    # Reply closure: the batching tick answers this
-                    # request asynchronously, on its own thread, via
-                    # the connection's send lock.
-                    handler(
-                        peer, seq, arrays, coded,
-                        lambda arrs, _c=c, _s=seq: self._reply_act(
-                            _c, _s, arrs
-                        ),
-                    )
-                elif kind in (KIND_SAMPLE_REQ, KIND_PRIO_UPDATE):
-                    handler = self._replay
-                    if handler is None:
-                        # A sample client pointed at a learner that is
-                        # not a replay server: fail the connection
-                        # loudly (the client's retries surface it)
-                        # instead of letting it block on a batch that
-                        # will never come.
-                        raise ConnectionError(
-                            "replay frame (kind "
-                            f"{kind}) but the prioritized-replay "
-                            "handler is not installed on this server"
-                        )
-                    with self._reg_lock:
-                        peer = PeerInfo(
-                            c.cid, c.actor_id, c.generation, c.role,
-                            c.caps, c.epoch, c.tenant,
-                        )
-                        if kind == KIND_SAMPLE_REQ:
-                            self._sample_reqs += 1
-                        else:
-                            self._prio_updates += 1
-                    reply = (
-                        (
-                            lambda arrs, _c=c, _s=tag: self._reply_sample(
-                                _c, _s, arrs
-                            )
-                        )
-                        if kind == KIND_SAMPLE_REQ
-                        else None
-                    )
-                    handler(peer, kind, tag, arrays, reply)
-                elif kind == KIND_MEMBER_REQ:
-                    # Answered straight from the hello/generation
-                    # registry — no handler to install, every learner
-                    # can serve its membership view.
-                    with self._reg_lock:
-                        self._member_reqs += 1
-                        rows = np.asarray(
-                            [
-                                [
-                                    cc.actor_id, cc.generation,
-                                    cc.role, cc.caps, cc.epoch,
-                                ]
-                                for cc in self._conns.values()
-                            ],
-                            np.int64,
-                        ).reshape(-1, 5)
-                        meta = np.asarray(
-                            [self._hellos, self._epoch], np.int64
-                        )
-                    self._send(c, KIND_MEMBER_VIEW, tag, (rows, meta))
-                elif kind == KIND_RESHARD:
-                    handler = self._reshard
-                    if handler is None:
-                        # A replan aimed at a peer that cannot
-                        # re-point must fail loudly, not desync.
-                        raise ConnectionError(
-                            "reshard notice (kind "
-                            f"{kind}) but no reshard handler is "
-                            "installed on this server"
-                        )
-                    with self._reg_lock:
-                        self._reshards_in += 1
-                        peer = PeerInfo(
-                            c.cid, c.actor_id, c.generation, c.role,
-                            c.caps, c.epoch, c.tenant,
-                        )
-                    rmeta = (
-                        np.asarray(arrays[0], np.int64).reshape(-1)
-                        if arrays else np.zeros(2, np.int64)
-                    )
-                    plan_json = (
-                        bytes(
-                            np.asarray(arrays[1], np.uint8)
-                        ).decode("utf-8")
-                        if len(arrays) > 1 and arrays[1].size
-                        else ""
-                    )
-                    handler(
-                        peer, int(rmeta[0]), int(rmeta[1]), plan_json
-                    )
-                elif kind in (KIND_CANDIDATE, KIND_VERDICT):
-                    handler = self._delivery
-                    if handler is None:
-                        # An evaluator pointed at a learner with no
-                        # delivery plane must fail loudly, not poll a
-                        # candidate that will never come.
-                        raise ConnectionError(
-                            "delivery frame (kind "
-                            f"{kind}) but no delivery handler is "
-                            "installed on this server"
-                        )
-                    with self._reg_lock:
-                        peer = PeerInfo(
-                            c.cid, c.actor_id, c.generation, c.role,
-                            c.caps, c.epoch, c.tenant,
-                        )
-                        if kind == KIND_CANDIDATE:
-                            self._candidate_polls += 1
-                        else:
-                            self._verdicts_in += 1
-                    reply = (
-                        (
-                            lambda arrs, _c=c, _s=tag: (
-                                self._reply_candidate(_c, _s, arrs)
-                            )
-                        )
-                        if kind == KIND_CANDIDATE
-                        else None
-                    )
-                    handler(peer, kind, tag, arrays, reply)
-                elif kind == KIND_GET_PARAMS:
-                    # tag = the version the client already holds (0 =
-                    # none / legacy client): ring hit -> delta frame.
-                    self._send_params(c, held_version=tag)
-                elif kind == KIND_PING:
-                    # The reply carries this learner's fencing epoch in
-                    # the tag's high bits (low bits echo the ping tag):
-                    # a standby's monitor learns the reign it would
-                    # succeed from the same heartbeats that prove
-                    # liveness. Legacy clients ignore pong tags.
-                    self._send(
-                        c, KIND_PONG,
-                        self._tenant_bits
-                        | (self._epoch << EPOCH_SHIFT)
-                        | (tag & _EPOCH_SEQ_MASK),
-                    )
-                elif kind == KIND_HELLO:
-                    # Identity announcement: [actor_id, generation,
-                    # role, caps, epoch, tenant] — the trailing fields
-                    # are optional so a legacy 3-/4-/5-field hello
-                    # parses unchanged with caps/epoch/tenant 0 (the
-                    # default single-job tenant).
-                    # One-way (no reply) so the client never blocks on it.
-                    ident = (
-                        np.asarray(arrays[0]).reshape(-1)
-                        if arrays else np.empty(0, np.int64)
-                    )
-                    with self._reg_lock:
-                        if ident.size >= 1:
-                            c.actor_id = int(ident[0])
-                        if ident.size >= 2:
-                            c.generation = int(ident[1])
-                        if ident.size >= 3:
-                            c.role = int(ident[2])
-                        if ident.size >= 4:
-                            c.caps = int(ident[3])
-                        if ident.size >= 5:
-                            c.epoch = int(ident[4])
-                        if ident.size >= 6:
-                            c.tenant = int(ident[5])
-                        self._hellos += 1
-                elif kind == KIND_CLOSE:
+                nbytes = sum(int(a.nbytes) for a in arrays)
+                if not self._dispatch_frame(c, kind, tag, arrays, nbytes):
                     reason = "graceful"
-                    goodbye = self._goodbye
-                    if goodbye is not None:
-                        with self._reg_lock:
-                            peer = PeerInfo(
-                                c.cid, c.actor_id, c.generation,
-                                c.role, c.caps, c.epoch, c.tenant,
-                            )
-                        try:
-                            goodbye(peer)
-                        except Exception as e:
-                            self._log(
-                                f"goodbye handler failed for actor#"
-                                f"{c.cid}: {type(e).__name__}: {e}"
-                            )
                     break
-                else:
-                    raise ConnectionError(f"unknown frame kind {kind}")
         except ChecksumError as e:
             with self._reg_lock:
                 self._checksum_failures += 1
@@ -1581,6 +1813,313 @@ class LearnerServer:
         finally:
             self._retire(c, reason)
             conn.close()
+
+    def _dispatch_frame(
+        self, c: _Conn, kind: int, tag: int, arrays, nbytes: int
+    ) -> bool:
+        """Account for + route ONE complete frame — the single dispatch
+        path both I/O modes share (the threads serve loop and the
+        reactor pump both land here), so kind semantics cannot drift
+        between them. Returns False for an orderly ``KIND_CLOSE`` (the
+        caller retires the connection as "graceful"); protocol errors
+        raise ``ConnectionError`` exactly as before. ``arrays`` is
+        None only for a TRAJ frame the reactor shed at header time
+        (see ``set_admission_handler``'s probe)."""
+        with self._reg_lock:
+            c.last_recv = time.monotonic()
+            c.frames_in += 1
+            self._frames_in += 1
+            c.bytes_in += nbytes
+            self._bytes_in += nbytes
+            if kind in (KIND_TRAJ, KIND_TRAJ_CODED):
+                c.trajectories += 1
+                self._trajectories += 1
+                self._traj_bytes_in += nbytes
+                if kind == KIND_TRAJ_CODED:
+                    self._traj_coded_frames += 1
+                    self._traj_coded_bytes_in += nbytes
+                else:
+                    self._traj_plain_frames += 1
+            elif kind == KIND_PING:
+                self._pings += 1
+        if kind in (KIND_TRAJ, KIND_TRAJ_CODED):
+            if arrays is None:
+                # Shed at HEADER time by the admission probe (reactor
+                # mode): the body was drained to scratch, never
+                # buffered. The frame-end admission handler still runs
+                # so the per-tenant metering counters agree with the
+                # frame-end shed path; the ACK is identical too.
+                admission = self._admission
+                if admission is not None:
+                    with self._reg_lock:
+                        peer = PeerInfo(
+                            c.cid, c.actor_id, c.generation, c.role,
+                            c.caps, c.epoch, c.tenant,
+                        )
+                    admission(peer, nbytes)
+                with self._reg_lock:
+                    self._shed_frames += 1
+                self._send(c, KIND_ACK, self._version)
+                return True
+            if kind == KIND_TRAJ_CODED:
+                # Coded frame: [meta] + tag coded trajectory
+                # leaves + episode-info leaves. The payload
+                # stays COMPRESSED here — CRC already verified
+                # the coded bytes in recv_msg, and the decode
+                # happens exactly once, downstream, where the
+                # destination arena slot is known. The sink
+                # receives a CodedTrajectory in place of the
+                # leaf list (hello provenance attached: the
+                # validator runs post-decode).
+                if len(arrays) < 1 + tag:
+                    raise ConnectionError(
+                        f"coded trajectory frame carries "
+                        f"{len(arrays)} arrays, tag claims "
+                        f"{tag} coded leaves"
+                    )
+                traj = codec.CodedTrajectory(
+                    arrays[: 1 + tag], actor_id=c.actor_id
+                )
+                ep = arrays[1 + tag:]
+            else:
+                traj, ep = arrays[:tag], arrays[tag:]
+            on_trajectory, pass_peer = self._sink
+            with self._reg_lock:
+                peer = PeerInfo(
+                    c.cid, c.actor_id, c.generation, c.role,
+                    c.caps, c.epoch, c.tenant,
+                )
+            admission = self._admission
+            if admission is not None and not admission(
+                peer, nbytes
+            ):
+                # Over-budget tenant: the frame is SHED at
+                # ingress — never decoded, validated, or
+                # queued, so one flooding job cannot starve
+                # the others. Still ACK (an unacked frame
+                # would just be re-pushed, and re-pushing an
+                # over-budget frame only floods harder); the
+                # per-tenant attribution lives in the
+                # admission controller's tenant_* counters.
+                with self._reg_lock:
+                    self._shed_frames += 1
+                self._send(c, KIND_ACK, self._version)
+                return True
+            if pass_peer:
+                ok = on_trajectory(traj, ep, peer)
+            else:
+                ok = on_trajectory(traj, ep)
+            if ok is False:
+                with self._reg_lock:
+                    c.rejected += 1
+                    self._rejected += 1
+            self._send(c, KIND_ACK, self._version)
+        elif kind == KIND_OBS_REQ:
+            handler = self._inference
+            if handler is None:
+                # A shim actor pointed at a learner that is
+                # not serving inference: fail the connection
+                # loudly (the actor's retries surface it in
+                # its stderr) instead of letting it block on
+                # a reply that will never come.
+                raise ConnectionError(
+                    "KIND_OBS_REQ but central inference is "
+                    "not enabled on this learner "
+                    "(actor_mode mismatch?)"
+                )
+            coded = bool(tag & OBS_REQ_CODED)
+            seq = int(tag & (OBS_REQ_CODED - 1))
+            # Reactor mode coalesces the serving tick's wake: one
+            # notify per readiness pass, not per request.
+            self._obs_pending_wake = True
+            with self._reg_lock:
+                self._obs_reqs += 1
+                self._obs_bytes_in += nbytes
+                peer = PeerInfo(
+                    c.cid, c.actor_id, c.generation, c.role,
+                    c.caps, c.epoch, c.tenant,
+                )
+            # Reply closure: the batching tick answers this
+            # request asynchronously, on its own thread, via
+            # the connection's send lock.
+            handler(
+                peer, seq, arrays, coded,
+                lambda arrs, _c=c, _s=seq: self._reply_act(
+                    _c, _s, arrs
+                ),
+            )
+        elif kind in (KIND_SAMPLE_REQ, KIND_PRIO_UPDATE):
+            handler = self._replay
+            if handler is None:
+                # A sample client pointed at a learner that is
+                # not a replay server: fail the connection
+                # loudly (the client's retries surface it)
+                # instead of letting it block on a batch that
+                # will never come.
+                raise ConnectionError(
+                    "replay frame (kind "
+                    f"{kind}) but the prioritized-replay "
+                    "handler is not installed on this server"
+                )
+            with self._reg_lock:
+                peer = PeerInfo(
+                    c.cid, c.actor_id, c.generation, c.role,
+                    c.caps, c.epoch, c.tenant,
+                )
+                if kind == KIND_SAMPLE_REQ:
+                    self._sample_reqs += 1
+                else:
+                    self._prio_updates += 1
+            reply = (
+                (
+                    lambda arrs, _c=c, _s=tag: self._reply_sample(
+                        _c, _s, arrs
+                    )
+                )
+                if kind == KIND_SAMPLE_REQ
+                else None
+            )
+            handler(peer, kind, tag, arrays, reply)
+        elif kind == KIND_MEMBER_REQ:
+            # Answered straight from the hello/generation
+            # registry — no handler to install, every learner
+            # can serve its membership view.
+            with self._reg_lock:
+                self._member_reqs += 1
+                rows = np.asarray(
+                    [
+                        [
+                            cc.actor_id, cc.generation,
+                            cc.role, cc.caps, cc.epoch,
+                        ]
+                        for cc in self._conns.values()
+                    ],
+                    np.int64,
+                ).reshape(-1, 5)
+                meta = np.asarray(
+                    [self._hellos, self._epoch], np.int64
+                )
+            self._send(c, KIND_MEMBER_VIEW, tag, (rows, meta))
+        elif kind == KIND_RESHARD:
+            handler = self._reshard
+            if handler is None:
+                # A replan aimed at a peer that cannot
+                # re-point must fail loudly, not desync.
+                raise ConnectionError(
+                    "reshard notice (kind "
+                    f"{kind}) but no reshard handler is "
+                    "installed on this server"
+                )
+            with self._reg_lock:
+                self._reshards_in += 1
+                peer = PeerInfo(
+                    c.cid, c.actor_id, c.generation, c.role,
+                    c.caps, c.epoch, c.tenant,
+                )
+            rmeta = (
+                np.asarray(arrays[0], np.int64).reshape(-1)
+                if arrays else np.zeros(2, np.int64)
+            )
+            plan_json = (
+                bytes(
+                    np.asarray(arrays[1], np.uint8)
+                ).decode("utf-8")
+                if len(arrays) > 1 and arrays[1].size
+                else ""
+            )
+            handler(
+                peer, int(rmeta[0]), int(rmeta[1]), plan_json
+            )
+        elif kind in (KIND_CANDIDATE, KIND_VERDICT):
+            handler = self._delivery
+            if handler is None:
+                # An evaluator pointed at a learner with no
+                # delivery plane must fail loudly, not poll a
+                # candidate that will never come.
+                raise ConnectionError(
+                    "delivery frame (kind "
+                    f"{kind}) but no delivery handler is "
+                    "installed on this server"
+                )
+            with self._reg_lock:
+                peer = PeerInfo(
+                    c.cid, c.actor_id, c.generation, c.role,
+                    c.caps, c.epoch, c.tenant,
+                )
+                if kind == KIND_CANDIDATE:
+                    self._candidate_polls += 1
+                else:
+                    self._verdicts_in += 1
+            reply = (
+                (
+                    lambda arrs, _c=c, _s=tag: (
+                        self._reply_candidate(_c, _s, arrs)
+                    )
+                )
+                if kind == KIND_CANDIDATE
+                else None
+            )
+            handler(peer, kind, tag, arrays, reply)
+        elif kind == KIND_GET_PARAMS:
+            # tag = the version the client already holds (0 =
+            # none / legacy client): ring hit -> delta frame.
+            self._send_params(c, held_version=tag)
+        elif kind == KIND_PING:
+            # The reply carries this learner's fencing epoch in
+            # the tag's high bits (low bits echo the ping tag):
+            # a standby's monitor learns the reign it would
+            # succeed from the same heartbeats that prove
+            # liveness. Legacy clients ignore pong tags.
+            self._send(
+                c, KIND_PONG,
+                self._tenant_bits
+                | (self._epoch << EPOCH_SHIFT)
+                | (tag & _EPOCH_SEQ_MASK),
+            )
+        elif kind == KIND_HELLO:
+            # Identity announcement: [actor_id, generation,
+            # role, caps, epoch, tenant] — the trailing fields
+            # are optional so a legacy 3-/4-/5-field hello
+            # parses unchanged with caps/epoch/tenant 0 (the
+            # default single-job tenant).
+            # One-way (no reply) so the client never blocks on it.
+            ident = (
+                np.asarray(arrays[0]).reshape(-1)
+                if arrays else np.empty(0, np.int64)
+            )
+            with self._reg_lock:
+                if ident.size >= 1:
+                    c.actor_id = int(ident[0])
+                if ident.size >= 2:
+                    c.generation = int(ident[1])
+                if ident.size >= 3:
+                    c.role = int(ident[2])
+                if ident.size >= 4:
+                    c.caps = int(ident[3])
+                if ident.size >= 5:
+                    c.epoch = int(ident[4])
+                if ident.size >= 6:
+                    c.tenant = int(ident[5])
+                self._hellos += 1
+        elif kind == KIND_CLOSE:
+            goodbye = self._goodbye
+            if goodbye is not None:
+                with self._reg_lock:
+                    peer = PeerInfo(
+                        c.cid, c.actor_id, c.generation,
+                        c.role, c.caps, c.epoch, c.tenant,
+                    )
+                try:
+                    goodbye(peer)
+                except Exception as e:
+                    self._log(
+                        f"goodbye handler failed for actor#"
+                        f"{c.cid}: {type(e).__name__}: {e}"
+                    )
+            return False
+        else:
+            raise ConnectionError(f"unknown frame kind {kind}")
+        return True
 
     def recycle_actor_connections(self) -> int:
         """Force every connected ROLE_ACTOR peer to reconnect (their
@@ -1643,18 +2182,31 @@ class LearnerServer:
             # force-closed moments later anyway).
             if c.send_lock.acquire(timeout=0.2):
                 try:
-                    c.sock.settimeout(0.2)
-                    send_msg(c.sock, KIND_CLOSE, self._version)
+                    if self._io_mode == "reactor":
+                        # NO settimeout here: it would flip the fd's
+                        # timeout mode under the reactor's non-blocking
+                        # recv path. The send bound comes from
+                        # _sendmsg_all's EAGAIN stall deadline instead.
+                        _sendmsg_all(
+                            c.sock,
+                            frame_views(KIND_CLOSE, self._version, ()),
+                            stall_timeout_s=0.2,
+                        )
+                    else:
+                        c.sock.settimeout(0.2)
+                        send_msg(c.sock, KIND_CLOSE, self._version)
                 except OSError:
                     pass
                 finally:
-                    try:
-                        c.sock.settimeout(
-                            self._idle_timeout
-                            if self._idle_timeout is not None else None
-                        )
-                    except OSError:
-                        pass
+                    if self._io_mode != "reactor":
+                        try:
+                            c.sock.settimeout(
+                                self._idle_timeout
+                                if self._idle_timeout is not None
+                                else None
+                            )
+                        except OSError:
+                            pass
                     c.send_lock.release()
 
     def close(self, *, graceful: bool = True, grace_s: float = 1.0) -> None:
@@ -1667,12 +2219,25 @@ class LearnerServer:
             self._closing.set()
             self._broadcast_close()
             deadline = time.monotonic() + grace_s
-            for t in self._conn_threads:
-                t.join(timeout=max(0.0, deadline - time.monotonic()))
+            if self._io_mode == "reactor":
+                # The drain is the LOOP's job (it keeps dispatching
+                # goodbyes); wait for the registry to empty instead of
+                # joining per-connection threads that don't exist.
+                self._wake_loop()
+                while time.monotonic() < deadline:
+                    with self._reg_lock:
+                        if not self._conns:
+                            break
+                    time.sleep(0.01)
+            else:
+                for t in self._conn_threads:
+                    t.join(timeout=max(0.0, deadline - time.monotonic()))
             # Anyone who connected mid-drain still gets a goodbye
             # before the force-close below.
             self._broadcast_close()
         self._stopping.set()
+        if self._io_mode == "reactor":
+            self._wake_loop()
         # Force-close whatever is left so peers (and the threads blocked
         # in recv on them) observe shutdown instead of hanging.
         with self._reg_lock:
@@ -1689,6 +2254,14 @@ class LearnerServer:
         self._accept_thread.join(timeout=2.0)
         for t in self._conn_threads:
             t.join(timeout=2.0)
+        if self._io_mode == "reactor":
+            # The loop is gone; retire whatever the force-close left in
+            # the registry (threads mode gets this from each connection
+            # thread's finally block as its recv faults).
+            with self._reg_lock:
+                leftover = list(self._conns.values())
+            for c in leftover:
+                self._retire(c, "disconnect")
 
 
 class ActorClient:

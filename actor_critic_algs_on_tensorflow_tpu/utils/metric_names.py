@@ -85,6 +85,11 @@ METRIC_NAMES: dict = {
                                "tenant admission handler (ACKed, "
                                "never decoded)",
     TRANSPORT + "handoffs_sent": "KIND_HANDOFF frames to standbys",
+    TRANSPORT + "io_threads": "threads serving receives (reactor: 1 "
+                              "loop regardless of fleet size; "
+                              "threads mode: accept + per-conn)",
+    TRANSPORT + "reactor_wakeups": "event-loop readiness passes "
+                                   "(reactor mode only)",
     TRANSPORT + "mb_out": "megabytes sent (all frames)",
     TRANSPORT + "param_sends": "param fetches served",
     TRANSPORT + "param_delta_sends": "param fetches served as deltas",
@@ -110,6 +115,9 @@ METRIC_NAMES: dict = {
     PIPELINE + "shard_batches_min": "min per-shard staged batches",
     # -- serve_*: InferenceServer.metrics() (distributed/serving.py)
     # + the serving bench ledger columns (scripts/serve_bench.py)
+    SERVE + "sweep": "BENCH_SERVE fleet-sweep payload section "
+                     "(reactor vs threads receive drivers; "
+                     "scripts/serve_bench.py sweep_leg)",
     SERVE + "requests": "observation requests submitted",
     SERVE + "dup_replays": "idempotent replays of cached replies",
     SERVE + "seq_resets": "per-actor sequence-lane resets",

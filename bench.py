@@ -487,6 +487,33 @@ def measure_serve() -> dict:
     )
 
 
+def measure_serve_sweep() -> dict:
+    """BENCH_SERVE fleet-sweep leg (scripts/serve_bench.py owns the
+    helpers): reactor vs threads ``server_io_mode`` at 16/32/64
+    scripted in-process shims — actions/sec per mode plus the
+    mid-window I/O thread census proving the reactor's thread count
+    is O(1) in fleet size."""
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts"),
+    )
+    import serve_bench as sb
+
+    fleets = tuple(
+        int(x)
+        for x in os.environ.get(
+            "BENCH_SWEEP_FLEETS", "16,32,64"
+        ).split(",")
+    )
+    return sb.sweep_leg(
+        fleets,
+        steps_per_actor=int(os.environ.get("BENCH_SWEEP_STEPS", 120)),
+        envs_per_actor=int(os.environ.get("BENCH_SWEEP_ENVS", 4)),
+        env=os.environ.get("BENCH_SERVE_ENV", "CartPole-v1"),
+        max_wait_ms=float(os.environ.get("BENCH_SERVE_WAIT_MS", 2.0)),
+    )
+
+
 def measure_tenancy() -> dict:
     """BENCH_SERVE multi-tenant leg (scripts/tenancy_bench.py owns
     the helpers): two tenants on one serving fleet — aggregate
@@ -665,6 +692,15 @@ def main() -> int:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         try:
             print(json.dumps(measure_serve()))
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+            return 1
+        return 0
+
+    if len(sys.argv) > 1 and sys.argv[1] == "--measure-serve-sweep":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        try:
+            print(json.dumps(measure_serve_sweep()))
         except Exception:
             traceback.print_exc(file=sys.stderr)
             return 1
@@ -1031,6 +1067,28 @@ def main() -> int:
             sys.stderr.write(
                 "[bench] tenancy leg failed\n"
                 + (tchild.stderr[-2000:] if tchild is not None else "")
+            )
+        # The reactor-vs-threads fleet sweep rides the same opt-in:
+        # same serving tier, now measured under both receive drivers.
+        wchild = None
+        try:
+            wchild = subprocess.run(
+                [
+                    sys.executable, os.path.abspath(__file__),
+                    "--measure-serve-sweep",
+                ],
+                capture_output=True,
+                text=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                timeout=int(os.environ.get("BENCH_CHILD_TIMEOUT", 900)),
+            )
+            payload["serve_sweep"] = json.loads(
+                wchild.stdout.strip().splitlines()[-1]
+            )
+        except Exception:
+            sys.stderr.write(
+                "[bench] serve-sweep leg failed\n"
+                + (wchild.stderr[-2000:] if wchild is not None else "")
             )
     print(json.dumps(payload))
     return 0
