@@ -2828,10 +2828,14 @@ def run_impala_distributed(
         )
         # The probe lets the reactor shed an over-budget tenant's TRAJ
         # frame at header time — body bytes drained, never buffered —
-        # while admit_frame still runs at frame end for metering.
+        # while record_shed attributes the drop at frame end
+        # unconditionally, so per-tenant meters can't disagree with
+        # transport_shed_frames when the bucket refills mid-frame.
         for s in servers:
             s.set_admission_handler(
-                admission.admit_frame, probe=admission.over_budget
+                admission.admit_frame,
+                probe=admission.over_budget,
+                shed=admission.record_shed,
             )
 
     # No actor threads here, but a multi-device CPU learner must still

@@ -157,13 +157,30 @@ class TenantAdmission:
         with self._lock:
             return self._charge(tenant, int(nbytes))
 
+    def record_shed(self, peer, nbytes: int) -> None:
+        """Attribution for a frame the TRANSPORT already dropped (the
+        reactor's header-time shed, installed via
+        ``set_admission_handler(..., shed=...)``): count it as SHED
+        for the tenant UNCONDITIONALLY — no bucket verdict, because
+        the bucket may have refilled between header parse and frame
+        end, and an "admitted" answer for a payload that was drained
+        to scratch would leave the per-tenant meters disagreeing with
+        ``transport_shed_frames``. Tokens are not charged: the shed
+        path never charges tokens for refused frames (matching
+        ``_charge``'s over-budget branch), it only meters them."""
+        tenant = int(getattr(peer, "tenant", DEFAULT_TENANT))
+        with self._lock:
+            counts = self._counts.setdefault(tenant, [0, 0, 0, 0])
+            counts[1] += 1
+            counts[3] += int(nbytes)
+
     def over_budget(self, peer) -> bool:
         """HEADER-TIME peek (the reactor transport's shed probe,
         installed via ``set_admission_handler(..., probe=...)``): is
         this peer's tenant exhausted RIGHT NOW? Refills the bucket but
-        charges nothing — ``admit_frame`` still runs at frame end for
-        the metering attribution — so a True here lets the transport
-        drain the frame's body to scratch instead of buffering it."""
+        charges nothing — ``record_shed`` attributes the drop at frame
+        end — so a True here lets the transport drain the frame's
+        body to scratch instead of buffering it."""
         tenant = int(getattr(peer, "tenant", DEFAULT_TENANT))
         rate = self.rate_for(tenant)
         if rate <= 0.0:

@@ -55,12 +55,12 @@ from __future__ import annotations
 import collections
 import dataclasses
 import math
-import select
 import selectors
 import socket
 import struct as struct_lib
 import threading
 import time
+import traceback
 import zlib
 from typing import Callable, Dict, List, Sequence, Tuple
 
@@ -355,8 +355,43 @@ _SENDMSG_MAX_BUFFERS = 512
 # How long a send on a NON-BLOCKING socket (the reactor's connections)
 # may sit in EAGAIN before the connection is declared wedged. Blocking
 # sockets never hit this path — their flow control is the blocking
-# send itself, exactly as before.
+# send itself, exactly as before. In reactor mode the deadline is
+# enforced by the event loop over the connection's buffered tail
+# (``_reactor_sweep_stalled``), never by a blocked thread.
 _SEND_STALL_S = 20.0
+
+# Reactor-mode outbound backlog ceiling per connection: a peer whose
+# buffered, unflushed tail exceeds this has stopped draining — fail
+# the NEXT send instead of buffering without bound. Well above the
+# largest single queued frame's *followers* in the request/reply
+# protocol (one param frame can exceed this and still buffers whole;
+# the cap only refuses piling more frames behind it).
+_TX_MAX_BUFFERED = 64 << 20
+
+
+def _wait_writable(sock: socket.socket, timeout: float | None) -> bool:
+    """Bounded writability wait that stays correct past FD_SETSIZE:
+    ``select.select`` raises ``ValueError`` for fds >= 1024 — exactly
+    the large-fleet regime the O(1)-thread reactor targets — so all
+    waits here go through a throwaway poll/epoll selector."""
+    sel = selectors.DefaultSelector()
+    try:
+        sel.register(sock, selectors.EVENT_WRITE)
+        return bool(sel.select(timeout))
+    finally:
+        sel.close()
+
+
+def _wait_readable(sock: socket.socket, timeout: float | None) -> bool:
+    """Readability twin of ``_wait_writable`` (client-side heartbeat
+    and notify waits — one bench process can hold hundreds of client
+    sockets, pushing fds past the select() limit)."""
+    sel = selectors.DefaultSelector()
+    try:
+        sel.register(sock, selectors.EVENT_READ)
+        return bool(sel.select(timeout))
+    finally:
+        sel.close()
 
 
 def _sendmsg_all(
@@ -395,7 +430,7 @@ def _sendmsg_all(
                     f"send stalled for {stall_timeout_s:.1f}s "
                     f"(peer not draining)"
                 )
-            select.select([], [sock], [], max(0.0, deadline - now))
+            _wait_writable(sock, max(0.0, deadline - now))
             continue
         deadline = None
         while sent:
@@ -652,6 +687,16 @@ class _Conn:
     send_lock: threading.Lock = dataclasses.field(
         default_factory=threading.Lock
     )
+    # Reactor-mode outbound buffering (guarded by ``send_lock``): the
+    # memoryview tail a non-blocking send could not push synchronously,
+    # flushed by the event loop on EVENT_WRITE readiness. ``tx_deadline``
+    # is the monotonic instant by which the tail must make progress
+    # (re-armed on every partial flush); None = nothing pending.
+    tx: collections.deque = dataclasses.field(
+        default_factory=collections.deque
+    )
+    tx_bytes: int = 0
+    tx_deadline: float | None = None
 
 
 class _GracefulClose(Exception):
@@ -666,6 +711,14 @@ class _GracefulClose(Exception):
 # payloads at least this large go straight into the destination array
 # (the zero-copy ingest path recv_msg uses).
 _RX_CHUNK = 262144
+
+# Per-readiness-pass fairness budget: one connection may consume at
+# most this many FRESH socket bytes per ``pump`` call before the loop
+# returns to the selector, so a firehose peer (a flooding tenant, a
+# param-scale push) cannot starve its neighbors' frames, accepts, or
+# idle sweeps. Resumption is free — epoll is level-triggered, so a
+# socket left with unread bytes re-fires on the next select pass.
+_PUMP_BUDGET_BYTES = 1 << 20
 
 
 class _RxState:
@@ -711,9 +764,13 @@ class _RxState:
     def pump(self, sock: socket.socket, on_frame) -> None:
         """Drain readable bytes into the parser. Calls ``on_frame(kind,
         tag, arrays, nbytes)`` per completed frame; returns when the
-        socket would block; raises ``ConnectionError`` on EOF (the same
-        "peer closed mid-frame" the blocking path raises) and whatever
-        the parser raises on hostile bytes."""
+        socket would block — or when the pass has consumed its
+        ``_PUMP_BUDGET_BYTES`` fairness budget (always with the
+        internal buffer fully parsed, so level-triggered readiness
+        resumes exactly where it left off); raises ``ConnectionError``
+        on EOF (the same "peer closed mid-frame" the blocking path
+        raises) and whatever the parser raises on hostile bytes."""
+        budget = _PUMP_BUDGET_BYTES
         while True:
             done = False
             data = None
@@ -746,7 +803,12 @@ class _RxState:
                 if frame is not None:
                     on_frame(*frame)
                 continue
-            # Request still short and the buffer is dry: read more.
+            # Request still short and the buffer is dry: read more —
+            # unless this pass already spent its fairness budget
+            # (every buffered byte is parsed at this point, so nothing
+            # is stranded between passes).
+            if budget <= 0:
+                return
             left = self.need - self.got
             if (
                 self.view is not None
@@ -763,6 +825,7 @@ class _RxState:
                     raise ConnectionError("peer closed mid-frame")
                 self.last_byte = time.monotonic()
                 self.got += r
+                budget -= r
                 continue
             try:
                 chunk = sock.recv(_RX_CHUNK)
@@ -773,6 +836,7 @@ class _RxState:
             self.last_byte = time.monotonic()
             self.buf = chunk
             self.pos = 0
+            budget -= len(chunk)
 
 
 class LearnerServer:
@@ -872,6 +936,11 @@ class LearnerServer:
         # body is buffered, so a flooding job's payload bytes are
         # drained to scratch instead of allocated.
         self._admission_probe = None
+        # Shed-attribution hook for header-shed frames: the payload is
+        # already gone, so metering must record SHED unconditionally —
+        # not re-ask the bucket, whose verdict can flip if it refilled
+        # between header parse and frame end.
+        self._admission_shed = None
         self._idle_timeout = idle_timeout_s
         # Param wire codec (distributed.codec): keep a small ring of
         # recent published versions' wire leaves and serve an XOR-delta
@@ -979,6 +1048,14 @@ class LearnerServer:
         # dispatch, consumed once per readiness pass).
         self._reactor_wakeups = 0
         self._obs_pending_wake = False
+        # Connections recycled because their buffered outbound tail
+        # made no progress for _SEND_STALL_S (reactor mode).
+        self._send_stalls = 0
+        # Write-interest requests from senders (any thread) to the
+        # loop (the only selector mutator): cid -> _Conn, drained by
+        # _reactor_arm_writes at the top of every loop pass.
+        self._tx_lock = threading.Lock()
+        self._tx_armed: Dict[int, _Conn] = {}
         self._listener = socket.create_server((host, port))
         self.port = self._listener.getsockname()[1]
         if server_io_mode == "reactor":
@@ -1089,7 +1166,7 @@ class LearnerServer:
         polling forever."""
         self._delivery = handler
 
-    def set_admission_handler(self, handler, *, probe=None) -> None:
+    def set_admission_handler(self, handler, *, probe=None, shed=None) -> None:
         """Install the tenant-admission gate
         (``distributed.tenancy.TenantAdmission.admit_frame``). Called
         as ``handler(peer, nbytes) -> bool`` on the connection's
@@ -1104,12 +1181,21 @@ class LearnerServer:
         the moment a TRAJ frame's header parses puts the frame in
         discard mode — array headers still validate identically, but
         the body is drained to scratch instead of buffered, so an
-        over-budget tenant's flood never allocates. The frame-end
-        ``handler`` still runs for such frames (metering attribution);
-        without a probe, shedding happens at frame end only — exactly
-        the threads-mode (and pre-reactor) semantics."""
+        over-budget tenant's flood never allocates. Without a probe,
+        shedding happens at frame end only — exactly the threads-mode
+        (and pre-reactor) semantics.
+
+        ``shed(peer, nbytes)`` (optional —
+        ``TenantAdmission.record_shed``) is the metering attribution
+        for a HEADER-shed frame: the transport already drained and
+        dropped the payload, so the hook must record it as SHED
+        unconditionally. Without it the frame-end ``handler`` runs
+        instead — whose bucket verdict can disagree with the drop if
+        the tenant refilled between header parse and frame end, so
+        wire all three when using ``TenantAdmission``."""
         self._admission = handler
         self._admission_probe = probe
+        self._admission_shed = shed
 
     def set_goodbye_handler(self, handler) -> None:
         """Install a hook called with a peer's ``PeerInfo`` when it
@@ -1194,6 +1280,21 @@ class LearnerServer:
         with self._reg_lock:
             live = list(self._conns.values())
         sent = 0
+        if self._io_mode == "reactor":
+            # Queue-or-send, never block: a peer with a send backlog
+            # gets the 17-byte notify buffered behind it (the loop's
+            # stall deadline recycles a truly wedged peer), and the
+            # send lock inside _reactor_send is only ever held for a
+            # non-blocking sendmsg — no wedged-peer stall to bound.
+            for c in live:
+                try:
+                    self._reactor_send(c, [frame])
+                    sent += 1
+                except (OSError, ValueError):
+                    pass
+            with self._reg_lock:
+                self._notifies_sent += sent
+            return
         for c in live:
             # Tiny BOUNDED lock wait, not a pure try-lock: the serve
             # thread releases this lock microseconds after its send's
@@ -1209,8 +1310,7 @@ class LearnerServer:
             if not c.send_lock.acquire(timeout=0.002):
                 continue
             try:
-                _, writable, _ = select.select([], [c.sock], [], 0)
-                if not writable:
+                if not _wait_writable(c.sock, 0):
                     continue
                 n = c.sock.send(frame)
                 if n != len(frame):
@@ -1353,6 +1453,11 @@ class LearnerServer:
                     )
                 ),
                 "transport_reactor_wakeups": self._reactor_wakeups,
+                # Connections recycled because their buffered send
+                # made no progress for the stall window (reactor
+                # mode; 0 in threads mode, where the blocking send's
+                # own deadline raises instead).
+                "transport_send_stalls": self._send_stalls,
             }
 
     def connections(self) -> List[dict]:
@@ -1512,32 +1617,184 @@ class LearnerServer:
                     f"recycling connection"
                 )
             self._reactor_retire(c, "disconnect")
-        except (ConnectionError, OSError) as e:
+        except (ConnectionError, OSError, ValueError) as e:
             if not self._stopping.is_set():
                 self._log(
                     f"actor#{c.cid} ({c.addr}) lost: "
                     f"{type(e).__name__}: {e}"
                 )
             self._reactor_retire(c, "disconnect")
+        except Exception:
+            # A handler bug — the trajectory sink, a serving/replay/
+            # delivery hook choking on one malformed payload — must
+            # cost ONE connection, exactly as it did in threads mode
+            # (where it killed only that connection's thread), never
+            # the shared I/O plane. Full traceback: this is a code
+            # bug, not wire noise.
+            self._log(
+                f"actor#{c.cid} ({c.addr}) handler error; recycling "
+                f"connection\n{traceback.format_exc()}"
+            )
+            self._reactor_retire(c, "disconnect")
+
+    def _reactor_send(self, c: _Conn, parts: Sequence) -> None:
+        """Reactor-mode send: NEVER blocks, from any thread. Whatever
+        the non-blocking socket takes synchronously goes out here; any
+        tail is buffered on the connection (the buffered memoryviews
+        pin their backing arrays, which are immutable once published —
+        see ``frame_views``) and flushed by the event loop on
+        EVENT_WRITE readiness, with the no-progress stall deadline
+        enforced by the loop (``_reactor_sweep_stalled``) instead of a
+        blocked thread. A peer whose backlog already exceeds
+        ``_TX_MAX_BUFFERED`` gets ``ConnectionError`` — it has stopped
+        draining, and buffering more only defers the verdict."""
+        bufs = [memoryview(p) for p in parts if len(p)]
+        with c.send_lock:
+            if c.tx_bytes > _TX_MAX_BUFFERED:
+                raise ConnectionError(
+                    f"send backlog of {c.tx_bytes} bytes "
+                    f"(peer not draining)"
+                )
+            if not c.tx:
+                idx = 0
+                while idx < len(bufs):
+                    try:
+                        sent = c.sock.sendmsg(
+                            bufs[idx : idx + _SENDMSG_MAX_BUFFERS]
+                        )
+                    except BlockingIOError:
+                        break
+                    while sent:
+                        b = bufs[idx]
+                        if sent >= len(b):
+                            sent -= len(b)
+                            idx += 1
+                        else:
+                            bufs[idx] = b[sent:]
+                            sent = 0
+                bufs = bufs[idx:]
+            if not bufs:
+                return
+            c.tx.extend(bufs)
+            c.tx_bytes += sum(len(b) for b in bufs)
+            if c.tx_deadline is None:
+                c.tx_deadline = time.monotonic() + _SEND_STALL_S
+        self._arm_write(c)
+
+    def _arm_write(self, c: _Conn) -> None:
+        """Request EVENT_WRITE interest for ``c``. The selector is
+        loop-private (mutating it from a foreign thread races the
+        in-flight select), so senders enqueue the request and nudge
+        the loop through the wake pipe."""
+        with self._tx_lock:
+            self._tx_armed[c.cid] = c
+        if threading.current_thread() is not self._io_thread:
+            self._wake_loop()
+
+    def _reactor_arm_writes(self) -> None:
+        """Apply senders' pending write-interest requests (loop thread
+        only, at the top of every pass — before the select sleeps)."""
+        with self._tx_lock:
+            if not self._tx_armed:
+                return
+            armed = list(self._tx_armed.values())
+            self._tx_armed.clear()
+        for c in armed:
+            try:
+                self._selector.modify(
+                    c.sock,
+                    selectors.EVENT_READ | selectors.EVENT_WRITE,
+                    c,
+                )
+            except (KeyError, ValueError, OSError):
+                pass  # retired between the enqueue and this pass
+
+    def _reactor_writable(self, c: _Conn) -> None:
+        """Flush ``c``'s buffered outbound tail (EVENT_WRITE
+        readiness): whatever the kernel takes now goes out, progress
+        re-arms the stall deadline, and an emptied queue drops write
+        interest. Loop thread only — the selector is loop-private."""
+        try:
+            with c.send_lock:
+                while c.tx:
+                    n = min(len(c.tx), _SENDMSG_MAX_BUFFERS)
+                    try:
+                        sent = c.sock.sendmsg(
+                            [c.tx[i] for i in range(n)]
+                        )
+                    except BlockingIOError:
+                        return
+                    if sent:
+                        c.tx_bytes -= sent
+                        c.tx_deadline = (
+                            time.monotonic() + _SEND_STALL_S
+                        )
+                    while sent:
+                        b = c.tx[0]
+                        if sent >= len(b):
+                            sent -= len(b)
+                            c.tx.popleft()
+                        else:
+                            c.tx[0] = b[sent:]
+                            sent = 0
+                c.tx_deadline = None
+                self._selector.modify(c.sock, selectors.EVENT_READ, c)
+        except (KeyError, OSError, ValueError) as e:
+            if not self._stopping.is_set():
+                self._log(
+                    f"actor#{c.cid} ({c.addr}) lost mid-send: "
+                    f"{type(e).__name__}: {e}"
+                )
+            self._reactor_retire(c, "disconnect")
+
+    def _reactor_sweep_stalled(self) -> None:
+        """Retire connections whose buffered send made no progress for
+        ``_SEND_STALL_S`` — the loop-enforced analog of the blocking
+        path's send-stall deadline. One slow param fetcher costs ITS
+        connection, never a stalled loop."""
+        now = time.monotonic()
+        with self._reg_lock:
+            stalled = [
+                c for c in self._conns.values()
+                if c.tx_deadline is not None and now >= c.tx_deadline
+            ]
+        for c in stalled:
+            with self._reg_lock:
+                self._send_stalls += 1
+            self._log(
+                f"actor#{c.cid} ({c.addr}) send stalled for "
+                f"{_SEND_STALL_S:.0f}s (peer not draining); "
+                f"recycling connection"
+            )
+            self._reactor_retire(c, "disconnect")
 
     def _reactor_timeout(self) -> float | None:
-        """Selector timeout to the NEAREST idle deadline across live
-        connections (None = sleep until an fd or the wake pipe fires —
+        """Selector timeout to the NEAREST deadline across live
+        connections — idle deadlines and buffered-send stall
+        deadlines (None = sleep until an fd or the wake pipe fires —
         no deadline to track). Byte-level activity counts: a peer
         trickling a large frame is not idle, matching the threads
         mode's per-recv timeout."""
-        if self._idle_timeout is None:
-            return None
-        now = time.monotonic()
         with self._reg_lock:
-            if not self._conns:
-                return None
+            conns = list(self._conns.values())
+        deadline = None
+        for c in conns:
+            if c.tx_deadline is not None and (
+                deadline is None or c.tx_deadline < deadline
+            ):
+                deadline = c.tx_deadline
+        if self._idle_timeout is not None and conns:
             nearest = min(
                 max(c.last_recv, c.rx.last_byte)
                 if c.rx is not None else c.last_recv
-                for c in self._conns.values()
+                for c in conns
             )
-        return max(0.0, nearest + self._idle_timeout - now)
+            idle_deadline = nearest + self._idle_timeout
+            if deadline is None or idle_deadline < deadline:
+                deadline = idle_deadline
+        if deadline is None:
+            return None
+        return max(0.0, deadline - time.monotonic())
 
     def _reactor_sweep_idle(self) -> None:
         if self._idle_timeout is None or self._closing.is_set():
@@ -1563,16 +1820,19 @@ class LearnerServer:
 
     def _reactor_loop(self) -> None:
         """THE event loop: one thread drives accept, every connection's
-        frame reassembly + dispatch, idle deadlines, and the batched
-        serving-tick wake. Never blocks outside ``selector.select`` —
-        see analysis/lock_hygiene (LOCK003 covers reactor callbacks)."""
+        frame reassembly + dispatch, buffered-send flushing, idle and
+        send-stall deadlines, and the batched serving-tick wake. Never
+        blocks outside ``selector.select`` — sends queue-or-buffer
+        (``_reactor_send``) and flush on writability — see
+        analysis/lock_hygiene (LOCK003 covers reactor callbacks)."""
         sel = self._selector
         try:
             while not self._stopping.is_set():
+                self._reactor_arm_writes()
                 events = sel.select(self._reactor_timeout())
                 with self._reg_lock:
                     self._reactor_wakeups += 1
-                for key, _mask in events:
+                for key, mask in events:
                     what = key.data
                     if what == "wake":
                         try:
@@ -1580,14 +1840,31 @@ class LearnerServer:
                         except (BlockingIOError, OSError):
                             pass
                     elif what == "accept":
-                        self._reactor_accept()
+                        try:
+                            self._reactor_accept()
+                        except Exception:
+                            # One bad accept must not take down the
+                            # whole I/O plane.
+                            self._log(
+                                "accept failed; listener kept:\n"
+                                + traceback.format_exc()
+                            )
                     else:
-                        self._reactor_readable(what)
+                        if mask & selectors.EVENT_WRITE:
+                            self._reactor_writable(what)
+                        if (
+                            mask & selectors.EVENT_READ
+                            # A failed flush above may have retired
+                            # (and closed) this connection already.
+                            and what.sock.fileno() >= 0
+                        ):
+                            self._reactor_readable(what)
                 if self._obs_pending_wake:
                     self._obs_pending_wake = False
                     wake = self._inference_wake
                     if wake is not None:
                         wake()
+                self._reactor_sweep_stalled()
                 self._reactor_sweep_idle()
         finally:
             try:
@@ -1611,8 +1888,14 @@ class LearnerServer:
         # Header bytes are `bytes`, payloads are uint8-cast memoryviews:
         # len() is exact wire bytes either way.
         nbytes = sum(len(p) for p in parts)
-        with c.send_lock:
-            _sendmsg_all(c.sock, parts)
+        if self._io_mode == "reactor":
+            # Queue-or-buffer, never block: dispatch-path sends run ON
+            # the loop thread, where one slow peer's full send buffer
+            # must not head-of-line block every other connection.
+            self._reactor_send(c, parts)
+        else:
+            with c.send_lock:
+                _sendmsg_all(c.sock, parts)
         with self._reg_lock:
             self._bytes_out += nbytes
         return nbytes
@@ -1846,17 +2129,28 @@ class LearnerServer:
             if arrays is None:
                 # Shed at HEADER time by the admission probe (reactor
                 # mode): the body was drained to scratch, never
-                # buffered. The frame-end admission handler still runs
-                # so the per-tenant metering counters agree with the
-                # frame-end shed path; the ACK is identical too.
+                # buffered. Attribution goes through the dedicated
+                # shed hook, which records the drop UNCONDITIONALLY —
+                # re-asking the frame-end handler could flip to
+                # "admitted" if the tenant's bucket refilled between
+                # header parse and frame end, leaving the per-tenant
+                # meters disagreeing with transport_shed_frames. The
+                # ACK is identical either way.
+                shed_hook = self._admission_shed
                 admission = self._admission
-                if admission is not None:
+                if shed_hook is not None or admission is not None:
                     with self._reg_lock:
                         peer = PeerInfo(
                             c.cid, c.actor_id, c.generation, c.role,
                             c.caps, c.epoch, c.tenant,
                         )
-                    admission(peer, nbytes)
+                    if shed_hook is not None:
+                        shed_hook(peer, nbytes)
+                    else:
+                        # Legacy two-hook wiring: the frame-end
+                        # handler is the only meter available; its
+                        # verdict is ignored (the payload is gone).
+                        admission(peer, nbytes)
                 with self._reg_lock:
                     self._shed_frames += 1
                 self._send(c, KIND_ACK, self._version)
@@ -2155,6 +2449,17 @@ class LearnerServer:
             ]
         told = 0
         for c in standbys:
+            if self._io_mode == "reactor":
+                # Queue-or-buffer: never blocks the caller, and never
+                # select()s on a possibly-huge fd (the loop flushes).
+                try:
+                    self._reactor_send(
+                        c, frame_views(KIND_HANDOFF, self._version, ())
+                    )
+                    told += 1
+                except OSError:
+                    pass
+                continue
             if c.send_lock.acquire(timeout=0.5):
                 try:
                     send_msg(c.sock, KIND_HANDOFF, self._version)
@@ -2180,33 +2485,35 @@ class LearnerServer:
             # bound both the lock wait AND the send itself (a peer that
             # stopped reading has a full send buffer; this socket is
             # force-closed moments later anyway).
+            if self._io_mode == "reactor":
+                # Queue-or-buffer (NO settimeout: it would flip the
+                # fd's timeout mode under the reactor's non-blocking
+                # recv path): the goodbye goes out synchronously or
+                # rides the loop's writability flush during the
+                # grace window; a wedged peer's tail just dies with
+                # the force-close moments later.
+                try:
+                    self._reactor_send(
+                        c, frame_views(KIND_CLOSE, self._version, ())
+                    )
+                except OSError:
+                    pass
+                continue
             if c.send_lock.acquire(timeout=0.2):
                 try:
-                    if self._io_mode == "reactor":
-                        # NO settimeout here: it would flip the fd's
-                        # timeout mode under the reactor's non-blocking
-                        # recv path. The send bound comes from
-                        # _sendmsg_all's EAGAIN stall deadline instead.
-                        _sendmsg_all(
-                            c.sock,
-                            frame_views(KIND_CLOSE, self._version, ()),
-                            stall_timeout_s=0.2,
-                        )
-                    else:
-                        c.sock.settimeout(0.2)
-                        send_msg(c.sock, KIND_CLOSE, self._version)
+                    c.sock.settimeout(0.2)
+                    send_msg(c.sock, KIND_CLOSE, self._version)
                 except OSError:
                     pass
                 finally:
-                    if self._io_mode != "reactor":
-                        try:
-                            c.sock.settimeout(
-                                self._idle_timeout
-                                if self._idle_timeout is not None
-                                else None
-                            )
-                        except OSError:
-                            pass
+                    try:
+                        c.sock.settimeout(
+                            self._idle_timeout
+                            if self._idle_timeout is not None
+                            else None
+                        )
+                    except OSError:
+                        pass
                     c.send_lock.release()
 
     def close(self, *, graceful: bool = True, grace_s: float = 1.0) -> None:
@@ -2345,12 +2652,11 @@ class ActorClient:
             time.monotonic() + self._idle if self._idle is not None else None
         )
         while True:
-            # select-then-recv: the wait is interruptible for pings
+            # wait-then-recv: the wait is interruptible for pings
             # without ever timing out MID-frame (which would desync the
             # stream). A peer that stalls mid-frame hits the recv
             # timeout below and the connection is dropped.
-            readable, _, _ = select.select([sock], [], [], self._heartbeat)
-            if not readable:
+            if not _wait_readable(sock, self._heartbeat):
                 if deadline is not None and time.monotonic() >= deadline:
                     raise ConnectionError(
                         f"learner unresponsive for {self._idle:.0f}s "
@@ -2419,8 +2725,7 @@ class ActorClient:
             wait = 0.0
             if deadline is not None:
                 wait = max(0.0, deadline - time.monotonic())
-            readable, _, _ = select.select([sock], [], [], wait)
-            if not readable:
+            if not _wait_readable(sock, wait):
                 return self.notified_version
             # Server-initiated frames are tiny (17-byte headers); a
             # mid-frame stall still trips the idle deadline below.

@@ -90,6 +90,9 @@ METRIC_NAMES: dict = {
                               "threads mode: accept + per-conn)",
     TRANSPORT + "reactor_wakeups": "event-loop readiness passes "
                                    "(reactor mode only)",
+    TRANSPORT + "send_stalls": "connections recycled because a peer "
+                               "stopped draining its buffered sends "
+                               "(reactor mode only)",
     TRANSPORT + "mb_out": "megabytes sent (all frames)",
     TRANSPORT + "param_sends": "param fetches served",
     TRANSPORT + "param_delta_sends": "param fetches served as deltas",

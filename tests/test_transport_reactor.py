@@ -18,8 +18,15 @@ import time
 import numpy as np
 import pytest
 
+from actor_critic_algs_on_tensorflow_tpu.distributed import (
+    transport as transport_mod,
+)
+from actor_critic_algs_on_tensorflow_tpu.distributed.tenancy import (
+    TenantAdmission,
+)
 from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
     KIND_ACK,
+    KIND_GET_PARAMS,
     KIND_TRAJ,
     MAGIC,
     MAX_NDIM,
@@ -30,6 +37,7 @@ from actor_critic_algs_on_tensorflow_tpu.distributed.transport import (
     _RxState,
     pack_arrays,
 )
+from tests.helpers import time_limit
 
 
 class _ScriptedSock:
@@ -363,3 +371,147 @@ def test_reactor_survives_hostile_peer_and_keeps_serving():
     alive = server.alive
     server.close()
     assert sunk and alive
+
+
+def test_pump_budget_yields_and_resumes(monkeypatch):
+    """Fairness: with a tiny budget, one pump pass returns to the
+    selector with socket bytes still unread (a firehose peer can't
+    monopolize the readiness pass), and the next pass resumes exactly
+    where it left off — all frames still land intact."""
+    monkeypatch.setattr(transport_mod, "_PUMP_BUDGET_BYTES", 64)
+    arrays, payload = _example_frame()
+    data = payload * 3
+    frames = []
+    rx = _RxState(lambda: _frame_parser())
+    # Many small chunks, so the budget can bite between recvs.
+    sock = _ScriptedSock(data, [50] * (len(data) // 50))
+    rx.pump(sock, lambda *f: frames.append(f))
+    assert sock._chunks, "budget did not bound the pass"
+    passes = 1
+    while sock._chunks:
+        rx.pump(sock, lambda *f: frames.append(f))
+        passes += 1
+        assert passes < 1000
+    # Nothing buffered unread inside rx between passes would show up
+    # here as a missing/short frame.
+    rx.pump(sock, lambda *f: frames.append(f))
+    assert passes > 1
+    assert len(frames) == 3
+    for frame in frames:
+        _assert_frame(frame, arrays)
+
+
+def test_reactor_handler_fault_costs_one_connection():
+    """A sink bug (ValueError on a malformed trajectory) retires the
+    offending connection only — threads-mode blast radius — and the
+    loop keeps serving everyone else."""
+    calls = []
+
+    def sink(traj, ep):
+        calls.append(1)
+        if len(calls) == 1:
+            raise ValueError("malformed trajectory")
+        return True
+
+    server = LearnerServer(
+        sink, server_io_mode="reactor", log=lambda m: None
+    )
+    with time_limit(20.0, "handler-fault isolation"):
+        bad = ActorClient("127.0.0.1", server.port)
+        with pytest.raises((ConnectionError, OSError)):
+            bad.push_trajectory(
+                [np.ones((3,), np.float32)], [np.zeros(1, np.float32)]
+            )
+        bad.close()
+        good = ActorClient("127.0.0.1", server.port)
+        good.push_trajectory(
+            [np.ones((3,), np.float32)], [np.zeros(1, np.float32)]
+        )
+        good.close()
+        alive = server.alive
+        server.close()
+    assert alive
+    assert len(calls) == 2
+
+
+def test_reactor_slow_param_fetcher_does_not_block_loop(monkeypatch):
+    """HOL-blocking pin: a peer that requests full params and never
+    reads them must not stall the loop — another client's pushes keep
+    ACKing while the send sits buffered, and the stall sweep recycles
+    the wedged connection (transport_send_stalls)."""
+    monkeypatch.setattr(transport_mod, "_SEND_STALL_S", 2.0)
+    server = LearnerServer(
+        lambda traj, ep: True,
+        server_io_mode="reactor",
+        log=lambda m: None,
+    )
+    with time_limit(30.0, "slow param fetcher"):
+        # Params far larger than the peer's socket buffers, so the
+        # send MUST tail-buffer on the server.
+        server.publish(
+            [np.zeros(4_000_000, np.float32)], notify=False
+        )
+        wedged = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        wedged.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+        wedged.connect(("127.0.0.1", server.port))
+        wedged.sendall(bytes(pack_arrays(KIND_GET_PARAMS, 0, [])))
+        # Let the reactor dispatch the fetch and wedge the reply.
+        time.sleep(0.2)
+        client = ActorClient("127.0.0.1", server.port)
+        t0 = time.monotonic()
+        for _ in range(3):
+            client.push_trajectory(
+                [np.ones((4,), np.float32)], [np.zeros(1, np.float32)]
+            )
+        elapsed = time.monotonic() - t0
+        client.close()
+        # Head-of-line blocked sends would serialize these behind the
+        # wedged 8 MB param frame (>= the 2 s stall deadline).
+        assert elapsed < 2.0, f"pushes took {elapsed:.2f}s"
+        deadline = time.monotonic() + 10.0
+        while (
+            server.metrics()["transport_send_stalls"] < 1
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.05)
+        m = server.metrics()
+        wedged.close()
+        server.close()
+    assert m["transport_send_stalls"] >= 1
+
+
+def test_header_shed_attribution_survives_bucket_refill():
+    """Finding-5 pin: when the transport sheds at header time but the
+    tenant's bucket has tokens by frame end, the shed hook still
+    records the drop as SHED — per-tenant meters agree with
+    transport_shed_frames instead of claiming admission for a payload
+    that was drained to scratch."""
+    # Generous budget: admit_frame WOULD say "admitted" — the old
+    # disagreement path — so only record_shed keeps the books honest.
+    adm = TenantAdmission(default_mb_s=1000.0, log=lambda m: None)
+    server = LearnerServer(
+        lambda traj, ep: True,
+        server_io_mode="reactor",
+        log=lambda m: None,
+    )
+    server.set_admission_handler(
+        adm.admit_frame,
+        probe=lambda peer: True,  # force the header shed
+        shed=adm.record_shed,
+    )
+    client = ActorClient("127.0.0.1", server.port)
+    traj = [np.ones((8, 4), np.float32)]
+    client.push_trajectory(traj, [np.zeros(1, np.float32)])
+    client.push_trajectory(traj, [np.zeros(1, np.float32)])
+    client.close()
+    deadline = time.monotonic() + 5.0
+    while server.metrics()["transport_shed_frames"] < 2 and (
+        time.monotonic() < deadline
+    ):
+        time.sleep(0.01)
+    m = server.metrics()
+    server.close()
+    t = adm.metrics()
+    assert m["transport_shed_frames"] == 2
+    assert t["tenant_frames_shed"] == 2
+    assert t["tenant_frames_admitted"] == 0
